@@ -1,0 +1,2 @@
+"""Backend engines: 'local' (single-process in-memory server) and 'tpu'
+(SPMD over a device mesh)."""

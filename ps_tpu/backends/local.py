@@ -1,0 +1,149 @@
+"""Single-process local parameter server.
+
+This is the reference's "single-process local PS, CPU" mode (BASELINE.json
+config 1) — the full push/aggregate/apply/pull protocol with no network and
+no mesh, used as the testing seam and for small CPU runs.
+
+Semantics implemented here (the spec the TPU backend must match numerically):
+
+- **Per-key optimizer state.** Each parameter key has its own optax state,
+  exactly like the reference server keeps state per key. For per-tensor
+  optimizers (SGD/momentum/Adam/LAMB) this is numerically identical to a
+  whole-tree update, which is what the fused TPU path does; the parity tests
+  assert this.
+- **Sync aggregation.** A key's update fires only once all ``num_workers``
+  logical workers have pushed for the current step; gradients are averaged
+  (matching data-parallel pmean semantics). A pull that would observe a
+  half-aggregated key raises instead of silently returning stale values.
+- **Async apply** (mode='async'): every push applies immediately with
+  DC-ASGD delay compensation against the pusher's last-pulled version.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import jax
+import optax
+
+from ps_tpu.config import Config
+from ps_tpu.optim.dc import delay_compensate
+
+
+class LocalServer:
+    """In-memory server for one KVStore: params + per-key optimizer state."""
+
+    def __init__(self, optimizer: optax.GradientTransformation, num_workers: int,
+                 mode: str = "sync", aggregate: str = "mean", dc_lambda: float = 0.04):
+        if aggregate not in ("mean", "sum"):
+            raise ValueError("aggregate must be 'mean' or 'sum'")
+        self._opt = optimizer
+        self.num_workers = num_workers
+        self.mode = mode
+        self.aggregate = aggregate
+        self.dc_lambda = dc_lambda
+        self._params: Dict[str, jax.Array] = {}
+        self._state: Dict[str, Any] = {}
+        # sync aggregation buffers: key -> {worker_id: grad}
+        self._pending: Dict[str, Dict[int, jax.Array]] = {}
+        # async: (worker_id, key) -> param snapshot at that worker's last pull
+        self._stale: Dict[tuple, jax.Array] = {}
+        self.apply_count: Dict[str, int] = {}
+
+        def _apply(param, state, grad):
+            updates, new_state = self._opt.update(grad, state, param)
+            return optax.apply_updates(param, updates), new_state
+
+        self._jit_apply = jax.jit(_apply)
+
+        def _apply_dc(param, state, grad, stale_param, lam):
+            g = delay_compensate(grad, param, stale_param, lam)
+            updates, new_state = self._opt.update(g, state, param)
+            return optax.apply_updates(param, updates), new_state
+
+        self._jit_apply_dc = jax.jit(_apply_dc, static_argnums=(4,))
+
+    # -- registration -------------------------------------------------------
+
+    def register(self, key: str, value: jax.Array) -> None:
+        if key in self._params:
+            raise ValueError(f"key {key!r} already registered")
+        self._params[key] = value
+        self._state[key] = self._opt.init(value)
+        self.apply_count[key] = 0
+
+    def keys(self):
+        return list(self._params)
+
+    # -- push/pull ----------------------------------------------------------
+
+    def push(self, key: str, grad: jax.Array, worker: int = 0) -> None:
+        if key not in self._params:
+            raise KeyError(f"unregistered key {key!r}")
+        if not (0 <= worker < self.num_workers):
+            raise ValueError(f"worker {worker} out of range [0, {self.num_workers})")
+        if self.mode == "async":
+            self._apply_async(key, grad, worker)
+            return
+        slot = self._pending.setdefault(key, {})
+        if worker in slot:
+            raise RuntimeError(
+                f"worker {worker} pushed key {key!r} twice before aggregation fired"
+            )
+        slot[worker] = grad
+        if len(slot) == self.num_workers:
+            agg = slot[0]
+            for w in range(1, self.num_workers):
+                agg = jax.tree_util.tree_map(lambda a, b: a + b, agg, slot[w])
+            if self.aggregate == "mean" and self.num_workers > 1:
+                agg = jax.tree_util.tree_map(lambda a: a / self.num_workers, agg)
+            self._params[key], self._state[key] = self._jit_apply(
+                self._params[key], self._state[key], agg
+            )
+            self.apply_count[key] += 1
+            del self._pending[key]
+
+    def _apply_async(self, key: str, grad: jax.Array, worker: int) -> None:
+        stale = self._stale.get((worker, key), self._params[key])
+        self._params[key], self._state[key] = self._jit_apply_dc(
+            self._params[key], self._state[key], grad, stale, self.dc_lambda
+        )
+        self.apply_count[key] += 1
+
+    def pull(self, key: str, worker: int = 0) -> jax.Array:
+        if key not in self._params:
+            raise KeyError(f"unregistered key {key!r}")
+        if self.mode == "sync" and key in self._pending:
+            got = sorted(self._pending[key])
+            raise RuntimeError(
+                f"pull({key!r}) would block: only workers {got} of "
+                f"{self.num_workers} have pushed this step"
+            )
+        if self.mode == "async":
+            self._stale[(worker, key)] = self._params[key]
+        return self._params[key]
+
+    def optimizer_state(self, key: str):
+        return self._state[key]
+
+
+class LocalBackend:
+    """Backend for ``ps_tpu.init(backend='local')``."""
+
+    def __init__(self, config: Config):
+        self.config = config
+        self.num_workers = config.num_workers
+
+    def create_server(self, optimizer: optax.GradientTransformation,
+                      mode: Optional[str] = None,
+                      aggregate: str = "mean") -> LocalServer:
+        return LocalServer(
+            optimizer,
+            num_workers=self.num_workers,
+            mode=mode or self.config.mode,
+            aggregate=aggregate,
+            dc_lambda=self.config.dc_lambda,
+        )
+
+    def shutdown(self) -> None:
+        pass

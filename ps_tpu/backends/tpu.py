@@ -1,0 +1,19 @@
+"""SPMD mesh backend — lands with P1 (SURVEY.md §8).
+
+Will provide: jax.distributed init (multi-host rendezvous), Mesh construction,
+and a sharded server whose push/apply/pull is one fused jitted step
+('replicated' = psum DP; 'sharded' = reduce-scatter/apply/all-gather,
+the TPU equivalent of key→server sharding).
+"""
+
+from __future__ import annotations
+
+from ps_tpu.config import Config
+
+
+class TpuBackend:
+    def __init__(self, config: Config):
+        raise NotImplementedError(
+            "backend='tpu' is not implemented yet (P1 in SURVEY.md §8); "
+            "use backend='local' meanwhile"
+        )

@@ -1,0 +1,92 @@
+"""Typed configuration for ps_tpu.
+
+The reference family configures node roles through environment variables
+(``DMLC_ROLE`` / ``DMLC_PS_ROOT_URI`` style) plus per-trainer argparse flags
+(SURVEY.md §3 row 17). ps_tpu keeps that spirit with one dataclass that can be
+built from environment variables, so existing launcher scripts that export
+role/coordinator env vars keep working.
+
+Environment variables honored by :meth:`Config.from_env`:
+
+- ``PS_BACKEND``           — 'local' or 'tpu' (default 'local')
+- ``PS_NUM_WORKERS``       — logical worker count for sync aggregation
+- ``PS_COORDINATOR_URI``   — multi-host coordinator ``host:port`` (tpu backend)
+- ``PS_NUM_PROCESSES``     — multi-host process count
+- ``PS_PROCESS_ID``        — this process's id
+- ``DMLC_ROLE`` etc. are accepted as aliases where the meaning is knowable.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+
+@dataclasses.dataclass
+class Config:
+    """Runtime configuration for :func:`ps_tpu.init`.
+
+    Attributes:
+      backend: 'local' (single-process, any JAX default device — the
+        reference's "single-process local PS" test seam) or 'tpu' (SPMD over a
+        device mesh; also works on CPU with virtual devices for testing).
+      num_workers: logical worker count for the local backend's sync
+        aggregation semantics (server applies once all workers pushed).
+        For the 'tpu' backend the worker count is the mesh's data-axis size.
+      coordinator_uri: ``host:port`` of the jax.distributed coordinator for
+        multi-host runs. ``None`` means single-host.
+      num_processes / process_id: multi-host topology for
+        ``jax.distributed.initialize``.
+      mesh_shape: optional explicit mesh shape, e.g. ``{'data': 8}`` or
+        ``{'data': 4, 'model': 2}``. Default: all devices on one 'data' axis.
+      mode: 'sync' or 'async' (async = stale apply with delay compensation).
+      dc_lambda: DC-ASGD delay-compensation coefficient (async mode).
+      seed: global PRNG seed.
+    """
+
+    backend: str = "local"
+    num_workers: int = 1
+    coordinator_uri: Optional[str] = None
+    num_processes: int = 1
+    process_id: int = 0
+    mesh_shape: Optional[dict] = None
+    mode: str = "sync"
+    dc_lambda: float = 0.04
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.backend not in ("local", "tpu"):
+            raise ValueError(f"unknown backend {self.backend!r}; use 'local' or 'tpu'")
+        if self.mode not in ("sync", "async"):
+            raise ValueError(f"unknown mode {self.mode!r}; use 'sync' or 'async'")
+        if self.num_workers < 1:
+            raise ValueError("num_workers must be >= 1")
+
+    @classmethod
+    def from_env(cls, **overrides) -> "Config":
+        """Build a Config from PS_* (and DMLC_* alias) environment variables."""
+        env = os.environ
+        kwargs = {}
+        if "PS_BACKEND" in env:
+            kwargs["backend"] = env["PS_BACKEND"]
+        if "PS_NUM_WORKERS" in env:
+            kwargs["num_workers"] = int(env["PS_NUM_WORKERS"])
+        elif "DMLC_NUM_WORKER" in env:
+            kwargs["num_workers"] = int(env["DMLC_NUM_WORKER"])
+        if "PS_COORDINATOR_URI" in env:
+            kwargs["coordinator_uri"] = env["PS_COORDINATOR_URI"]
+        elif "DMLC_PS_ROOT_URI" in env and "DMLC_PS_ROOT_PORT" in env:
+            kwargs["coordinator_uri"] = (
+                f"{env['DMLC_PS_ROOT_URI']}:{env['DMLC_PS_ROOT_PORT']}"
+            )
+        if "PS_NUM_PROCESSES" in env:
+            kwargs["num_processes"] = int(env["PS_NUM_PROCESSES"])
+        if "PS_PROCESS_ID" in env:
+            kwargs["process_id"] = int(env["PS_PROCESS_ID"])
+        if "PS_MODE" in env:
+            kwargs["mode"] = env["PS_MODE"]
+        if "PS_SEED" in env:
+            kwargs["seed"] = int(env["PS_SEED"])
+        kwargs.update(overrides)
+        return cls(**kwargs)

@@ -1,0 +1,3 @@
+"""Data pipelines. This environment has no network access, so every dataset
+has a deterministic synthetic generator shaped like the real one; trainers
+take ``--synthetic`` (default) and plug real loaders in the same interface."""

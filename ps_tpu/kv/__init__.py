@@ -1,0 +1,1 @@
+"""Key-value push/pull layer — the heart of the parameter-server API."""

@@ -1,0 +1,63 @@
+"""Parameter-key handling.
+
+The reference family addresses every tensor by an integer/string key and
+range-shards keys across servers (SURVEY.md §3 row 4). ps_tpu derives keys
+from pytree paths ("dense1/kernel"), keeping a stable sorted ordering so the
+key space is deterministic across processes — the property the reference's
+key→server range partition relies on.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Any, Dict, List, Tuple
+
+import jax
+
+
+def _path_str(path) -> str:
+    parts = []
+    for p in path:
+        if isinstance(p, jax.tree_util.DictKey):
+            parts.append(str(p.key))
+        elif isinstance(p, jax.tree_util.SequenceKey):
+            parts.append(str(p.idx))
+        elif isinstance(p, jax.tree_util.GetAttrKey):
+            parts.append(str(p.name))
+        elif isinstance(p, jax.tree_util.FlattenedIndexKey):
+            parts.append(str(p.key))
+        else:
+            parts.append(str(p))
+    return "/".join(parts)
+
+
+def flatten_with_keys(tree: Any) -> Tuple[Dict[str, Any], Any]:
+    """Flatten a pytree into a ``{key: leaf}`` dict plus its treedef.
+
+    Keys are slash-joined path strings; collisions are an error.
+    """
+    leaves_with_paths, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    out: Dict[str, Any] = {}
+    for path, leaf in leaves_with_paths:
+        k = _path_str(path)
+        if k in out:
+            raise ValueError(f"duplicate parameter key {k!r}")
+        out[k] = leaf
+    return out, treedef
+
+
+def unflatten(treedef, kv: Dict[str, Any], key_order: List[str]) -> Any:
+    """Rebuild the pytree from a key dict using the original flatten order."""
+    return jax.tree_util.tree_unflatten(treedef, [kv[k] for k in key_order])
+
+
+def shard_for_key(key: str, num_shards: int) -> int:
+    """Deterministic key→server assignment (hash partition).
+
+    The reference family range-partitions integer keys across servers; with
+    string keys a stable hash gives the same load-spreading property. On the
+    mesh backend, key→server becomes ``NamedSharding`` over tensor dimensions
+    instead — this function exists for PS-semantic introspection (which mesh
+    shard "owns" a key) and for tests of the assignment's stability.
+    """
+    return zlib.crc32(key.encode()) % num_shards

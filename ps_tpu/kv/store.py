@@ -1,0 +1,165 @@
+"""KVStore — the user-facing worker API (push/pull over parameter keys).
+
+Mirrors the reference's ``KVWorker::Push/Pull`` surface (SURVEY.md §3 rows
+2-3) on top of whichever backend :func:`ps_tpu.init` selected:
+
+- local backend: calls go straight to an in-process :class:`LocalServer`.
+- tpu backend: the whole protocol compiles into one fused XLA step —
+  push = staging (or reduce-scatter), apply = sharded optax update,
+  pull = (all-gather of) the post-apply parameters.
+
+Byte counters for every push/pull feed the "push/pull GB/s" metric the
+reference reports (BASELINE.json metric line).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Union
+
+import jax
+import numpy as np
+import optax
+
+from ps_tpu.api import current_context
+from ps_tpu.kv import keys as keymod
+from ps_tpu.optim import make_optimizer
+
+
+def _nbytes(x) -> int:
+    return int(np.prod(x.shape)) * np.dtype(x.dtype).itemsize if hasattr(x, "shape") else 0
+
+
+class KVStore:
+    """A named parameter store with PS push/pull semantics.
+
+    Args:
+      optimizer: name ('sgd'|'momentum'|'adam'|'lamb') or optax transformation
+        — the *server-side* update rule.
+      mode: 'sync' | 'async' | None (inherit from Config).
+      aggregate: 'mean' (data-parallel pmean semantics, default) or 'sum'.
+      placement: tpu backend only — 'replicated' (pure DP: psum grads, every
+        device applies the full update) or 'sharded' (PS-faithful: parameters
+        and optimizer state partitioned over the mesh's data axis, grads
+        reduce-scattered to their owner shard, pulls all-gather — the TPU
+        equivalent of key→server sharding, ZeRO-1 style).
+      **opt_kwargs: forwarded to the named optimizer factory (e.g. learning_rate).
+    """
+
+    def __init__(
+        self,
+        optimizer: Union[str, optax.GradientTransformation] = "sgd",
+        mode: Optional[str] = None,
+        aggregate: str = "mean",
+        placement: str = "replicated",
+        **opt_kwargs,
+    ):
+        ctx = current_context()
+        self._ctx = ctx
+        self._opt = make_optimizer(optimizer, **opt_kwargs)
+        if placement not in ("replicated", "sharded"):
+            raise ValueError("placement must be 'replicated' or 'sharded'")
+        self.placement = placement
+        if ctx.config.backend == "local":
+            self._engine = ctx.backend.create_server(self._opt, mode=mode, aggregate=aggregate)
+        else:
+            self._engine = ctx.backend.create_server(
+                self._opt, mode=mode, aggregate=aggregate, placement=placement
+            )
+        self._treedef = None
+        self._key_order: List[str] = []
+        self.bytes_pushed = 0
+        self.bytes_pulled = 0
+        self.step = 0
+
+    # -- registration -------------------------------------------------------
+
+    def init(self, params: Any) -> Any:
+        """Register a parameter pytree with the server; returns the params as
+        the server placed them (device-put/sharded for the tpu backend)."""
+        if self._treedef is not None:
+            raise RuntimeError("KVStore.init already called")
+        kv, treedef = keymod.flatten_with_keys(params)
+        self._treedef = treedef
+        self._key_order = list(kv)
+        if hasattr(self._engine, "register_tree"):
+            return self._engine.register_tree(kv, treedef, self._key_order)
+        for k, v in kv.items():
+            self._engine.register(k, v)
+        return self.params()
+
+    def keys(self) -> List[str]:
+        return list(self._key_order)
+
+    # -- per-key protocol ---------------------------------------------------
+
+    def push(self, key: str, grad: jax.Array, worker: int = 0) -> None:
+        """Send a gradient for one key to its server (stages or applies,
+        depending on mode/backend)."""
+        self.bytes_pushed += _nbytes(grad)
+        self._engine.push(key, grad, worker=worker)
+
+    def pull(self, key: str, worker: int = 0) -> jax.Array:
+        """Fetch the current (post-apply) value of one key."""
+        val = self._engine.pull(key, worker=worker)
+        self.bytes_pulled += _nbytes(val)
+        return val
+
+    # -- whole-tree protocol ------------------------------------------------
+
+    def _require_init(self) -> None:
+        if self._treedef is None:
+            raise RuntimeError("KVStore.init(params) must be called first")
+
+    def push_all(self, grads: Any, worker: int = 0) -> None:
+        """Push every key of a gradient pytree (structure must match init)."""
+        self._require_init()
+        kv, _ = keymod.flatten_with_keys(grads)
+        if set(kv) != set(self._key_order):
+            raise ValueError("gradient pytree structure does not match registered params")
+        for k in self._key_order:
+            self.push(k, kv[k], worker=worker)
+
+    def pull_all(self, worker: int = 0) -> Any:
+        """Pull every key and rebuild the parameter pytree."""
+        self._require_init()
+        kv = {k: self.pull(k, worker=worker) for k in self._key_order}
+        return keymod.unflatten(self._treedef, kv, self._key_order)
+
+    def push_pull(self, grads: Any, worker: int = 0) -> Any:
+        """Fused push+apply+pull for a whole gradient pytree.
+
+        On the tpu backend this is ONE jitted SPMD step (collective + sharded
+        apply); on the local backend it is the per-key protocol in a loop.
+        With multiple logical workers, the sync barrier fires on the last
+        worker's push — earlier workers' pulls would block, so call
+        ``push_all`` for them and ``pull_all`` after the last push.
+        """
+        self._require_init()
+        if hasattr(self._engine, "update_tree"):
+            kv, _ = keymod.flatten_with_keys(grads)
+            if set(kv) != set(self._key_order):
+                raise ValueError("gradient pytree structure does not match registered params")
+            nbytes = sum(_nbytes(v) for v in kv.values())
+            self.bytes_pushed += nbytes
+            self.bytes_pulled += nbytes
+            out = self._engine.update_tree(kv)
+            self.step += 1
+            return keymod.unflatten(self._treedef, out, self._key_order)
+        self.push_all(grads, worker=worker)
+        self.step += 1
+        return self.pull_all(worker=worker)
+
+    # -- introspection ------------------------------------------------------
+
+    def params(self) -> Any:
+        """Current server-side parameter pytree (pull without byte accounting)."""
+        self._require_init()
+        kv = {k: self._engine.pull(k, worker=0) for k in self._key_order}
+        return keymod.unflatten(self._treedef, kv, self._key_order)
+
+    def optimizer_state(self, key: str):
+        return self._engine.optimizer_state(key)
+
+    @property
+    def num_workers(self) -> int:
+        return self._engine.num_workers

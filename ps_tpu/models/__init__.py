@@ -1,0 +1,4 @@
+"""Model zoo for the reference's trainer configs (BASELINE.json).
+
+Implemented: MNIST MLP (`mlp`). Planned per SURVEY.md §8: ResNet-50 (P2),
+BERT-base MLM (P3), Wide-&-Deep (P4)."""

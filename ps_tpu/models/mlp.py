@@ -1,0 +1,31 @@
+"""2-layer MLP — the reference's MNIST smoke-test model (BASELINE.json
+config 1: "dense push/pull: 2-layer MLP on MNIST").
+"""
+
+from __future__ import annotations
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    """784 → hidden → 10 classifier."""
+
+    hidden: int = 256
+    num_classes: int = 10
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(self.hidden, name="dense1")(x)
+        x = nn.relu(x)
+        x = nn.Dense(self.num_classes, name="dense2")(x)
+        return x
+
+
+def cross_entropy_loss(logits, labels):
+    """Mean softmax cross-entropy with integer labels."""
+    logp = jnp.take_along_axis(
+        nn.log_softmax(logits), labels[:, None], axis=-1
+    ).squeeze(-1)
+    return -logp.mean()

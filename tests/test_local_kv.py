@@ -1,0 +1,121 @@
+"""Local backend: push/pull protocol semantics (reference config 1 seam)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import ps_tpu as ps
+
+
+def make_store(**kw):
+    store = ps.KVStore(optimizer="sgd", learning_rate=0.5, **kw)
+    params = {"w": jnp.ones((4,)), "b": jnp.zeros((2, 2))}
+    store.init(params)
+    return store
+
+
+def test_init_registers_keys():
+    ps.init(backend="local")
+    store = make_store()
+    assert sorted(store.keys()) == ["b", "w"]
+
+
+def test_push_pull_applies_sgd():
+    ps.init(backend="local")
+    store = make_store()
+    store.push("w", jnp.full((4,), 2.0))
+    out = store.pull("w")
+    np.testing.assert_allclose(np.asarray(out), np.zeros(4))  # 1 - 0.5*2
+
+
+def test_pull_without_push_returns_current():
+    ps.init(backend="local")
+    store = make_store()
+    np.testing.assert_allclose(np.asarray(store.pull("w")), np.ones(4))
+
+
+def test_unregistered_key_raises():
+    ps.init(backend="local")
+    store = make_store()
+    with pytest.raises(KeyError):
+        store.push("nope", jnp.zeros(1))
+    with pytest.raises(KeyError):
+        store.pull("nope")
+
+
+def test_sync_aggregation_waits_for_all_workers():
+    ps.init(backend="local", num_workers=2)
+    store = make_store()
+    store.push("w", jnp.full((4,), 1.0), worker=0)
+    # half-aggregated pull must not silently return stale values
+    with pytest.raises(RuntimeError, match="would block"):
+        store.pull("w")
+    store.push("w", jnp.full((4,), 3.0), worker=1)
+    # mean aggregation: grad = 2.0 -> w = 1 - 0.5*2 = 0
+    np.testing.assert_allclose(np.asarray(store.pull("w")), np.zeros(4))
+
+
+def test_double_push_same_worker_raises():
+    ps.init(backend="local", num_workers=2)
+    store = make_store()
+    store.push("w", jnp.ones(4), worker=0)
+    with pytest.raises(RuntimeError, match="twice"):
+        store.push("w", jnp.ones(4), worker=0)
+
+
+def test_sum_aggregation():
+    ps.init(backend="local", num_workers=2)
+    store = ps.KVStore(optimizer="sgd", learning_rate=1.0, aggregate="sum")
+    store.init({"w": jnp.zeros(3)})
+    store.push("w", jnp.ones(3), worker=0)
+    store.push("w", jnp.ones(3), worker=1)
+    np.testing.assert_allclose(np.asarray(store.pull("w")), -2.0 * np.ones(3))
+
+
+def test_push_pull_fused_tree():
+    ps.init(backend="local")
+    store = make_store()
+    grads = {"w": jnp.ones((4,)), "b": jnp.ones((2, 2))}
+    params = store.push_pull(grads)
+    np.testing.assert_allclose(np.asarray(params["w"]), 0.5 * np.ones(4))
+    np.testing.assert_allclose(np.asarray(params["b"]), -0.5 * np.ones((2, 2)))
+    assert store.step == 1
+
+
+def test_mismatched_tree_raises():
+    ps.init(backend="local")
+    store = make_store()
+    with pytest.raises(ValueError, match="structure"):
+        store.push_all({"w": jnp.ones(4)})
+
+
+def test_byte_accounting():
+    ps.init(backend="local")
+    store = make_store()
+    store.push("w", jnp.ones(4, jnp.float32))
+    store.pull("w")
+    assert store.bytes_pushed == 16
+    assert store.bytes_pulled == 16
+
+
+def test_init_twice_raises():
+    ps.init(backend="local")
+    with pytest.raises(RuntimeError, match="already initialized"):
+        ps.init(backend="local")
+
+
+def test_requires_init():
+    with pytest.raises(RuntimeError, match="not initialized"):
+        ps.KVStore()
+
+
+def test_nested_pytree_keys():
+    ps.init(backend="local")
+    store = ps.KVStore(optimizer="sgd", learning_rate=1.0)
+    params = {"layer1": {"kernel": jnp.ones((2, 3)), "bias": jnp.zeros(3)},
+              "layer2": {"kernel": jnp.ones((3, 1))}}
+    store.init(params)
+    assert sorted(store.keys()) == ["layer1/bias", "layer1/kernel", "layer2/kernel"]
+    out = store.params()
+    assert jax.tree_util.tree_structure(out) == jax.tree_util.tree_structure(params)

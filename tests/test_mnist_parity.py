@@ -1,0 +1,97 @@
+"""Loss parity: MNIST MLP trained through the local PS must match a plain
+optax loop bit-for-bit in fp32 on CPU (the [VERIFIED] "loss parity" metric,
+SURVEY.md §5)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import ps_tpu as ps
+from ps_tpu.data.synthetic import mnist_batches
+from ps_tpu.models.mlp import MLP, cross_entropy_loss
+from ps_tpu.optim import make_optimizer
+
+
+def _setup(seed=0):
+    model = MLP(hidden=32)
+    params = model.init(jax.random.key(seed), jnp.zeros((1, 28, 28, 1)))["params"]
+
+    @jax.jit
+    def grad_fn(params, images, labels):
+        def loss_fn(p):
+            return cross_entropy_loss(model.apply({"params": p}, images), labels)
+        return jax.value_and_grad(loss_fn)(params)
+
+    return model, params, grad_fn
+
+
+def test_ps_matches_plain_optax_single_worker():
+    model, params0, grad_fn = _setup()
+    steps, bs = 10, 32
+
+    # --- PS loop
+    ps.init(backend="local")
+    store = ps.KVStore(optimizer="sgd", learning_rate=0.1)
+    store.init(params0)
+    ps_losses = []
+    params = store.pull_all()
+    for images, labels in mnist_batches(bs, steps=steps):
+        loss, grads = grad_fn(params, jnp.asarray(images), jnp.asarray(labels))
+        ps_losses.append(float(loss))
+        params = store.push_pull(grads)
+    ps.shutdown()
+
+    # --- plain optax loop, identical data
+    opt = make_optimizer("sgd", learning_rate=0.1)
+    opt_state = opt.init(params0)
+    params = params0
+    ref_losses = []
+    for images, labels in mnist_batches(bs, steps=steps):
+        loss, grads = grad_fn(params, jnp.asarray(images), jnp.asarray(labels))
+        ref_losses.append(float(loss))
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+
+    np.testing.assert_array_equal(np.array(ps_losses), np.array(ref_losses))
+    assert ps_losses[-1] < ps_losses[0], "model did not learn"
+
+
+def test_two_worker_sync_equals_big_batch():
+    """2 sync workers with batch B each ≡ 1 worker with the concatenated 2B
+    batch (mean aggregation = data-parallel semantics)."""
+    model, params0, grad_fn = _setup()
+    steps, bs = 6, 16
+
+    # two workers, each its own shard
+    ps.init(backend="local", num_workers=2)
+    store = ps.KVStore(optimizer="sgd", learning_rate=0.1)
+    store.init(params0)
+    s0 = mnist_batches(bs, steps=steps, worker=0, num_workers=2)
+    s1 = mnist_batches(bs, steps=steps, worker=1, num_workers=2)
+    params = store.pull_all()
+    batches = []
+    for (im0, lb0), (im1, lb1) in zip(s0, s1):
+        batches.append((im0, lb0, im1, lb1))
+        _, g0 = grad_fn(params, jnp.asarray(im0), jnp.asarray(lb0))
+        _, g1 = grad_fn(params, jnp.asarray(im1), jnp.asarray(lb1))
+        store.push_all(g0, worker=0)
+        store.push_all(g1, worker=1)
+        params = store.pull_all()
+    two_worker_params = params
+    ps.shutdown()
+
+    # single worker on the concatenated batch
+    opt = make_optimizer("sgd", learning_rate=0.1)
+    opt_state = opt.init(params0)
+    params = params0
+    for im0, lb0, im1, lb1 in batches:
+        images = jnp.concatenate([jnp.asarray(im0), jnp.asarray(im1)])
+        labels = jnp.concatenate([jnp.asarray(lb0), jnp.asarray(lb1)])
+        _, grads = grad_fn(params, images, labels)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+
+    for a, b in zip(jax.tree_util.tree_leaves(two_worker_params),
+                    jax.tree_util.tree_leaves(params)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)

@@ -1,0 +1,86 @@
+"""Server optimizers vs hand-computed references (SURVEY.md §5: "each
+optimizer vs a NumPy/optax reference")."""
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import ps_tpu as ps
+from ps_tpu.optim import make_optimizer
+
+
+def run_steps(opt_name, steps=5, **kw):
+    """Run the same gradient sequence through the local PS and through a
+    plain optax loop; return both parameter trajectories."""
+    ps.init(backend="local")
+    store = ps.KVStore(optimizer=opt_name, **kw)
+    w0 = jnp.array([1.0, -2.0, 3.0])
+    store.init({"w": w0})
+
+    opt = make_optimizer(opt_name, **kw)
+    ref_w = w0
+    ref_state = opt.init(ref_w)
+
+    ps_traj, ref_traj = [], []
+    for i in range(steps):
+        g = jnp.array([0.1 * (i + 1), -0.2, 0.3 * (i % 2)])
+        store.push("w", g)
+        ps_traj.append(np.asarray(store.pull("w")))
+        updates, ref_state = opt.update(g, ref_state, ref_w)
+        ref_w = optax.apply_updates(ref_w, updates)
+        ref_traj.append(np.asarray(ref_w))
+    return ps_traj, ref_traj
+
+
+@pytest.mark.parametrize("opt_name,kw", [
+    ("sgd", {"learning_rate": 0.1}),
+    ("momentum", {"learning_rate": 0.1, "momentum": 0.9}),
+    ("adam", {"learning_rate": 0.01}),
+    ("lamb", {"learning_rate": 0.01, "weight_decay": 0.01}),
+])
+def test_server_apply_matches_optax(opt_name, kw):
+    ps_traj, ref_traj = run_steps(opt_name, **kw)
+    for a, b in zip(ps_traj, ref_traj):
+        np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7)
+
+
+def test_sgd_exact_math():
+    ps.init(backend="local")
+    store = ps.KVStore(optimizer="sgd", learning_rate=0.5)
+    store.init({"w": jnp.array([10.0])})
+    store.push("w", jnp.array([4.0]))
+    np.testing.assert_allclose(np.asarray(store.pull("w")), [8.0])
+
+
+def test_custom_optax_transformation():
+    ps.init(backend="local")
+    store = ps.KVStore(optimizer=optax.adamw(1e-2, weight_decay=0.1))
+    store.init({"w": jnp.ones(2)})
+    store.push("w", jnp.ones(2))
+    out = np.asarray(store.pull("w"))
+    assert np.all(out < 1.0)
+
+
+def test_unknown_name_raises():
+    ps.init(backend="local")
+    with pytest.raises(ValueError, match="unknown optimizer"):
+        ps.KVStore(optimizer="adagrad9000")
+
+
+def test_per_key_state_is_independent():
+    """Adam state (incl. step count) is tracked per key, like the reference
+    server's per-key state tables."""
+    ps.init(backend="local")
+    store = ps.KVStore(optimizer="adam", learning_rate=0.1)
+    store.init({"a": jnp.zeros(2), "b": jnp.zeros(2)})
+    for _ in range(3):
+        store.push("a", jnp.ones(2))
+        store.pull("a")
+    store.push("b", jnp.ones(2))
+    # 'b' has seen one update; its Adam moments differ from 'a's
+    state_a = store.optimizer_state("a")
+    state_b = store.optimizer_state("b")
+    count_a = np.asarray(state_a[0].count)
+    count_b = np.asarray(state_b[0].count)
+    assert count_a == 3 and count_b == 1
