@@ -1,19 +1,216 @@
-"""SPMD mesh backend — lands with P1 (SURVEY.md §8).
+"""SPMD mesh backend — the TPU-native parameter server.
 
-Will provide: jax.distributed init (multi-host rendezvous), Mesh construction,
-and a sharded server whose push/apply/pull is one fused jitted step
-('replicated' = psum DP; 'sharded' = reduce-scatter/apply/all-gather,
-the TPU equivalent of key→server sharding).
+This is the north-star translation (BASELINE.json): the reference's
+intra-node NCCL reduce + cross-node ZMQ push/pull + C++ server apply collapse
+into one jitted XLA program over a device mesh:
+
+- push      = gradient reduction (psum, inserted by XLA; reduce-scatter when
+              parameters are sharded)
+- server    = the mesh's data axis; each device owns a shard of the
+              parameter + optimizer-state pytree ('sharded' placement) or a
+              full replica ('replicated')
+- apply     = optax update on the (sharded) pytree, compiled to TPU
+- pull      = the post-apply parameters (all-gather on demand when sharded)
+
+Multi-host: ``Config.coordinator_uri`` triggers ``jax.distributed.initialize``
+— XLA's coordination service is the scheduler/rendezvous equivalent
+(SURVEY.md §3 row 10).
+
+Worker identity: in SPMD there is one controller; the 'worker' argument of
+the per-key API is accepted for source compatibility and ignored — the worker
+set IS the data axis, and per-worker gradients exist only inside the fused
+step (before the automatic reduction).
 """
 
 from __future__ import annotations
 
+from typing import Any, Dict, List, Optional
+
+import jax
+import numpy as np
+import optax
+
 from ps_tpu.config import Config
+from ps_tpu.parallel import collectives
+from ps_tpu.parallel.mesh import DATA_AXIS, make_mesh
+from ps_tpu.parallel.sharding import batch_sharding, param_sharding
+
+
+class TpuServer:
+    """Mesh-sharded parameter/optimizer-state store with PS semantics.
+
+    Holds the parameter dict ``{key: jax.Array}`` placed per the placement
+    policy, plus ONE whole-tree optax state (numerically identical to the
+    local backend's per-key states for per-tensor optimizers; asserted by the
+    parity tests).
+    """
+
+    def __init__(self, optimizer: optax.GradientTransformation, mesh,
+                 placement: str = "replicated", aggregate: str = "mean",
+                 mode: str = "sync"):
+        if mode == "async":
+            raise NotImplementedError(
+                "async mode on the tpu backend is host-driven and lands with "
+                "P5 (SURVEY.md §8); use mode='sync' or backend='local'"
+            )
+        if aggregate != "mean":
+            raise NotImplementedError(
+                "the tpu backend has data-parallel mean semantics; for sum "
+                "semantics, sum (not mean) your loss over the global batch"
+            )
+        self._opt = optimizer
+        self.mesh = mesh
+        self.placement = placement
+        self.aggregate = aggregate
+        self.mode = mode
+        self.num_workers = mesh.shape[DATA_AXIS]
+        self._params: Dict[str, jax.Array] = {}
+        self._state = None
+        self._shardings: Dict[str, Any] = {}
+        self._staged: Dict[str, Any] = {}
+        # analytic ICI traffic (bytes per device) accumulated across updates
+        self.collective_bytes = 0
+        self._apply_fn = None
+        self.apply_count = 0
+
+    # -- registration -------------------------------------------------------
+
+    def register_tree(self, kv: Dict[str, Any], treedef, key_order: List[str]):
+        if self._params:
+            raise RuntimeError("server already holds a registered tree")
+        self._shardings = {
+            k: param_sharding(self.mesh, v, self.placement) for k, v in kv.items()
+        }
+        # np.asarray forces a fresh device buffer: device_put of an array that
+        # already matches the sharding would alias the caller's buffer, and
+        # the fused step donates (frees) server buffers every update.
+        self._params = {
+            k: jax.device_put(np.asarray(v), self._shardings[k])
+            for k, v in kv.items()
+        }
+        # whole-tree state; sharding propagates from the sharded params
+        self._state = jax.jit(self._opt.init)(self._params)
+
+        # No donation here: this apply backs the per-key/push_pull
+        # compatibility path, whose callers may legitimately hold pulled
+        # arrays across steps. The fused make_step path owns its buffers
+        # exclusively and donates there instead (2x transient memory here is
+        # the price of the compatibility semantics).
+        @jax.jit
+        def apply_fn(params, state, grads):
+            updates, new_state = self._opt.update(grads, state, params)
+            return optax.apply_updates(params, updates), new_state
+
+        self._apply_fn = apply_fn
+        from ps_tpu.kv import keys as keymod
+
+        return keymod.unflatten(treedef, self._params, key_order)
+
+    def keys(self):
+        return list(self._params)
+
+    # -- fused whole-tree update -------------------------------------------
+
+    def update_tree(self, grads_kv: Dict[str, Any]) -> Dict[str, Any]:
+        """One server step: aggregate(implicit) + apply; returns new params.
+
+        Gradients are expected to be *global* gradients (mean over the global
+        batch — XLA already reduced them inside the caller's jitted grad
+        computation, which is where the reference's NCCL+ZMQ push lived).
+        """
+        self._params, self._state = self._apply_fn(self._params, self._state, grads_kv)
+        self.apply_count += 1
+        self._account_update()
+        return dict(self._params)
+
+    def _account_update(self):
+        k = self.num_workers
+        if self.placement == "replicated":
+            # grads were all-reduced across the data axis
+            self.collective_bytes += collectives.allreduce_bytes(self._params, k)
+        else:
+            # reduce-scatter grads to owners + all-gather params for next fwd
+            self.collective_bytes += collectives.reduce_scatter_bytes(self._params, k)
+            self.collective_bytes += collectives.all_gather_bytes(self._params, k)
+
+    # -- per-key protocol (stages, flushes at full-tree granularity) --------
+
+    def push(self, key: str, grad: Any, worker: int = 0) -> None:
+        del worker  # SPMD single-controller: the worker set is the data axis
+        if key not in self._params:
+            raise KeyError(f"unregistered key {key!r}")
+        if key in self._staged:
+            raise RuntimeError(f"key {key!r} already staged this step")
+        self._staged[key] = grad
+        if len(self._staged) == len(self._params):
+            staged, self._staged = self._staged, {}
+            self.update_tree(staged)
+
+    def pull(self, key: str, worker: int = 0) -> jax.Array:
+        del worker
+        if key not in self._params:
+            raise KeyError(f"unregistered key {key!r}")
+        if self._staged:
+            missing = sorted(set(self._params) - set(self._staged))
+            shown = ", ".join(missing[:3]) + (", ..." if len(missing) > 3 else "")
+            raise RuntimeError(
+                f"pull({key!r}) would block: the tpu backend applies at "
+                f"full-tree granularity and keys [{shown}] have not been "
+                f"pushed this step"
+            )
+        return self._params[key]
+
+    def optimizer_state(self, key: str):
+        """Per-key view into the whole-tree state (PS-API compatibility)."""
+        return jax.tree_util.tree_map(
+            lambda leaf: leaf[key] if isinstance(leaf, dict) and key in leaf else leaf,
+            self._state,
+            is_leaf=lambda x: isinstance(x, dict) and key in x,
+        )
+
+    # -- internals for the fused train step ---------------------------------
+
+    def get_tree_and_state(self):
+        return dict(self._params), self._state
+
+    def set_tree_and_state(self, params, state):
+        self._params, self._state = dict(params), state
+        self.apply_count += 1
+        self._account_update()
 
 
 class TpuBackend:
+    """Backend for ``ps_tpu.init(backend='tpu')``. Despite the name it runs
+    anywhere JAX has devices — on CPU it uses virtual devices (tests), on a
+    TPU slice it uses the real chips over ICI."""
+
     def __init__(self, config: Config):
-        raise NotImplementedError(
-            "backend='tpu' is not implemented yet (P1 in SURVEY.md §8); "
-            "use backend='local' meanwhile"
+        self.config = config
+        self._owns_distributed = False
+        if config.coordinator_uri is not None:
+            jax.distributed.initialize(
+                coordinator_address=config.coordinator_uri,
+                num_processes=config.num_processes,
+                process_id=config.process_id,
+            )
+            self._owns_distributed = True
+        self.mesh = make_mesh(config.mesh_shape)
+        self.num_workers = self.mesh.shape.get(DATA_AXIS, 1)
+
+    def create_server(self, optimizer, mode: Optional[str] = None,
+                      aggregate: str = "mean", placement: str = "replicated") -> TpuServer:
+        return TpuServer(
+            optimizer,
+            self.mesh,
+            placement=placement,
+            aggregate=aggregate,
+            mode=mode or self.config.mode,
         )
+
+    def batch_sharding(self):
+        return batch_sharding(self.mesh)
+
+    def shutdown(self) -> None:
+        if self._owns_distributed:
+            jax.distributed.shutdown()
+            self._owns_distributed = False

@@ -14,6 +14,7 @@ reference reports (BASELINE.json metric line).
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Dict, List, Optional, Union
 
 import jax
@@ -148,6 +149,74 @@ class KVStore:
         self.push_all(grads, worker=worker)
         self.step += 1
         return self.pull_all(worker=worker)
+
+    # -- fused train step ---------------------------------------------------
+
+    def make_step(self, loss_fn):
+        """Build a train-step callable ``run(batch) -> (loss, params)``.
+
+        ``loss_fn(params, batch)`` must return a scalar loss, meaned over the
+        *global* batch. On the tpu backend the whole PS protocol — gradient,
+        aggregation collective, server apply, pull — compiles into ONE donated
+        XLA program (the north-star fusion); on the local backend it runs the
+        explicit per-key protocol.
+
+        Donation note (tpu): each step donates the previous parameter and
+        optimizer-state buffers. References obtained from earlier
+        ``pull``/``params()`` calls become invalid once the step runs; use
+        the params returned by ``run``.
+        """
+        self._require_init()
+        engine = self._engine
+        treedef, key_order = self._treedef, self._key_order
+
+        if not hasattr(engine, "get_tree_and_state"):
+            if engine.num_workers != 1:
+                raise NotImplementedError(
+                    "make_step on the local backend drives a single logical "
+                    "worker; with num_workers > 1 use push_all/pull_all per "
+                    "worker (see examples/train_mnist_mlp.py)"
+                )
+            grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+
+            def run_local(batch):
+                params = self.params()
+                loss, grads = grad_fn(params, batch)
+                return loss, self.push_pull(grads)
+
+            return run_local
+
+        opt = self._opt
+
+        def kv_loss(params_kv, batch):
+            return loss_fn(keymod.unflatten(treedef, params_kv, key_order), batch)
+
+        @functools.partial(jax.jit, donate_argnums=(0, 1))
+        def fused(params_kv, state, batch):
+            loss, grads = jax.value_and_grad(kv_loss)(params_kv, batch)
+            updates, state = opt.update(grads, state, params_kv)
+            params_kv = optax.apply_updates(params_kv, updates)
+            return params_kv, state, loss
+
+        def run(batch):
+            params_kv, state = engine.get_tree_and_state()
+            params_kv, state, loss = fused(params_kv, state, batch)
+            engine.set_tree_and_state(params_kv, state)
+            nbytes = sum(_nbytes(v) for v in params_kv.values())
+            self.bytes_pushed += nbytes
+            self.bytes_pulled += nbytes
+            self.step += 1
+            return loss, keymod.unflatten(treedef, params_kv, key_order)
+
+        return run
+
+    def shard_batch(self, batch: Any) -> Any:
+        """Place a host batch on the mesh, sharded over the data axis
+        (identity on the local backend)."""
+        if self._ctx.mesh is None:
+            return batch
+        sharding = self._ctx.backend.batch_sharding()
+        return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), batch)
 
     # -- introspection ------------------------------------------------------
 

@@ -1,0 +1,2 @@
+"""Mesh, sharding, and collective utilities — the TPU replacement for the
+reference's NCCL reduce + ZMQ transport (SURVEY.md §3 rows 8-9)."""

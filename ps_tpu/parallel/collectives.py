@@ -1,0 +1,62 @@
+"""Collective-traffic algebra for the "push/pull GB/s over ICI" metric.
+
+The reference counts bytes moved by its ZMQ push/pull sockets. On TPU the
+same traffic rides XLA collectives over ICI, which the profiler can see but
+user code cannot count directly — so we account analytically from standard
+ring-algorithm costs (bytes sent per device for a tensor of N bytes over a
+k-device axis):
+
+- all-reduce (psum):        2 * N * (k-1) / k
+- reduce-scatter:               N * (k-1) / k
+- all-gather:                   N * (k-1) / k
+- all-to-all:                   N * (k-1) / k
+
+These are the textbook bandwidth-optimal figures (see e.g. the public
+"How to Scale Your Model" treatment of TPU collectives). They can be
+cross-checked against ``jax.profiler`` ICI counters on real hardware.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import numpy as np
+
+
+def _tree_bytes(tree: Any) -> int:
+    total = 0
+    for leaf in jax.tree_util.tree_leaves(tree):
+        total += int(np.prod(leaf.shape)) * np.dtype(leaf.dtype).itemsize
+    return total
+
+
+def allreduce_bytes(tree: Any, axis_size: int) -> int:
+    """Per-device ICI bytes for a psum of this pytree over axis_size devices."""
+    if axis_size <= 1:
+        return 0
+    n = _tree_bytes(tree)
+    return int(2 * n * (axis_size - 1) / axis_size)
+
+
+def reduce_scatter_bytes(tree: Any, axis_size: int) -> int:
+    if axis_size <= 1:
+        return 0
+    return int(_tree_bytes(tree) * (axis_size - 1) / axis_size)
+
+
+def all_gather_bytes(tree: Any, axis_size: int) -> int:
+    if axis_size <= 1:
+        return 0
+    return int(_tree_bytes(tree) * (axis_size - 1) / axis_size)
+
+
+def all_to_all_bytes(tree: Any, axis_size: int) -> int:
+    if axis_size <= 1:
+        return 0
+    return int(_tree_bytes(tree) * (axis_size - 1) / axis_size)
+
+
+def tree_bytes(tree: Any) -> int:
+    """Total payload bytes of a pytree (the PS-API 'push' or 'pull' size)."""
+    return _tree_bytes(tree)

@@ -1,0 +1,54 @@
+"""Parameter-placement policy: the TPU translation of key→server sharding.
+
+The reference range-partitions parameter keys across server processes
+(SURVEY.md §3 row 4). Here a parameter "lives on a server" by being sharded
+over the mesh's data axis; the optimizer state shards identically (state
+"next to" the param, as on a PS server). Tensors too small to split evenly
+stay replicated — the analogue of small keys living whole on one server,
+minus the load imbalance.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ps_tpu.parallel.mesh import DATA_AXIS
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def param_sharding(mesh: Mesh, leaf: Any, placement: str,
+                   axis: str = DATA_AXIS) -> NamedSharding:
+    """Choose a NamedSharding for one parameter tensor.
+
+    - 'replicated': every device holds the full tensor (pure data parallel;
+      grads psum, update computed everywhere — fastest for small models).
+    - 'sharded': split the largest dimension divisible by the axis size
+      (ZeRO-1-style; grads reduce-scatter to the owner shard, the update runs
+      shard-local, pulls all-gather). Falls back to replicated for tensors
+      with no evenly divisible dimension.
+    """
+    if placement == "replicated":
+        return replicated(mesh)
+    if placement != "sharded":
+        raise ValueError(f"unknown placement {placement!r}")
+    n = mesh.shape[axis]
+    ndim = getattr(leaf, "ndim", 0)
+    if ndim:
+        # prefer the largest dim; ties break toward the leading dim
+        order = sorted(range(ndim), key=lambda i: (-leaf.shape[i], i))
+        for i in order:
+            if leaf.shape[i] % n == 0 and leaf.shape[i] >= n:
+                spec = [None] * ndim
+                spec[i] = axis
+                return NamedSharding(mesh, P(*spec))
+    return replicated(mesh)
+
+
+def batch_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
+    """Shard the leading (batch) dimension over the data axis."""
+    return NamedSharding(mesh, P(axis))

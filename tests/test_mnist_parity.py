@@ -42,16 +42,22 @@ def test_ps_matches_plain_optax_single_worker():
         params = store.push_pull(grads)
     ps.shutdown()
 
-    # --- plain optax loop, identical data
+    # --- plain optax loop, identical data; apply jitted like the server's
+    # (eager optax rounds differently than the XLA-fused apply at ~1e-7)
     opt = make_optimizer("sgd", learning_rate=0.1)
     opt_state = opt.init(params0)
     params = params0
+
+    @jax.jit
+    def ref_apply(params, state, grads):
+        updates, state = opt.update(grads, state, params)
+        return optax.apply_updates(params, updates), state
+
     ref_losses = []
     for images, labels in mnist_batches(bs, steps=steps):
         loss, grads = grad_fn(params, jnp.asarray(images), jnp.asarray(labels))
         ref_losses.append(float(loss))
-        updates, opt_state = opt.update(grads, opt_state, params)
-        params = optax.apply_updates(params, updates)
+        params, opt_state = ref_apply(params, opt_state, grads)
 
     np.testing.assert_array_equal(np.array(ps_losses), np.array(ref_losses))
     assert ps_losses[-1] < ps_losses[0], "model did not learn"
