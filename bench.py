@@ -1,0 +1,91 @@
+"""Driver benchmark entrypoint — prints ONE JSON line.
+
+Headline metric (BASELINE.json): ResNet-50 images/sec/chip, sync data-parallel
+PS step (fused psum + sharded server apply) on whatever devices are visible —
+the real TPU chip under the driver, virtual/CPU devices elsewhere.
+
+``vs_baseline`` is null because the reference publishes no numbers
+(BASELINE.json ``"published": {}``; see BASELINE.md).
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+import ps_tpu as ps
+from ps_tpu.data.synthetic import imagenet_batches
+from ps_tpu.models.resnet import ResNet50, make_loss_fn
+from ps_tpu.parallel.sharding import replicated
+
+
+def main(steps: int = 12, per_chip_batch: int = 256, image_size: int = 224):
+    ndev = len(jax.devices())
+    on_tpu = jax.devices()[0].platform == "tpu"
+    if not on_tpu:
+        # keep CPU smoke runs tractable
+        per_chip_batch, image_size, steps = 8, 64, 4
+    batch_size = per_chip_batch * ndev
+
+    ctx = ps.init(backend="tpu")
+    model = ResNet50(dtype=jnp.bfloat16 if on_tpu else jnp.float32)
+    variables = model.init(
+        jax.random.key(0), jnp.zeros((2, image_size, image_size, 3)), train=False
+    )
+    params, model_state = variables["params"], variables["batch_stats"]
+    model_state = jax.device_put(model_state, replicated(ctx.mesh))
+
+    store = ps.KVStore(optimizer="momentum", learning_rate=0.1, momentum=0.9,
+                       placement="sharded" if ndev > 1 else "replicated")
+    store.init(params)
+
+    run = store.make_step(make_loss_fn(model, label_smoothing=0.1), has_aux=True)
+
+    # Pre-generate and pre-place a few distinct batches: the metric is the
+    # device step (fused psum + sharded apply), not host RNG / host->device
+    # transfer. Real input pipelines overlap those; see examples/ for the
+    # streaming form.
+    batches = [
+        store.shard_batch((jnp.asarray(images), jnp.asarray(labels)))
+        for images, labels in imagenet_batches(
+            batch_size, image_size=image_size, steps=min(steps, 3)
+        )
+    ]
+    jax.block_until_ready(batches)
+
+    # TWO warmup steps: step 0 compiles, step 1 recompiles once more when the
+    # donated outputs come back in the compiler-chosen TPU layouts; steady
+    # state begins at step 2.
+    warmup = 2
+    t0 = None
+    for step in range(steps + warmup):
+        loss, _, model_state = run(batches[step % len(batches)], model_state)
+        if step == warmup - 1:
+            loss.block_until_ready()  # exclude compile/layout warmup
+            t0 = time.time()
+    jax.block_until_ready(store.params())
+    dt = max(time.time() - t0, 1e-9)
+
+    imgs_per_sec_per_chip = steps * batch_size / dt / ndev
+    print(json.dumps({
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": round(imgs_per_sec_per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": None,
+        "detail": {
+            "devices": ndev,
+            "platform": jax.devices()[0].platform,
+            "global_batch": batch_size,
+            "image_size": image_size,
+            "timed_steps": steps,
+            "note": "reference published no numbers (BASELINE.json published={})",
+        },
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

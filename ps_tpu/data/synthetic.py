@@ -24,14 +24,24 @@ def mnist_batches(batch_size: int, *, seed: int = 0, steps: int = None,
     ``batch_size * num_workers`` stream — the property the data-parallel
     parity tests rely on.
 
-    The images are class-conditional Gaussian blobs so a linear model can
-    actually learn — loss curves decrease, which the parity tests rely on.
+    The images are class-conditional sinusoidal gratings (class-dependent
+    frequency/orientation) plus noise, so BOTH a linear model (per-pixel
+    pattern) and a convnet with global pooling (local texture statistics)
+    can actually learn — loss curves decrease, which the parity and
+    convergence tests rely on.
     """
     if not (0 <= worker < num_workers):
         raise ValueError(f"worker {worker} out of range [0, {num_workers})")
-    # one fixed prototype image per class
+    # one fixed grating prototype per class
     proto_rng = np.random.default_rng(seed)
-    protos = proto_rng.normal(0.5, 0.2, size=(10, 28, 28, 1)).astype(np.float32)
+    yy, xx = np.mgrid[0:28, 0:28]
+    freqs = proto_rng.uniform(1.5, 6.0, size=(10, 2))
+    phases = proto_rng.uniform(0, 2 * np.pi, size=10)
+    protos = 0.5 + 0.35 * np.sin(
+        2 * np.pi * (freqs[:, :1, None] * xx + freqs[:, 1:, None] * yy) / 28
+        + phases[:, None, None]
+    )
+    protos = protos[..., None].astype(np.float32)
     gb = batch_size * num_workers
     i = 0
     while steps is None or i < steps:

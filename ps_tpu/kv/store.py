@@ -152,13 +152,20 @@ class KVStore:
 
     # -- fused train step ---------------------------------------------------
 
-    def make_step(self, loss_fn):
-        """Build a train-step callable ``run(batch) -> (loss, params)``.
+    def make_step(self, loss_fn, has_aux: bool = False):
+        """Build a train-step callable.
 
-        ``loss_fn(params, batch)`` must return a scalar loss, meaned over the
-        *global* batch. On the tpu backend the whole PS protocol — gradient,
-        aggregation collective, server apply, pull — compiles into ONE donated
-        XLA program (the north-star fusion); on the local backend it runs the
+        ``loss_fn(params, batch, *extra)`` must return a scalar loss, meaned
+        over the *global* batch — or, with ``has_aux=True``, a ``(loss, aux)``
+        pair where ``aux`` is any pytree of auxiliary outputs (e.g. flax
+        mutable collections such as BatchNorm ``batch_stats``, or metrics).
+        ``run(batch, *extra) -> (loss, params)`` (or ``(loss, params, aux)``).
+        Extra positional args flow through to ``loss_fn`` untouched, so
+        non-optimized model state can thread through the step.
+
+        On the tpu backend the whole PS protocol — gradient, aggregation
+        collective, server apply, pull — compiles into ONE donated XLA
+        program (the north-star fusion); on the local backend it runs the
         explicit per-key protocol.
 
         Donation note (tpu): each step donates the previous parameter and
@@ -177,36 +184,50 @@ class KVStore:
                     "worker; with num_workers > 1 use push_all/pull_all per "
                     "worker (see examples/train_mnist_mlp.py)"
                 )
-            grad_fn = jax.jit(jax.value_and_grad(loss_fn))
+            grad_fn = jax.jit(jax.value_and_grad(loss_fn, has_aux=has_aux))
 
-            def run_local(batch):
+            def run_local(batch, *extra):
                 params = self.params()
-                loss, grads = grad_fn(params, batch)
+                if has_aux:
+                    (loss, aux), grads = grad_fn(params, batch, *extra)
+                    return loss, self.push_pull(grads), aux
+                loss, grads = grad_fn(params, batch, *extra)
                 return loss, self.push_pull(grads)
 
             return run_local
 
         opt = self._opt
 
-        def kv_loss(params_kv, batch):
-            return loss_fn(keymod.unflatten(treedef, params_kv, key_order), batch)
+        def kv_loss(params_kv, batch, *extra):
+            return loss_fn(
+                keymod.unflatten(treedef, params_kv, key_order), batch, *extra
+            )
 
         @functools.partial(jax.jit, donate_argnums=(0, 1))
-        def fused(params_kv, state, batch):
-            loss, grads = jax.value_and_grad(kv_loss)(params_kv, batch)
+        def fused(params_kv, state, batch, *extra):
+            if has_aux:
+                (loss, aux), grads = jax.value_and_grad(kv_loss, has_aux=True)(
+                    params_kv, batch, *extra
+                )
+            else:
+                loss, grads = jax.value_and_grad(kv_loss)(params_kv, batch, *extra)
+                aux = None
             updates, state = opt.update(grads, state, params_kv)
             params_kv = optax.apply_updates(params_kv, updates)
-            return params_kv, state, loss
+            return params_kv, state, loss, aux
 
-        def run(batch):
+        def run(batch, *extra):
             params_kv, state = engine.get_tree_and_state()
-            params_kv, state, loss = fused(params_kv, state, batch)
+            params_kv, state, loss, aux = fused(params_kv, state, batch, *extra)
             engine.set_tree_and_state(params_kv, state)
             nbytes = sum(_nbytes(v) for v in params_kv.values())
             self.bytes_pushed += nbytes
             self.bytes_pulled += nbytes
             self.step += 1
-            return loss, keymod.unflatten(treedef, params_kv, key_order)
+            params = keymod.unflatten(treedef, params_kv, key_order)
+            if has_aux:
+                return loss, params, aux
+            return loss, params
 
         return run
 
@@ -228,6 +249,13 @@ class KVStore:
 
     def optimizer_state(self, key: str):
         return self._engine.optimizer_state(key)
+
+    @property
+    def collective_bytes(self) -> int:
+        """Analytic per-device ICI bytes moved by the server's collectives so
+        far (the 'push/pull GB/s over ICI' numerator; 0 on the local backend,
+        which moves no inter-device traffic)."""
+        return getattr(self._engine, "collective_bytes", 0)
 
     @property
     def num_workers(self) -> int:

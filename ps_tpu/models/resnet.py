@@ -1,0 +1,170 @@
+"""ResNet — the reference's headline benchmark model (BASELINE.json config 2:
+"ResNet-50 / ImageNet (dense allreduce path, sync data-parallel)"; SURVEY.md
+§3 row 14). The reference was unreadable (SURVEY.md §0) so this is a standard
+ResNet-v1.5 written TPU-first:
+
+- bfloat16 compute / float32 params by default: convs and the final matmul
+  hit the MXU at full rate; BatchNorm statistics and the softmax/loss stay
+  in float32 for numerics.
+- BatchNorm under GSPMD jit: with the batch sharded over the 'data' mesh
+  axis, the batch-mean/variance reductions are *global* means — XLA inserts
+  the cross-device collectives, so this is synchronized BatchNorm with no
+  explicit axis_name plumbing (the TPU equivalent of the reference family's
+  per-GPU BN + NCCL allreduce of grads).
+- NHWC layout throughout (TPU-native conv layout).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BasicBlock(nn.Module):
+    """Two 3x3 convs — ResNet-18/34 block (used by tests as a small stand-in)."""
+
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+    strides: Tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), self.strides)(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters, (1, 1), self.strides, name="conv_proj")(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return self.act(residual + y)
+
+
+class BottleneckBlock(nn.Module):
+    """1x1 → 3x3 → 1x1 bottleneck — the ResNet-50/101/152 block (v1.5: the
+    stride lives on the 3x3, matching the variant every modern benchmark
+    reports)."""
+
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+    strides: Tuple[int, int] = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        # zero-init the last BN scale: residual branch starts as identity,
+        # the standard trick for large-batch ResNet convergence
+        y = self.norm(scale_init=nn.initializers.zeros_init())(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1), self.strides, name="conv_proj")(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return self.act(residual + y)
+
+
+class ResNet(nn.Module):
+    """Generic ResNet over NHWC inputs.
+
+    Attributes:
+      stage_sizes: blocks per stage, e.g. (3, 4, 6, 3) for ResNet-50.
+      block_cls: BasicBlock or BottleneckBlock.
+      num_classes: classifier width.
+      num_filters: stem width (64 for the standard family).
+      dtype: compute dtype (bfloat16 default — MXU-native).
+      small_inputs: replace the 7x7/stride-2 stem + maxpool with a 3x3/stride-1
+        stem for CIFAR-sized images (used by tests/tiny dry-runs).
+    """
+
+    stage_sizes: Sequence[int]
+    block_cls: ModuleDef
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: Any = jnp.bfloat16
+    small_inputs: bool = False
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = functools.partial(
+            nn.Conv, use_bias=False, dtype=self.dtype, param_dtype=jnp.float32,
+            padding="SAME",
+        )
+        norm = functools.partial(
+            nn.BatchNorm, use_running_average=not train, momentum=0.9,
+            epsilon=1e-5, dtype=jnp.float32, param_dtype=jnp.float32,
+        )
+        act = nn.relu
+
+        x = x.astype(self.dtype)
+        if self.small_inputs:
+            x = conv(self.num_filters, (3, 3), name="conv_init")(x)
+        else:
+            x = conv(self.num_filters, (7, 7), (2, 2), name="conv_init")(x)
+        x = norm(name="bn_init")(x)
+        x = act(x)
+        if not self.small_inputs:
+            x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, num_blocks in enumerate(self.stage_sizes):
+            for j in range(num_blocks):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block_cls(
+                    filters=self.num_filters * 2 ** i,
+                    conv=conv, norm=norm, act=act, strides=strides,
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=self.dtype, param_dtype=jnp.float32,
+                     name="head")(x)
+        return x.astype(jnp.float32)
+
+
+ResNet18 = functools.partial(ResNet, stage_sizes=(2, 2, 2, 2), block_cls=BasicBlock)
+ResNet34 = functools.partial(ResNet, stage_sizes=(3, 4, 6, 3), block_cls=BasicBlock)
+ResNet50 = functools.partial(ResNet, stage_sizes=(3, 4, 6, 3), block_cls=BottleneckBlock)
+ResNet101 = functools.partial(ResNet, stage_sizes=(3, 4, 23, 3), block_cls=BottleneckBlock)
+ResNet152 = functools.partial(ResNet, stage_sizes=(3, 8, 36, 3), block_cls=BottleneckBlock)
+
+
+def make_loss_fn(model, label_smoothing: float = 0.0):
+    """Standard PS-step loss closure for a BatchNorm model.
+
+    Returns ``loss_fn(params, batch, model_state) -> (loss, new_model_state)``
+    for use with ``KVStore.make_step(loss_fn, has_aux=True)``: the mutable
+    ``batch_stats`` collection threads through the fused step as aux state.
+    """
+
+    def loss_fn(params, batch, model_state):
+        images, labels = batch
+        logits, mutated = model.apply(
+            {"params": params, "batch_stats": model_state},
+            images, train=True, mutable=["batch_stats"],
+        )
+        loss = cross_entropy_loss(logits, labels, label_smoothing)
+        return loss, mutated["batch_stats"]
+
+    return loss_fn
+
+
+def cross_entropy_loss(logits, labels, label_smoothing: float = 0.0):
+    """Mean softmax cross-entropy over integer labels, float32 numerics."""
+    num_classes = logits.shape[-1]
+    onehot = jax.nn.one_hot(labels, num_classes, dtype=jnp.float32)
+    if label_smoothing:
+        onehot = onehot * (1.0 - label_smoothing) + label_smoothing / num_classes
+    logp = nn.log_softmax(logits.astype(jnp.float32))
+    return -(onehot * logp).sum(axis=-1).mean()

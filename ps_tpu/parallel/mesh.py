@@ -34,11 +34,15 @@ def make_mesh(mesh_shape: Optional[Dict[str, int]] = None,
         mesh_shape = {DATA_AXIS: len(devices)}
     names = tuple(mesh_shape)
     shape = tuple(int(s) for s in mesh_shape.values())
-    if math.prod(shape) != len(devices):
+    if any(s < 1 for s in shape):
+        raise ValueError(f"mesh axes must be >= 1, got {mesh_shape}")
+    needed = math.prod(shape)
+    if needed > len(devices):
         raise ValueError(
-            f"mesh shape {mesh_shape} needs {math.prod(shape)} devices, "
+            f"mesh shape {mesh_shape} needs {needed} devices, "
             f"have {len(devices)}"
         )
+    devices = list(devices)[:needed]  # explicit smaller meshes are allowed
     if devices[0].platform == "tpu":
         from jax.experimental import mesh_utils
 

@@ -1,0 +1,117 @@
+"""Throughput / bandwidth metrics.
+
+The reference's headline metric line (BASELINE.json): "ResNet-50
+images/sec/chip; push/pull GB/s over ICI; loss parity". The reference family
+counts bytes at its ZMQ sockets; here the KVStore counts payload bytes at the
+push/pull API boundary and the mesh server accounts analytic per-device ICI
+bytes from collective algebra (ps_tpu/parallel/collectives.py). This module
+turns those counters plus wall-clock into the reported rates.
+"""
+
+from __future__ import annotations
+
+import collections
+import time
+from typing import Deque, Dict, Optional
+
+
+class Meter:
+    """Sliding-window rate meter: ``update(n)`` per event, ``rate()`` in n/sec.
+
+    The window bounds both staleness and memory; the first sample anchors the
+    window so early rates are not inflated by an empty history.
+    """
+
+    def __init__(self, window: int = 64):
+        self._events: Deque = collections.deque(maxlen=window)
+
+    def update(self, n: float = 1.0, t: Optional[float] = None) -> None:
+        self._events.append((time.monotonic() if t is None else t, float(n)))
+
+    def rate(self) -> float:
+        if len(self._events) < 2:
+            return 0.0
+        dt = self._events[-1][0] - self._events[0][0]
+        if dt <= 0:
+            return 0.0
+        # the first sample opens the window; its count predates it
+        return sum(n for _, n in list(self._events)[1:]) / dt
+
+    def reset(self) -> None:
+        self._events.clear()
+
+
+class TrainMetrics:
+    """Aggregates one training run's metrics against a KVStore's counters.
+
+    Usage::
+
+        m = TrainMetrics(store, batch_size=global_batch, num_chips=ndev)
+        for batch in data:
+            loss, params = run(batch)
+            m.step(loss)
+        print(m.summary())
+
+    ``step()`` is cheap (no device sync); pass ``loss`` as a jax scalar and it
+    is only converted on ``summary()``/``log_every`` boundaries.
+    """
+
+    def __init__(self, store=None, batch_size: int = 0, num_chips: int = 1):
+        self.store = store
+        self.batch_size = batch_size
+        self.num_chips = max(num_chips, 1)
+        self.steps = 0
+        self.start = time.monotonic()
+        self._timed_from = self.start
+        self._last_loss = None
+        self._snapshot_bytes()
+
+    def _snapshot_bytes(self) -> None:
+        self._bytes_from = (
+            (self.store.bytes_pushed, self.store.bytes_pulled,
+             self.store.collective_bytes)
+            if self.store is not None else (0, 0, 0)
+        )
+
+    def mark_compiled(self) -> None:
+        """Call after the warmup step: resets the timed region so compile
+        time does not pollute throughput (the reference family similarly
+        excludes the first step from reported rates)."""
+        self._timed_from = time.monotonic()
+        self._snapshot_bytes()
+        self.steps = 0
+
+    def step(self, loss=None) -> None:
+        self.steps += 1
+        self._last_loss = loss
+
+    def summary(self) -> Dict[str, float]:
+        now = time.monotonic()
+        dt = max(now - self._timed_from, 1e-9)
+        out: Dict[str, float] = {
+            "steps": self.steps,
+            "wall_s": round(dt, 3),
+            "steps_per_sec": round(self.steps / dt, 3),
+        }
+        if self._last_loss is not None:
+            out["loss"] = float(self._last_loss)
+        if self.batch_size:
+            out["examples_per_sec"] = round(self.steps * self.batch_size / dt, 2)
+            out["examples_per_sec_per_chip"] = round(
+                self.steps * self.batch_size / dt / self.num_chips, 2
+            )
+        if self.store is not None:
+            p0, q0, c0 = self._bytes_from
+            out["push_gb"] = round((self.store.bytes_pushed - p0) / 1e9, 4)
+            out["pull_gb"] = round((self.store.bytes_pulled - q0) / 1e9, 4)
+            out["push_pull_gbps"] = round(
+                (self.store.bytes_pushed - p0 + self.store.bytes_pulled - q0)
+                / 1e9 / dt, 4
+            )
+            out["ici_gb_per_device"] = round(
+                (self.store.collective_bytes - c0) / 1e9, 4
+            )
+            out["ici_gbps_per_device"] = round(
+                (self.store.collective_bytes - c0) / 1e9 / dt, 4
+            )
+        return out

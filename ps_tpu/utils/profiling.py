@@ -1,0 +1,44 @@
+"""Profiling hooks — thin, dependency-free wrappers over jax.profiler.
+
+SURVEY.md §6 "Tracing/profiling": the TPU-native mechanism is
+``jax.profiler.trace`` (TensorBoard/Perfetto XPlane dumps, including ICI
+collective timelines on real TPUs) plus named annotations so PS phases
+(push/apply/pull) are findable in the trace. The analytic GB/s counters in
+ps_tpu/parallel/collectives.py can be cross-checked against the profiler's
+ICI utilization on hardware.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Iterator, Optional
+
+
+@contextlib.contextmanager
+def trace(log_dir: Optional[str]) -> Iterator[None]:
+    """Profile the enclosed block to ``log_dir`` (no-op when None).
+
+    View with TensorBoard's profile plugin or Perfetto.
+    """
+    if log_dir is None:
+        yield
+        return
+    import jax.profiler
+
+    with jax.profiler.trace(log_dir):
+        yield
+
+
+def annotate(name: str):
+    """Context manager naming the enclosed host region in profiler traces."""
+    import jax.profiler
+
+    return jax.profiler.TraceAnnotation(name)
+
+
+def start_server(port: int = 9999):
+    """Start the on-demand profiling server (connect with TensorBoard's
+    capture-profile button); returns the server object."""
+    import jax.profiler
+
+    return jax.profiler.start_server(port)

@@ -1,0 +1,115 @@
+"""ResNet model + sync-DP train-step tests (reference workload config 2).
+
+Parity strategy per SURVEY.md §5: the PS-mesh step (batch sharded over 8
+virtual devices, implicit psum, sharded server apply) must match a plain
+single-device optax step on the full global batch — including the BatchNorm
+batch statistics, which under GSPMD are *global*-batch statistics.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+
+import ps_tpu as ps
+from ps_tpu.data.synthetic import mnist_batches
+from ps_tpu.models.resnet import (
+    BasicBlock, BottleneckBlock, ResNet, ResNet50, make_loss_fn,
+)
+
+
+def tiny_resnet(**kw):
+    kw.setdefault("stage_sizes", (1, 1))
+    kw.setdefault("block_cls", BasicBlock)
+    kw.setdefault("num_filters", 8)
+    kw.setdefault("num_classes", 10)
+    kw.setdefault("dtype", jnp.float32)
+    kw.setdefault("small_inputs", True)
+    return ResNet(**kw)
+
+
+def test_forward_shape():
+    model = tiny_resnet()
+    variables = model.init(jax.random.key(0), jnp.zeros((2, 28, 28, 1)), train=False)
+    logits = model.apply(variables, jnp.zeros((4, 28, 28, 1)), train=False)
+    assert logits.shape == (4, 10)
+    assert logits.dtype == jnp.float32
+    assert "batch_stats" in variables
+
+
+def test_bottleneck_block_downsamples():
+    model = ResNet(stage_sizes=(1, 1), block_cls=BottleneckBlock, num_filters=8,
+                   num_classes=10, dtype=jnp.float32, small_inputs=True)
+    variables = model.init(jax.random.key(0), jnp.zeros((2, 16, 16, 3)), train=False)
+    logits = model.apply(variables, jnp.zeros((2, 16, 16, 3)), train=False)
+    assert logits.shape == (2, 10)
+
+
+def test_resnet50_param_count():
+    """ResNet-50 v1.5 has the canonical 25.56M trainable params."""
+    model = ResNet50(dtype=jnp.float32)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 32, 32, 3)), train=False)["params"]
+    n = sum(int(np.prod(p.shape)) for p in jax.tree_util.tree_leaves(params))
+    assert n == 25_557_032
+
+
+_loss_fn = make_loss_fn
+
+
+@pytest.mark.parametrize("placement", ["replicated", "sharded"])
+def test_ps_step_matches_plain_optax(placement):
+    """One fused PS step over the 8-device mesh ≡ one single-device optax
+    step on the same global batch (params AND BatchNorm stats)."""
+    model = tiny_resnet()
+    images, labels = next(mnist_batches(32, seed=3))
+    batch = (jnp.asarray(images), jnp.asarray(labels))
+    variables = model.init(jax.random.key(1), batch[0][:2], train=False)
+    params0, state0 = variables["params"], variables["batch_stats"]
+    loss_fn = _loss_fn(model)
+
+    # plain single-device reference
+    opt = optax.sgd(0.1, momentum=0.9)
+    opt_state = opt.init(params0)
+    (ref_loss, ref_bn), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+        params0, batch, state0
+    )
+    updates, _ = opt.update(grads, opt_state, params0)
+    ref_params = optax.apply_updates(params0, updates)
+
+    # PS mesh step
+    ps.init(backend="tpu")
+    store = ps.KVStore(optimizer="momentum", learning_rate=0.1, momentum=0.9,
+                       placement=placement)
+    store.init(params0)
+    run = store.make_step(loss_fn, has_aux=True)
+    loss, new_params, new_bn = run(store.shard_batch(batch), state0)
+
+    np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5),
+        new_params, ref_params,
+    )
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5),
+        new_bn, ref_bn,
+    )
+
+
+def test_training_decreases_loss():
+    model = tiny_resnet()
+    variables = model.init(jax.random.key(0), jnp.zeros((2, 28, 28, 1)), train=False)
+    params, model_state = variables["params"], variables["batch_stats"]
+
+    ps.init(backend="tpu")
+    store = ps.KVStore(optimizer="momentum", learning_rate=0.5, momentum=0.9,
+                       placement="sharded")
+    store.init(params)
+    run = store.make_step(_loss_fn(model), has_aux=True)
+
+    losses = []
+    for images, labels in mnist_batches(64, seed=0, steps=40):
+        batch = store.shard_batch((jnp.asarray(images), jnp.asarray(labels)))
+        loss, _, model_state = run(batch, model_state)
+        losses.append(float(loss))
+    assert np.mean(losses[-5:]) < np.mean(losses[:5]) - 0.2, losses

@@ -47,8 +47,15 @@ def test_custom_mesh_shape():
 
 
 def test_mesh_shape_device_mismatch():
+    # asking for more devices than exist is an error...
     with pytest.raises(ValueError, match="devices"):
-        ps.init(backend="tpu", mesh_shape={"data": 5})
+        ps.init(backend="tpu", mesh_shape={"data": 16})
+
+
+def test_mesh_smaller_than_device_count():
+    # ...but an explicit smaller mesh is allowed (driver dry-runs use this)
+    ctx = ps.init(backend="tpu", mesh_shape={"data": 5})
+    assert ctx.mesh.shape["data"] == 5
 
 
 @pytest.mark.parametrize("placement", ["replicated", "sharded"])

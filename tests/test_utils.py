@@ -1,0 +1,56 @@
+"""Metrics / logging / profiling utility tests (SURVEY.md §6)."""
+
+import json
+
+from ps_tpu.utils import Meter, StepLogger, TrainMetrics, trace
+
+
+def test_meter_rate():
+    m = Meter(window=8)
+    m.update(10, t=0.0)   # opens the window
+    m.update(10, t=1.0)
+    m.update(10, t=2.0)
+    assert abs(m.rate() - 10.0) < 1e-9
+    m.reset()
+    assert m.rate() == 0.0
+
+
+def test_meter_empty_and_single():
+    m = Meter()
+    assert m.rate() == 0.0
+    m.update(5, t=1.0)
+    assert m.rate() == 0.0
+
+
+class _FakeStore:
+    bytes_pushed = 4_000_000_000
+    bytes_pulled = 1_000_000_000
+    collective_bytes = 2_000_000_000
+
+
+def test_train_metrics_summary():
+    tm = TrainMetrics(_FakeStore(), batch_size=256, num_chips=8)
+    tm.mark_compiled()
+    for i in range(5):
+        tm.step(loss=1.0 - 0.1 * i)
+    s = tm.summary()
+    assert s["steps"] == 5
+    assert abs(s["loss"] - 0.6) < 1e-9
+    assert s["examples_per_sec"] > 0
+    assert abs(s["examples_per_sec"] / s["examples_per_sec_per_chip"] - 8) < 1e-6
+    # counters were snapshotted at mark_compiled, so deltas are zero
+    assert s["push_gb"] == 0.0 and s["ici_gb_per_device"] == 0.0
+
+
+def test_step_logger_jsonl(tmp_path):
+    path = str(tmp_path / "run.jsonl")
+    with StepLogger(every=100, jsonl=path) as log:
+        log.log(0, loss=2.5)
+        log.log(1, loss=2.25)
+    records = [json.loads(line) for line in open(path)]
+    assert records == [{"step": 0, "loss": 2.5}, {"step": 1, "loss": 2.25}]
+
+
+def test_trace_noop():
+    with trace(None):
+        pass
