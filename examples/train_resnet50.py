@@ -85,7 +85,8 @@ def main():
                 metrics.mark_compiled()  # exclude compile/warmup from rates
             else:
                 metrics.step(loss)
-            log.log(step, loss=float(loss))
+            if log.wants(step):
+                log.log(step, loss=float(loss))
         jax.block_until_ready(store.params())
     s = metrics.summary()
     print(f"done: {s['examples_per_sec']:.1f} imgs/s total, "

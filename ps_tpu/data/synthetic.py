@@ -71,9 +71,14 @@ def mlm_batches(batch_size: int, seq_len: int, *, vocab_size: int = 30522,
                 steps: int = None) -> Iterator[dict]:
     """Yields BERT-MLM dicts: input_ids, labels (-100 = unmasked), attention_mask."""
     rng = np.random.default_rng(seed)
+    # reserve a low-id band for special tokens (BERT-style); shrink it for
+    # tiny test vocabularies
+    low = max(min(1000, vocab_size // 4), mask_id + 1)
+    if low >= vocab_size:
+        raise ValueError(f"vocab_size {vocab_size} too small (mask_id {mask_id})")
     i = 0
     while steps is None or i < steps:
-        ids = rng.integers(1000, vocab_size, size=(batch_size, seq_len)).astype(np.int32)
+        ids = rng.integers(low, vocab_size, size=(batch_size, seq_len)).astype(np.int32)
         mask = rng.random((batch_size, seq_len)) < mask_rate
         labels = np.where(mask, ids, -100).astype(np.int32)
         input_ids = np.where(mask, mask_id, ids).astype(np.int32)

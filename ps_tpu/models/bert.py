@@ -1,0 +1,159 @@
+"""BERT-base MLM — reference workload config 3 (BASELINE.json: "BERT-base MLM
+(dense grads + server-side LAMB optimizer)"; SURVEY.md §3 row 15). The
+reference was unreadable (SURVEY.md §0), so this is a standard BERT encoder
+written TPU-first:
+
+- bfloat16 compute / float32 params: attention and FFN matmuls are
+  MXU-shaped ([B*S, H] x [H, 4H] etc.); LayerNorm and the softmax run in
+  float32 for numerics.
+- Attention is explicit einsum (no dynamic shapes, no python control flow) —
+  XLA fuses scale+mask+softmax into the matmul pipeline.
+- The MLM decoder ties to the token embedding (standard BERT weight tying),
+  which also keeps the dominant [V, H] matrix a single sharded tensor.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_len: int = 512
+    type_vocab_size: int = 2
+    dropout_rate: float = 0.0  # pretraining benchmarks run dropout-free
+    dtype: Any = jnp.bfloat16
+
+    @staticmethod
+    def base() -> "BertConfig":
+        return BertConfig()
+
+    @staticmethod
+    def tiny(**kw) -> "BertConfig":
+        """Test-sized config (2 layers, 64 wide)."""
+        defaults = dict(vocab_size=512, hidden_size=64, num_layers=2,
+                        num_heads=4, intermediate_size=128, max_len=64,
+                        dtype=jnp.float32)
+        defaults.update(kw)
+        return BertConfig(**defaults)
+
+
+class SelfAttention(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask):
+        cfg = self.cfg
+        head_dim = cfg.hidden_size // cfg.num_heads
+        dense = lambda name: nn.DenseGeneral(  # noqa: E731
+            (cfg.num_heads, head_dim), dtype=cfg.dtype,
+            param_dtype=jnp.float32, name=name,
+        )
+        q = dense("query")(x)  # [B, S, h, d]
+        k = dense("key")(x)
+        v = dense("value")(x)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(head_dim)
+        # mask: [B, S] with 1 = attend; softmax in f32
+        bias = jnp.where(mask[:, None, None, :] > 0, 0.0, -1e9)
+        probs = nn.softmax(scores.astype(jnp.float32) + bias).astype(cfg.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        return nn.DenseGeneral(
+            cfg.hidden_size, axis=(-2, -1), dtype=cfg.dtype,
+            param_dtype=jnp.float32, name="out",
+        )(out)
+
+
+class EncoderLayer(nn.Module):
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask):
+        cfg = self.cfg
+        ln = lambda name: nn.LayerNorm(  # noqa: E731
+            epsilon=1e-12, dtype=jnp.float32, param_dtype=jnp.float32, name=name
+        )
+        # post-LN (original BERT): sublayer -> residual -> LayerNorm
+        a = SelfAttention(cfg, name="attention")(x, mask)
+        x = ln("ln_attention")(x + a).astype(cfg.dtype)
+        h = nn.Dense(cfg.intermediate_size, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="intermediate")(x)
+        h = nn.gelu(h, approximate=True)
+        h = nn.Dense(cfg.hidden_size, dtype=cfg.dtype,
+                     param_dtype=jnp.float32, name="output")(h)
+        return ln("ln_output")(x + h).astype(cfg.dtype)
+
+
+class BertMLM(nn.Module):
+    """BERT encoder + tied-embedding MLM head.
+
+    ``__call__(input_ids, attention_mask, token_type_ids=None) -> logits
+    [B, S, V] (float32)``.
+    """
+
+    cfg: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, attention_mask, token_type_ids=None):
+        cfg = self.cfg
+        embed = nn.Embed(cfg.vocab_size, cfg.hidden_size,
+                         param_dtype=jnp.float32, name="token_embed")
+        x = embed(input_ids)
+        pos = jnp.arange(input_ids.shape[1])[None, :]
+        x = x + nn.Embed(cfg.max_len, cfg.hidden_size, param_dtype=jnp.float32,
+                         name="position_embed")(pos)
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        x = x + nn.Embed(cfg.type_vocab_size, cfg.hidden_size,
+                         param_dtype=jnp.float32, name="type_embed")(token_type_ids)
+        x = nn.LayerNorm(epsilon=1e-12, dtype=jnp.float32,
+                         param_dtype=jnp.float32, name="ln_embed")(x)
+        x = x.astype(cfg.dtype)
+
+        for i in range(cfg.num_layers):
+            x = EncoderLayer(cfg, name=f"layer_{i}")(x, attention_mask)
+
+        # MLM head: transform + tied decoder
+        x = nn.Dense(cfg.hidden_size, dtype=cfg.dtype, param_dtype=jnp.float32,
+                     name="mlm_transform")(x)
+        x = nn.gelu(x, approximate=True)
+        x = nn.LayerNorm(epsilon=1e-12, dtype=jnp.float32,
+                         param_dtype=jnp.float32, name="ln_mlm")(x).astype(cfg.dtype)
+        logits = embed.attend(x)  # tied weights: [B, S, V]
+        logits = logits + self.param(
+            "mlm_bias", nn.initializers.zeros_init(), (cfg.vocab_size,), jnp.float32
+        )
+        return logits.astype(jnp.float32)
+
+
+def mlm_loss(logits, labels, ignore_index: int = -100):
+    """Mean cross-entropy over masked positions only (labels == ignore_index
+    elsewhere, matching the data generator's contract)."""
+    valid = labels != ignore_index
+    safe_labels = jnp.where(valid, labels, 0)
+    logp = nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    token_logp = jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+    n = jnp.maximum(valid.sum(), 1)
+    return -(token_logp * valid).sum() / n
+
+
+def make_mlm_loss_fn(model):
+    """PS-step loss closure: ``loss_fn(params, batch) -> loss`` over the
+    data generator's {input_ids, labels, attention_mask} dict batches."""
+
+    def loss_fn(params, batch):
+        logits = model.apply(
+            {"params": params}, batch["input_ids"], batch["attention_mask"]
+        )
+        return mlm_loss(logits, batch["labels"])
+
+    return loss_fn

@@ -29,6 +29,12 @@ class StepLogger:
         self.stream = stream
         self._jsonl: Optional[IO] = open(jsonl, "a") if jsonl else None
 
+    def wants(self, step: int) -> bool:
+        """True when a record for this step would be printed or written —
+        lets callers skip host-device syncs (e.g. ``float(loss)``) on steps
+        that produce no output."""
+        return self._jsonl is not None or step % self.every == 0
+
     def log(self, step: int, **fields) -> None:
         if self._jsonl is not None:
             self._jsonl.write(json.dumps({"step": step, **fields}) + "\n")
