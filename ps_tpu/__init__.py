@@ -32,6 +32,8 @@ ZMQ van / scheduler         XLA collectives (data) + host control plane
 from ps_tpu.config import Config
 from ps_tpu.api import init, shutdown, is_initialized, current_context
 from ps_tpu.kv.store import KVStore
+from ps_tpu.kv.sparse import SparseEmbedding
+from ps_tpu.train import make_composite_step
 from ps_tpu import optim
 
 __version__ = "0.1.0"
@@ -43,6 +45,8 @@ __all__ = [
     "is_initialized",
     "current_context",
     "KVStore",
+    "SparseEmbedding",
+    "make_composite_step",
     "optim",
     "__version__",
 ]

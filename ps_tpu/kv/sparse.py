@@ -1,0 +1,269 @@
+"""Sparse KV: row-indexed push/pull on mesh-sharded embedding tables.
+
+Reference workload config 4 (BASELINE.json: "sparse push/pull: Wide-&-Deep on
+Criteo (row-sparse embedding tables)"; SURVEY.md §3 row 3, §4c). The GPU
+reference's protocol is: workers send (row_ids, row_grads) to the servers
+owning those rows (range-sharded), servers segment-sum duplicate rows and
+scatter-apply with per-row optimizer state, pulls gather rows back.
+
+TPU-native translation (north star: "sparse embedding row push/pull maps to
+``lax.all_to_all`` row exchange"):
+
+- The table [V, D] is **row-range-sharded** over the mesh's data axis
+  (``NamedSharding(P('data', None))``) — the literal key→server range
+  partition, as mesh shards.
+- **pull / lookup** = ``jnp.take`` on the sharded table; under GSPMD, XLA
+  partitions the gather and moves only the needed rows over ICI.
+- **push / apply** = a ``shard_map`` program: worker-local (ids, row_grads)
+  are exchanged to owner shards, duplicate rows are scatter-summed
+  (segment-sum via ``.at[].add``), then a lazy row-wise optimizer
+  (ps_tpu/optim/rowwise.py) applies only to touched rows.
+
+Exchange modes for the push:
+
+- ``'gather'`` (default, lossless): all-gather the (ids, grads) lists; each
+  shard filters and applies its own rows. Per-device ICI bytes
+  ≈ N·(D+1)·4·(k-1)/k — simple and exact.
+- ``'a2a'``: capacity-bounded ``lax.all_to_all`` — each device routes its
+  rows into per-destination buckets of capacity C = ceil(N_local/k ·
+  capacity_factor); per-device bytes drop to ≈ k·C·(D+1)·4·(k-1)/k.
+  Overflowing rows are **dropped** (standard embedding-capacity semantics;
+  set capacity_factor=k for lossless routing). Skewed id distributions
+  (Criteo-like zipf) overflow hot shards first — tests cover both the
+  lossless and the drop behavior.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+from jax import shard_map
+
+from ps_tpu.api import current_context
+from ps_tpu.optim.rowwise import make_rowwise
+from ps_tpu.parallel import collectives
+from ps_tpu.parallel.mesh import DATA_AXIS
+
+
+class SparseEmbedding:
+    """A row-sharded embedding table with PS sparse push/pull semantics.
+
+    Args:
+      num_rows: logical vocabulary size (internally padded up to a multiple
+        of the mesh axis so every shard is even — the pad rows are
+        unreachable by valid ids).
+      dim: embedding dimension.
+      optimizer: 'sgd' | 'adagrad' | 'adam' (lazy, per-row state) or a
+        RowwiseOptimizer.
+      exchange: 'gather' (lossless) | 'a2a' (capacity-bounded all_to_all).
+      capacity_factor: 'a2a' only — per-destination bucket capacity multiple.
+      dtype: table dtype (f32 default; bf16 halves pull bytes).
+    """
+
+    def __init__(self, num_rows: int, dim: int, optimizer="adagrad",
+                 exchange: str = "gather", capacity_factor: float = 2.0,
+                 dtype=jnp.float32, mesh=None, axis: str = DATA_AXIS,
+                 **opt_kwargs):
+        if exchange not in ("gather", "a2a"):
+            raise ValueError("exchange must be 'gather' or 'a2a'")
+        ctx = current_context()
+        self.mesh = mesh if mesh is not None else ctx.mesh
+        if self.mesh is None:
+            raise RuntimeError(
+                "SparseEmbedding needs the mesh backend; ps_tpu.init(backend='tpu')"
+            )
+        self.axis = axis
+        self.k = self.mesh.shape[axis]
+        self.num_rows = num_rows
+        self.padded_rows = int(math.ceil(num_rows / self.k) * self.k)
+        self.rows_per_shard = self.padded_rows // self.k
+        self.dim = dim
+        self.dtype = dtype
+        self.exchange = exchange
+        self.capacity_factor = capacity_factor
+        self._opt = make_rowwise(optimizer, **opt_kwargs)
+        self._table: Optional[jax.Array] = None
+        self._state: Any = None
+        self._jit_apply = None   # cached jit wrappers: a fresh jax.jit per
+        self._jit_lookup = None  # call would retrace every push/pull
+
+        self.bytes_pushed = 0
+        self.bytes_pulled = 0
+        self.collective_bytes = 0
+        self.push_count = 0
+
+    # -- placement -----------------------------------------------------------
+
+    def _row_sharding(self) -> NamedSharding:
+        return NamedSharding(self.mesh, P(self.axis, None))
+
+    def init(self, rng_or_table, scale: float = 0.01) -> jax.Array:
+        """Create (or adopt) the table and per-row optimizer state, sharded
+        row-range over the mesh. Returns the placed table."""
+        if self._table is not None:
+            raise RuntimeError("SparseEmbedding.init already called")
+        is_prng_key = isinstance(rng_or_table, jax.Array) and jnp.issubdtype(
+            rng_or_table.dtype, jax.dtypes.prng_key
+        )
+        if not is_prng_key and isinstance(rng_or_table, (jax.Array, np.ndarray)):
+            arr = np.asarray(rng_or_table)
+            if arr.shape != (self.num_rows, self.dim):
+                raise ValueError(
+                    f"table shape {arr.shape} != ({self.num_rows}, {self.dim})"
+                )
+            pad = self.padded_rows - self.num_rows
+            if pad:
+                arr = np.concatenate([arr, np.zeros((pad, self.dim), arr.dtype)])
+            table = jnp.asarray(arr, self.dtype)
+        else:
+            table = scale * jax.random.normal(
+                rng_or_table, (self.padded_rows, self.dim), self.dtype
+            )
+        self._table = jax.device_put(table, self._row_sharding())
+        shard_init = shard_map(
+            self._opt.init, mesh=self.mesh,
+            in_specs=P(self.axis, None), out_specs=self._state_specs(),
+        )
+        self._state = jax.jit(shard_init)(self._table)
+        return self._table
+
+    def _state_specs(self):
+        """PartitionSpecs of the optimizer state (row-major leaves shard on
+        the table axis)."""
+        probe = self._opt.init(jnp.zeros((self.k, self.dim), self.dtype))
+        return jax.tree_util.tree_map(
+            lambda leaf: P(self.axis, None) if getattr(leaf, "ndim", 0) > 1 else P(self.axis),
+            probe,
+        )
+
+    # -- functional pieces (usable inside a fused jitted step) ---------------
+
+    def lookup(self, table: jax.Array, ids: jax.Array) -> jax.Array:
+        """rows = table[ids] — GSPMD partitions the gather over row shards.
+
+        Out-of-range ids are clipped by jnp.take's default mode; valid ids
+        are the caller's contract (synthetic data guarantees it)."""
+        return jnp.take(table, ids, axis=0)
+
+    def apply(self, table: jax.Array, state: Any, ids: jax.Array,
+              row_grads: jax.Array) -> Tuple[jax.Array, Any]:
+        """Scatter-apply summed row grads onto owner shards (pure function).
+
+        ``ids``: [N] int32 (duplicates allowed), sharded or replicated.
+        ``row_grads``: [N, D] grads w.r.t. the *gathered rows* (the sparse
+        push payload — never a dense table grad).
+        """
+        rps, dim, axis, k = self.rows_per_shard, self.dim, self.axis, self.k
+        opt_apply = self._opt.apply
+
+        def shard_apply(table_shard, state_shard, ids_loc, grads_loc):
+            if self.exchange == "gather" or k == 1:
+                all_ids = jax.lax.all_gather(ids_loc, axis, tiled=True)
+                all_grads = jax.lax.all_gather(grads_loc, axis, tiled=True)
+            else:
+                all_ids, all_grads = _a2a_route(
+                    ids_loc, grads_loc, k, axis, rps, self.capacity_factor
+                )
+            lo = jax.lax.axis_index(axis) * rps
+            local = all_ids - lo
+            ok = (local >= 0) & (local < rps)
+            slot = jnp.where(ok, local, rps)  # overflow slot, sliced off
+            g = jnp.where(ok[:, None], all_grads, 0).astype(jnp.float32)
+            gsum = jnp.zeros((rps + 1, dim), jnp.float32).at[slot].add(g)[:-1]
+            cnt = jnp.zeros((rps + 1,), jnp.int32).at[slot].add(
+                ok.astype(jnp.int32))[:-1]
+            return opt_apply(table_shard, state_shard, gsum, cnt > 0)
+
+        state_specs = self._state_specs()
+        fn = shard_map(
+            shard_apply, mesh=self.mesh,
+            in_specs=(P(axis, None), state_specs, P(axis), P(axis, None)),
+            out_specs=(P(axis, None), state_specs),
+        )
+        return fn(table, state, ids, row_grads)
+
+    # -- eager PS API (the reference's worker-side protocol surface) ---------
+
+    @property
+    def table(self) -> jax.Array:
+        if self._table is None:
+            raise RuntimeError("SparseEmbedding.init not called")
+        return self._table
+
+    def pull(self, ids) -> jax.Array:
+        """Gather current rows for ids (the sparse pull)."""
+        ids = jnp.asarray(ids, jnp.int32)
+        if self._jit_lookup is None:
+            self._jit_lookup = jax.jit(self.lookup)
+        rows = self._jit_lookup(self.table, ids)
+        self.bytes_pulled += rows.size * rows.dtype.itemsize
+        return rows
+
+    def push(self, ids, row_grads) -> None:
+        """Send (ids, row_grads); server scatter-applies immediately."""
+        ids = jnp.asarray(ids, jnp.int32)
+        row_grads = jnp.asarray(row_grads)
+        if row_grads.shape != (ids.shape[0], self.dim):
+            raise ValueError(
+                f"row_grads shape {row_grads.shape} != ({ids.shape[0]}, {self.dim})"
+            )
+        if ids.shape[0] % self.k:
+            # shard_map shards the push list over the axis; pad to a multiple
+            # with id -1, which the owner-shard ok-mask drops (same filler
+            # convention as a2a overflow) so no real row is marked touched
+            pad = self.k - ids.shape[0] % self.k
+            ids = jnp.concatenate([ids, jnp.full((pad,), -1, jnp.int32)])
+            row_grads = jnp.concatenate(
+                [row_grads, jnp.zeros((pad, self.dim), row_grads.dtype)]
+            )
+        if self._jit_apply is None:
+            self._jit_apply = jax.jit(self.apply)
+        self._table, self._state = self._jit_apply(
+            self.table, self._state, ids, row_grads
+        )
+        self.bytes_pushed += row_grads.size * row_grads.dtype.itemsize
+        self.push_count += 1
+        self._account_push(ids.shape[0])
+
+    def _account_push(self, n_ids: int) -> None:
+        payload = {"g": np.zeros((n_ids, self.dim + 1), np.float32)}
+        if self.exchange == "gather":
+            self.collective_bytes += collectives.all_gather_bytes(payload, self.k)
+        else:
+            cap = int(math.ceil(n_ids / self.k / self.k * self.capacity_factor))
+            bucket = {"g": np.zeros((self.k * cap, self.dim + 1), np.float32)}
+            self.collective_bytes += collectives.all_to_all_bytes(bucket, self.k)
+
+    def state(self):
+        return self._state
+
+
+def _a2a_route(ids, grads, k: int, axis: str, rows_per_shard: int,
+               capacity_factor: float):
+    """Route (ids, grads) into capacity-bounded per-destination buckets and
+    lax.all_to_all them to owner shards. Overflow rows are dropped (their
+    bucket slots stay id=-1 / grad=0)."""
+    n = ids.shape[0]
+    cap = int(math.ceil(n / k * capacity_factor))
+    dest = jnp.clip(ids // rows_per_shard, 0, k - 1)
+    # slot of each row within its destination bucket = rank among same-dest rows
+    order = jnp.argsort(dest)  # stable: groups rows by destination
+    ids_s, grads_s, dest_s = ids[order], grads[order], dest[order]
+    pos = jnp.arange(n) - jnp.searchsorted(dest_s, dest_s, side="left")
+    keep = pos < cap
+    bucket_ids = jnp.full((k, cap), -1, ids.dtype)
+    bucket_grads = jnp.zeros((k, cap) + grads.shape[1:], grads.dtype)
+    bucket_ids = bucket_ids.at[dest_s, pos].set(
+        jnp.where(keep, ids_s, -1), mode="drop")
+    bucket_grads = bucket_grads.at[dest_s, pos].set(
+        jnp.where(keep[:, None], grads_s, 0), mode="drop")
+    # exchange: device d receives every device's bucket for destination d
+    recv_ids = jax.lax.all_to_all(bucket_ids, axis, 0, 0, tiled=True)
+    recv_grads = jax.lax.all_to_all(bucket_grads, axis, 0, 0, tiled=True)
+    return recv_ids.reshape(-1), recv_grads.reshape((-1,) + grads.shape[1:])

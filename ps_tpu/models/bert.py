@@ -31,7 +31,6 @@ class BertConfig:
     intermediate_size: int = 3072
     max_len: int = 512
     type_vocab_size: int = 2
-    dropout_rate: float = 0.0  # pretraining benchmarks run dropout-free
     dtype: Any = jnp.bfloat16
 
     @staticmethod
@@ -105,6 +104,11 @@ class BertMLM(nn.Module):
     @nn.compact
     def __call__(self, input_ids, attention_mask, token_type_ids=None):
         cfg = self.cfg
+        if input_ids.shape[1] > cfg.max_len:
+            raise ValueError(
+                f"sequence length {input_ids.shape[1]} exceeds max_len "
+                f"{cfg.max_len}; position ids would silently clamp"
+            )
         embed = nn.Embed(cfg.vocab_size, cfg.hidden_size,
                          param_dtype=jnp.float32, name="token_embed")
         x = embed(input_ids)

@@ -1,0 +1,106 @@
+"""Per-row optimizers for sparse embedding tables.
+
+The reference server keeps optimizer state per embedding row and applies
+updates only to pushed rows (SURVEY.md §4c: "server: scatter-apply per row
+(sparse Adam/SGD state per row)"). optax transforms are whole-tensor, so
+these are purpose-built *lazy* row-wise rules: a row's state advances only
+when the row is touched this step. Consequences, tested in
+tests/test_sparse.py:
+
+- sgd / adagrad: identical to the dense update with zero grads on untouched
+  rows (zero grad moves neither the row nor its accumulator).
+- adam: LAZY adam — untouched rows' moments do not decay and their timestep
+  does not advance (dense adam would keep moving previously-touched rows).
+  This matches sparse-PS semantics, not dense optax.adam.
+
+All rules consume a *summed* duplicate-row gradient (``gsum``) plus a
+``touched`` mask, both produced by the scatter-apply in ps_tpu/kv/sparse.py.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Tuple
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class RowwiseOptimizer:
+    """init(rows) -> state; apply(rows, state, gsum, touched) -> (rows, state).
+
+    ``rows``: [R, D] table shard. ``gsum``: [R, D] duplicate-summed grads
+    (zero for untouched rows). ``touched``: [R] bool.
+    """
+
+    init: Callable[[jnp.ndarray], Any]
+    apply: Callable[..., Tuple[jnp.ndarray, Any]]
+
+
+def sgd(learning_rate: float = 0.01) -> RowwiseOptimizer:
+    def init(rows):
+        return ()
+
+    def apply(rows, state, gsum, touched):
+        del touched  # zero grad already leaves untouched rows unchanged
+        return rows - learning_rate * gsum.astype(rows.dtype), state
+
+    return RowwiseOptimizer(init, apply)
+
+
+def adagrad(learning_rate: float = 0.01, eps: float = 1e-8) -> RowwiseOptimizer:
+    """Row-wise Adagrad: ONE accumulator scalar per row (mean of grad² over
+    the embedding dim) — the classic memory-lean rule for large tables."""
+
+    def init(rows):
+        return jnp.zeros((rows.shape[0],), jnp.float32)
+
+    def apply(rows, acc, gsum, touched):
+        del touched
+        g = gsum.astype(jnp.float32)
+        acc = acc + (g * g).mean(axis=-1)
+        step = learning_rate * g / jnp.sqrt(acc + eps)[:, None]
+        return rows - step.astype(rows.dtype), acc
+
+    return RowwiseOptimizer(init, apply)
+
+
+def adam(learning_rate: float = 1e-3, b1: float = 0.9, b2: float = 0.999,
+         eps: float = 1e-8) -> RowwiseOptimizer:
+    """Lazy Adam: moments and per-row timestep advance only on touched rows."""
+
+    def init(rows):
+        zeros = jnp.zeros(rows.shape, jnp.float32)
+        return {"m": zeros, "v": zeros,
+                "t": jnp.zeros((rows.shape[0],), jnp.int32)}
+
+    def apply(rows, state, gsum, touched):
+        g = gsum.astype(jnp.float32)
+        mask = touched[:, None]
+        t = state["t"] + touched.astype(jnp.int32)
+        m = jnp.where(mask, b1 * state["m"] + (1 - b1) * g, state["m"])
+        v = jnp.where(mask, b2 * state["v"] + (1 - b2) * g * g, state["v"])
+        # bias correction with per-row t (t >= 1 wherever touched)
+        t_safe = jnp.maximum(t, 1)[:, None].astype(jnp.float32)
+        mhat = m / (1 - b1 ** t_safe)
+        vhat = v / (1 - b2 ** t_safe)
+        step = jnp.where(mask, learning_rate * mhat / (jnp.sqrt(vhat) + eps), 0.0)
+        return rows - step.astype(rows.dtype), {"m": m, "v": v, "t": t}
+
+    return RowwiseOptimizer(init, apply)
+
+
+_REGISTRY = {"sgd": sgd, "adagrad": adagrad, "adam": adam}
+
+
+def make_rowwise(opt, **kwargs) -> RowwiseOptimizer:
+    if isinstance(opt, RowwiseOptimizer):
+        if kwargs:
+            raise ValueError("kwargs only valid with a string optimizer name")
+        return opt
+    try:
+        return _REGISTRY[opt.lower()](**kwargs)
+    except KeyError:
+        raise ValueError(
+            f"unknown rowwise optimizer {opt!r}; known: {sorted(_REGISTRY)}"
+        ) from None
