@@ -30,10 +30,19 @@ def main():
     ap.add_argument("--num-workers", type=int, default=1)
     ap.add_argument("--optimizer", default="sgd", choices=["sgd", "momentum", "adam", "lamb"])
     ap.add_argument("--mode", default="sync", choices=["sync", "async"])
+    ap.add_argument("--backend", default="local", choices=["local", "tpu"],
+                    help="'tpu' runs the same protocol on the device mesh "
+                         "(async, or sync with one logical worker)")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
 
-    ps.init(backend="local", num_workers=args.num_workers, mode=args.mode, seed=args.seed)
+    if args.backend == "tpu" and args.mode == "sync" and args.num_workers > 1:
+        raise SystemExit(
+            "on the tpu backend the sync worker set IS the mesh's data axis; "
+            "use --num-workers 1 (shard the batch) or --mode async"
+        )
+    ps.init(backend=args.backend, num_workers=args.num_workers, mode=args.mode,
+            seed=args.seed)
     model = MLP(hidden=args.hidden)
     params = model.init(jax.random.key(args.seed), jnp.zeros((1, 28, 28, 1)))["params"]
 

@@ -1,0 +1,38 @@
+"""Shared server-engine pieces (single source of truth for both backends).
+
+The local backend's semantics are the spec the mesh backend must match
+(asserted by tests/test_async_tpu.py); keeping the DC apply and the
+introspection read in one place guarantees a fix to one cannot silently
+break that parity.
+"""
+
+from __future__ import annotations
+
+import jax
+import optax
+
+from ps_tpu.optim.dc import delay_compensate
+
+
+def make_jit_dc_apply(opt: optax.GradientTransformation):
+    """Jitted per-key async apply: DC-ASGD correction then optimizer update.
+
+    ``fn(param, state, grad, stale_param, lam) -> (param, state)`` with lam
+    static (SURVEY.md §4d: g̃ = g + λ·g⊙g⊙(w_now − w_stale))."""
+
+    def _apply_dc(param, state, grad, stale_param, lam):
+        g = delay_compensate(grad, param, stale_param, lam)
+        updates, new_state = opt.update(g, state, param)
+        return optax.apply_updates(param, updates), new_state
+
+    return jax.jit(_apply_dc, static_argnums=(4,))
+
+
+class PeekMixin:
+    """Side-effect-free key read for introspection (KVStore.params()):
+    never records async pull snapshots or checks aggregation state."""
+
+    def peek(self, key: str) -> jax.Array:
+        if key not in self._params:
+            raise KeyError(f"unregistered key {key!r}")
+        return self._params[key]

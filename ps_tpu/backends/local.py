@@ -26,11 +26,11 @@ from typing import Any, Dict, Optional
 import jax
 import optax
 
+from ps_tpu.backends.common import PeekMixin, make_jit_dc_apply
 from ps_tpu.config import Config
-from ps_tpu.optim.dc import delay_compensate
 
 
-class LocalServer:
+class LocalServer(PeekMixin):
     """In-memory server for one KVStore: params + per-key optimizer state."""
 
     def __init__(self, optimizer: optax.GradientTransformation, num_workers: int,
@@ -55,13 +55,7 @@ class LocalServer:
             return optax.apply_updates(param, updates), new_state
 
         self._jit_apply = jax.jit(_apply)
-
-        def _apply_dc(param, state, grad, stale_param, lam):
-            g = delay_compensate(grad, param, stale_param, lam)
-            updates, new_state = self._opt.update(g, state, param)
-            return optax.apply_updates(param, updates), new_state
-
-        self._jit_apply_dc = jax.jit(_apply_dc, static_argnums=(4,))
+        self._jit_apply_dc = make_jit_dc_apply(optimizer)
 
     # -- registration -------------------------------------------------------
 
@@ -121,6 +115,13 @@ class LocalServer:
             )
         if self.mode == "async":
             self._stale[(worker, key)] = self._params[key]
+        return self._params[key]
+
+    def peek(self, key: str) -> jax.Array:
+        """Read a key with NO protocol side effects (no async snapshot
+        recording) — for introspection like KVStore.params()."""
+        if key not in self._params:
+            raise KeyError(f"unregistered key {key!r}")
         return self._params[key]
 
     def optimizer_state(self, key: str):

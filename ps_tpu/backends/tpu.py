@@ -36,7 +36,105 @@ from ps_tpu.parallel.mesh import DATA_AXIS, make_mesh
 from ps_tpu.parallel.sharding import batch_sharding, param_sharding
 
 
-class TpuServer:
+from ps_tpu.backends.common import PeekMixin, make_jit_dc_apply
+
+
+class AsyncTpuServer(PeekMixin):
+    """Mesh-placed parameter server with ASYNC (stale, delay-compensated)
+    apply — reference workload config 5 (SURVEY.md §4d).
+
+    Semantics mirror the local backend's async mode exactly (the spec; parity
+    asserted in tests/test_async_tpu.py): every push applies immediately with
+    the DC-ASGD correction against the pusher's last-pulled snapshot of that
+    key. The difference is placement: params and per-key optimizer state live
+    on the mesh (replicated or ZeRO-1 sharded), and each worker's gradient
+    computation runs SPMD over the mesh — the mesh plays the reference's
+    intra-node GPU set (the grad psum = NCCL reduce), while the *logical*
+    workers (``Config.num_workers``) are the asynchronously-pushing nodes.
+
+    Version accounting: ``version`` advances once per full-tree worth of
+    per-key applies; ``worker_version[w]`` records the version worker w last
+    pulled, so ``staleness(w) = version_at_push - worker_version[w]``.
+    """
+
+    mode = "async"
+
+    def __init__(self, optimizer: optax.GradientTransformation, mesh,
+                 num_workers: int, placement: str = "replicated",
+                 dc_lambda: float = 0.04):
+        self._opt = optimizer
+        self.mesh = mesh
+        self.placement = placement
+        self.num_workers = num_workers
+        self.dc_lambda = dc_lambda
+        self._params: Dict[str, jax.Array] = {}
+        self._state: Dict[str, Any] = {}
+        self._stale: Dict[tuple, jax.Array] = {}
+        self._worker_version: Dict[int, int] = {}
+        self._applies = 0
+        self.apply_count: Dict[str, int] = {}
+        self.collective_bytes = 0
+
+        self._jit_apply_dc = make_jit_dc_apply(optimizer)
+
+    @property
+    def version(self) -> int:
+        """Server version in whole-model steps (total per-key applies divided
+        by the key count)."""
+        return self._applies // max(len(self._params), 1)
+
+    def register_tree(self, kv: Dict[str, Any], treedef, key_order: List[str]):
+        if self._params:
+            raise RuntimeError("server already holds a registered tree")
+        shardings = {
+            k: param_sharding(self.mesh, v, self.placement) for k, v in kv.items()
+        }
+        self._params = {
+            k: jax.device_put(np.asarray(v), shardings[k]) for k, v in kv.items()
+        }
+        for k, v in self._params.items():
+            self._state[k] = jax.jit(self._opt.init)(v)
+            self.apply_count[k] = 0
+        from ps_tpu.kv import keys as keymod
+
+        return keymod.unflatten(treedef, self._params, key_order)
+
+    def keys(self):
+        return list(self._params)
+
+    def push(self, key: str, grad: Any, worker: int = 0) -> None:
+        if key not in self._params:
+            raise KeyError(f"unregistered key {key!r}")
+        if not (0 <= worker < self.num_workers):
+            raise ValueError(f"worker {worker} out of range [0, {self.num_workers})")
+        stale = self._stale.get((worker, key), self._params[key])
+        self._params[key], self._state[key] = self._jit_apply_dc(
+            self._params[key], self._state[key], grad, stale, self.dc_lambda
+        )
+        self.apply_count[key] += 1
+        self._applies += 1
+        k = self.mesh.shape[DATA_AXIS]
+        self.collective_bytes += collectives.allreduce_bytes(
+            {key: self._params[key]}, k
+        )
+
+    def pull(self, key: str, worker: int = 0) -> jax.Array:
+        if key not in self._params:
+            raise KeyError(f"unregistered key {key!r}")
+        self._stale[(worker, key)] = self._params[key]
+        self._worker_version[worker] = self.version
+        return self._params[key]
+
+    def staleness(self, worker: int) -> int:
+        """Whole-model versions the server advanced since this worker's last
+        pull (the τ of the DC-ASGD correction)."""
+        return self.version - self._worker_version.get(worker, 0)
+
+    def optimizer_state(self, key: str):
+        return self._state[key]
+
+
+class TpuServer(PeekMixin):
     """Mesh-sharded parameter/optimizer-state store with PS semantics.
 
     Holds the parameter dict ``{key: jax.Array}`` placed per the placement
@@ -48,11 +146,7 @@ class TpuServer:
     def __init__(self, optimizer: optax.GradientTransformation, mesh,
                  placement: str = "replicated", aggregate: str = "mean",
                  mode: str = "sync"):
-        if mode == "async":
-            raise NotImplementedError(
-                "async mode on the tpu backend is host-driven and lands with "
-                "P5 (SURVEY.md §8); use mode='sync' or backend='local'"
-            )
+        assert mode == "sync", "async mode is handled by AsyncTpuServer"
         if aggregate != "mean":
             raise NotImplementedError(
                 "the tpu backend has data-parallel mean semantics; for sum "
@@ -198,13 +292,22 @@ class TpuBackend:
         self.num_workers = self.mesh.shape.get(DATA_AXIS, 1)
 
     def create_server(self, optimizer, mode: Optional[str] = None,
-                      aggregate: str = "mean", placement: str = "replicated") -> TpuServer:
+                      aggregate: str = "mean", placement: str = "replicated"):
+        mode = mode or self.config.mode
+        if mode == "async":
+            return AsyncTpuServer(
+                optimizer,
+                self.mesh,
+                num_workers=self.config.num_workers,
+                placement=placement,
+                dc_lambda=self.config.dc_lambda,
+            )
         return TpuServer(
             optimizer,
             self.mesh,
             placement=placement,
             aggregate=aggregate,
-            mode=mode or self.config.mode,
+            mode=mode,
         )
 
     def batch_sharding(self):

@@ -68,6 +68,7 @@ class KVStore:
             )
         self._treedef = None
         self._key_order: List[str] = []
+        self._async_params: Dict[int, Any] = {}
         self.bytes_pushed = 0
         self.bytes_pulled = 0
         self.step = 0
@@ -175,6 +176,11 @@ class KVStore:
         """
         self._require_init()
         engine = self._engine
+        if getattr(engine, "mode", "sync") == "async":
+            raise RuntimeError(
+                "make_step is the sync fused path; in async mode use "
+                "make_async_step (or push_all/pull_all directly)"
+            )
         treedef, key_order = self._treedef, self._key_order
 
         if not hasattr(engine, "get_tree_and_state"):
@@ -231,6 +237,49 @@ class KVStore:
 
         return run
 
+    def make_async_step(self, loss_fn, has_aux: bool = False):
+        """Build the async worker cycle ``run(batch, worker=w, *extra)``.
+
+        The reference's async flow (SURVEY.md §4d): a worker computes
+        gradients against the parameters it LAST pulled — stale by however
+        many whole-model versions other workers pushed since — pushes them
+        (the server applies immediately with the DC-ASGD correction), then
+        pulls the current version for its next cycle. Drive workers
+        round-robin (or from separate host threads) to accrue staleness;
+        ``staleness(w)`` reports each worker's current τ.
+        """
+        self._require_init()
+        if getattr(self._engine, "mode", "sync") != "async":
+            raise RuntimeError(
+                "make_async_step requires mode='async' "
+                "(ps_tpu.init(..., mode='async') or KVStore(mode='async'))"
+            )
+        grad_fn = jax.jit(jax.value_and_grad(loss_fn, has_aux=has_aux))
+
+        def run(batch, worker: int = 0, *extra):
+            params = self._async_params.get(worker)
+            if params is None:
+                params = self.pull_all(worker=worker)
+            if has_aux:
+                (loss, aux), grads = grad_fn(params, batch, *extra)
+            else:
+                loss, grads = grad_fn(params, batch, *extra)
+                aux = None
+            self.push_all(grads, worker=worker)
+            self._async_params[worker] = self.pull_all(worker=worker)
+            self.step += 1
+            if has_aux:
+                return loss, aux
+            return loss
+
+        return run
+
+    def staleness(self, worker: int = 0) -> int:
+        """Async mode: whole-model versions behind the server this worker's
+        cached parameters are (0 in sync mode)."""
+        fn = getattr(self._engine, "staleness", None)
+        return fn(worker) if fn else 0
+
     def shard_batch(self, batch: Any) -> Any:
         """Place a host batch on the mesh, sharded over the data axis
         (identity on the local backend)."""
@@ -242,9 +291,12 @@ class KVStore:
     # -- introspection ------------------------------------------------------
 
     def params(self) -> Any:
-        """Current server-side parameter pytree (pull without byte accounting)."""
+        """Current server-side parameter pytree — introspection only: no byte
+        accounting and no protocol side effects (an async worker's snapshot
+        is recorded by ``pull``/``pull_all``, never by this)."""
         self._require_init()
-        kv = {k: self._engine.pull(k, worker=0) for k in self._key_order}
+        read = getattr(self._engine, "peek", None) or self._engine.pull
+        kv = {k: read(k) for k in self._key_order}
         return keymod.unflatten(self._treedef, kv, self._key_order)
 
     def optimizer_state(self, key: str):

@@ -1,0 +1,141 @@
+"""Async (stale-gradient, delay-compensated) mode on the mesh backend —
+reference workload config 5. The local backend's async semantics are the
+spec; the mesh server must match them while holding state on the mesh.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import ps_tpu as ps
+from ps_tpu.data.synthetic import mnist_batches
+from ps_tpu.models.mlp import MLP, cross_entropy_loss
+
+LAM = 0.04
+LR = 0.1
+
+
+def _params():
+    model = MLP(hidden=16)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)))["params"]
+    return model, params
+
+
+def _grads_like(params, seed):
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    rng = np.random.default_rng(seed)
+    return jax.tree_util.tree_unflatten(
+        treedef,
+        [jnp.asarray(rng.normal(0, 0.1, x.shape).astype(np.float32)) for x in leaves],
+    )
+
+
+def _run_protocol(backend):
+    """Fixed async push/pull interleaving; returns final params."""
+    ps.init(backend=backend, mode="async", num_workers=2, dc_lambda=LAM)
+    _, params = _params()
+    store = ps.KVStore(optimizer="sgd", learning_rate=LR, mode="async")
+    store.init(params)
+    g0, g1a, g1b = (_grads_like(params, s) for s in (1, 2, 3))
+    store.pull_all(worker=0)          # w0 snapshots v0
+    store.push_all(g1a, worker=1)     # w1 advances the server twice
+    store.push_all(g1b, worker=1)
+    store.push_all(g0, worker=0)      # w0 pushes stale-by-2
+    out = jax.tree_util.tree_map(np.asarray, store.pull_all(worker=0))
+    ps.shutdown()
+    return out
+
+
+def test_async_tpu_matches_local_spec():
+    np.testing.assert_allclose  # readability anchor
+    local = _run_protocol("local")
+    mesh = _run_protocol("tpu")
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7),
+        local, mesh,
+    )
+
+
+def test_dc_correction_math():
+    """One stale push must apply g + λ·g⊙g⊙(w_now − w_stale) exactly."""
+    ps.init(backend="tpu", mode="async", num_workers=2, dc_lambda=LAM)
+    _, params = _params()
+    store = ps.KVStore(optimizer="sgd", learning_rate=LR, mode="async")
+    store.init(params)
+    w_stale = jax.tree_util.tree_map(np.asarray, store.pull_all(worker=0))
+    g1, g0 = _grads_like(params, 10), _grads_like(params, 11)
+    store.push_all(g1, worker=1)
+    w_now = jax.tree_util.tree_map(np.asarray, store.params())
+    store.push_all(g0, worker=0)
+    got = jax.tree_util.tree_map(np.asarray, store.params())
+
+    def expect(wn, ws, g):
+        g = np.asarray(g)
+        return wn - LR * (g + LAM * g * g * (wn - ws))
+
+    exp = jax.tree_util.tree_map(expect, w_now, w_stale, g0)
+    # atol=2e-6: manual float64 reference vs fp32 jit arithmetic
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-5, atol=2e-6),
+        got, exp,
+    )
+
+
+def test_version_and_staleness():
+    ps.init(backend="tpu", mode="async", num_workers=3)
+    _, params = _params()
+    store = ps.KVStore(optimizer="sgd", learning_rate=LR, mode="async")
+    store.init(params)
+    store.pull_all(worker=0)
+    assert store.staleness(0) == 0
+    g = _grads_like(params, 4)
+    store.push_all(g, worker=1)
+    store.push_all(g, worker=2)
+    assert store._engine.version == 2
+    assert store.staleness(0) == 2
+    store.pull_all(worker=0)
+    assert store.staleness(0) == 0
+
+
+def test_make_async_step_trains():
+    ps.init(backend="tpu", mode="async", num_workers=2)
+    model = MLP(hidden=64)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)))["params"]
+    store = ps.KVStore(optimizer="sgd", learning_rate=0.1, mode="async")
+    store.init(params)
+
+    def loss_fn(p, batch):
+        images, labels = batch
+        return cross_entropy_loss(model.apply({"params": p}, images), labels)
+
+    run = store.make_async_step(loss_fn)
+    streams = [
+        mnist_batches(64, seed=0, worker=w, num_workers=2, steps=40)
+        for w in range(2)
+    ]
+    losses = []
+    for step in range(40):
+        for w, stream in enumerate(streams):
+            images, labels = next(stream)
+            loss = run((jnp.asarray(images), jnp.asarray(labels)), w)
+            losses.append(float(loss))
+    # with 2 round-robin workers, each cycle is stale by one version
+    assert store.staleness(0) == 1
+    assert np.mean(losses[-6:]) < np.mean(losses[:6]) - 1.0, losses
+
+
+def test_mode_guards():
+    ps.init(backend="tpu", mode="async", num_workers=2)
+    _, params = _params()
+    store = ps.KVStore(optimizer="sgd", mode="async")
+    store.init(params)
+    with pytest.raises(RuntimeError, match="make_async_step"):
+        store.make_step(lambda p, b: 0.0)
+    ps.shutdown()
+
+    ps.init(backend="tpu")
+    store = ps.KVStore(optimizer="sgd")
+    store.init(params)
+    with pytest.raises(RuntimeError, match="mode='async'"):
+        store.make_async_step(lambda p, b: 0.0)

@@ -167,10 +167,13 @@ def test_collective_byte_accounting():
     assert store._engine.collective_bytes == 448
 
 
-def test_async_mode_on_tpu_raises_for_now():
-    ps.init(backend="tpu")
-    with pytest.raises(NotImplementedError, match="P5"):
-        ps.KVStore(optimizer="sgd", mode="async")
+def test_async_mode_on_tpu_creates_async_server():
+    from ps_tpu.backends.tpu import AsyncTpuServer
+
+    ps.init(backend="tpu", mode="async", num_workers=2)
+    store = ps.KVStore(optimizer="sgd", mode="async")
+    assert isinstance(store._engine, AsyncTpuServer)
+    assert store.num_workers == 2
 
 
 def test_donation_invalidates_old_pull():
