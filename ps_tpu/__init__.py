@@ -34,6 +34,7 @@ from ps_tpu.api import init, shutdown, is_initialized, current_context
 from ps_tpu.kv.store import KVStore
 from ps_tpu.kv.sparse import SparseEmbedding
 from ps_tpu.train import make_composite_step
+from ps_tpu import checkpoint
 from ps_tpu import optim
 
 __version__ = "0.1.0"
@@ -47,6 +48,7 @@ __all__ = [
     "KVStore",
     "SparseEmbedding",
     "make_composite_step",
+    "checkpoint",
     "optim",
     "__version__",
 ]

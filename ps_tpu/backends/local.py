@@ -27,10 +27,11 @@ import jax
 import optax
 
 from ps_tpu.backends.common import PeekMixin, make_jit_dc_apply
+from ps_tpu.checkpoint import CheckpointMixin
 from ps_tpu.config import Config
 
 
-class LocalServer(PeekMixin):
+class LocalServer(PeekMixin, CheckpointMixin):
     """In-memory server for one KVStore: params + per-key optimizer state."""
 
     def __init__(self, optimizer: optax.GradientTransformation, num_workers: int,
@@ -117,15 +118,38 @@ class LocalServer(PeekMixin):
             self._stale[(worker, key)] = self._params[key]
         return self._params[key]
 
-    def peek(self, key: str) -> jax.Array:
-        """Read a key with NO protocol side effects (no async snapshot
-        recording) — for introspection like KVStore.params()."""
-        if key not in self._params:
-            raise KeyError(f"unregistered key {key!r}")
-        return self._params[key]
-
     def optimizer_state(self, key: str):
         return self._state[key]
+
+    # -- checkpoint hooks (CheckpointMixin) ---------------------------------
+
+    engine_name = "local"
+
+    def _check_checkpointable(self):
+        if self._pending:
+            raise RuntimeError(
+                f"cannot checkpoint mid-step: keys {sorted(self._pending)} "
+                f"have pending sync pushes"
+            )
+
+    def _checkpoint_meta(self):
+        return {
+            "mode": self.mode,
+            "num_workers": self.num_workers,
+            "aggregate": self.aggregate,
+            "apply_count": dict(self.apply_count),
+        }
+
+    def _load_checkpoint_meta(self, meta):
+        for field in ("mode", "num_workers", "aggregate"):
+            if meta[field] != getattr(self, field):
+                raise ValueError(
+                    f"checkpoint was written with {field}={meta[field]!r} but "
+                    f"this store runs {field}={getattr(self, field)!r} — "
+                    f"resume semantics would differ"
+                )
+        self._pending = {}
+        self.apply_count = {k: int(v) for k, v in meta["apply_count"].items()}
 
 
 class LocalBackend:

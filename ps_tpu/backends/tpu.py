@@ -33,13 +33,18 @@ import optax
 from ps_tpu.config import Config
 from ps_tpu.parallel import collectives
 from ps_tpu.parallel.mesh import DATA_AXIS, make_mesh
-from ps_tpu.parallel.sharding import batch_sharding, param_sharding
+from ps_tpu.parallel.sharding import (
+    batch_sharding,
+    param_sharding,
+    sharded_opt_init,
+)
 
 
 from ps_tpu.backends.common import PeekMixin, make_jit_dc_apply
+from ps_tpu.checkpoint import CheckpointMixin
 
 
-class AsyncTpuServer(PeekMixin):
+class AsyncTpuServer(PeekMixin, CheckpointMixin):
     """Mesh-placed parameter server with ASYNC (stale, delay-compensated)
     apply — reference workload config 5 (SURVEY.md §4d).
 
@@ -93,7 +98,9 @@ class AsyncTpuServer(PeekMixin):
             k: jax.device_put(np.asarray(v), shardings[k]) for k, v in kv.items()
         }
         for k, v in self._params.items():
-            self._state[k] = jax.jit(self._opt.init)(v)
+            self._state[k] = sharded_opt_init(
+                self._opt.init, v, self.mesh, self.placement
+            )
             self.apply_count[k] = 0
         from ps_tpu.kv import keys as keymod
 
@@ -133,8 +140,37 @@ class AsyncTpuServer(PeekMixin):
     def optimizer_state(self, key: str):
         return self._state[key]
 
+    # -- checkpoint hooks (CheckpointMixin) ---------------------------------
+    # SURVEY.md §6: async mode checkpoints server-side state + every worker's
+    # stale snapshots + the per-worker version vector.
 
-class TpuServer(PeekMixin):
+    engine_name = "tpu_async"
+
+    def _checkpoint_meta(self):
+        return {
+            "applies": self._applies,
+            "num_workers": self.num_workers,
+            "worker_version": {str(w): v for w, v in self._worker_version.items()},
+            "apply_count": dict(self.apply_count),
+            "collective_bytes": self.collective_bytes,
+        }
+
+    def _load_checkpoint_meta(self, meta):
+        if meta["num_workers"] != self.num_workers:
+            raise ValueError(
+                f"checkpoint was written with num_workers={meta['num_workers']} "
+                f"but this store runs num_workers={self.num_workers} — "
+                f"staleness semantics would differ"
+            )
+        self._worker_version = {
+            int(w): int(v) for w, v in meta["worker_version"].items()
+        }
+        self._applies = int(meta["applies"])
+        self.apply_count = {k: int(v) for k, v in meta["apply_count"].items()}
+        self.collective_bytes = int(meta["collective_bytes"])
+
+
+class TpuServer(PeekMixin, CheckpointMixin):
     """Mesh-sharded parameter/optimizer-state store with PS semantics.
 
     Holds the parameter dict ``{key: jax.Array}`` placed per the placement
@@ -182,8 +218,12 @@ class TpuServer(PeekMixin):
             k: jax.device_put(np.asarray(v), self._shardings[k])
             for k, v in kv.items()
         }
-        # whole-tree state; sharding propagates from the sharded params
-        self._state = jax.jit(self._opt.init)(self._params)
+        # whole-tree state, placed by the same policy as the params it sits
+        # next to (ZeRO-1: moment tensors shard with their param, scalars
+        # replicate) — explicit so checkpoint restore lands identically
+        self._state = sharded_opt_init(
+            self._opt.init, self._params, self.mesh, self.placement
+        )
 
         # No donation here: this apply backs the per-key/push_pull
         # compatibility path, whose callers may legitimately hold pulled
@@ -261,6 +301,28 @@ class TpuServer(PeekMixin):
             self._state,
             is_leaf=lambda x: isinstance(x, dict) and key in x,
         )
+
+    # -- checkpoint hooks (CheckpointMixin) ---------------------------------
+
+    engine_name = "tpu_sync"
+
+    def _check_checkpointable(self):
+        if self._staged:
+            raise RuntimeError(
+                f"cannot checkpoint mid-step: keys {sorted(self._staged)} "
+                f"are staged but unapplied"
+            )
+
+    def _checkpoint_meta(self):
+        return {
+            "apply_count": self.apply_count,
+            "collective_bytes": self.collective_bytes,
+        }
+
+    def _load_checkpoint_meta(self, meta):
+        self._staged = {}
+        self.apply_count = int(meta["apply_count"])
+        self.collective_bytes = int(meta["collective_bytes"])
 
     # -- internals for the fused train step ---------------------------------
 

@@ -1,0 +1,245 @@
+"""Checkpoint/resume for every PS mode (orbax-backed).
+
+The reference family checkpoints server-side state — parameters plus the
+per-key optimizer state living next to them — so a resumed run continues as
+if never interrupted (SURVEY.md §6 "Checkpoint/resume"). ps_tpu saves exactly
+that, per backend:
+
+- **sync local**: per-key params + per-key optax states.
+- **sync mesh**: the sharded param pytree + whole-tree optax state; orbax
+  writes/reads per shard, and restore targets carry the live shardings, so a
+  checkpoint restores straight onto the mesh without a host round-trip.
+- **async**: params, per-key states, every worker's stale parameter
+  snapshots and cached pulls, and the version vector (``worker_version`` +
+  total applies) — the resumed run reproduces the exact staleness each
+  worker would have seen.
+- **sparse tables**: the row-sharded table + per-row optimizer state
+  (SparseEmbedding.save/restore).
+
+Layout under ``<path>/``: orbax pytree checkpoint in ``arrays-<id>/`` plus a
+JSON sidecar ``meta.json`` naming it. The meta write is the commit point:
+arrays land in a fresh uniquely-named directory first, then ``meta.json`` is
+atomically replaced to point at it — a crash mid-save leaves the previous
+checkpoint fully intact (old meta → old arrays). Superseded array dirs are
+garbage-collected after the commit.
+
+Optimizer-state pytrees are stored as *flat leaf lists* (optax states are
+NamedTuples, whose structure the live engine already holds — storing flat
+sidesteps any container-type round-trip mismatch and makes the checkpoint
+format optimizer-agnostic).
+
+Restore contract: call after registration (``KVStore.init(params)`` /
+``SparseEmbedding.init(...)``) so shapes, shardings and optimizer wiring
+exist; restore then overwrites values in place. Resume is bit-identical —
+asserted by tests/test_checkpoint.py for all three modes.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import uuid
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+
+_META_FILE = "meta.json"
+_ARRAYS_PREFIX = "arrays-"
+
+
+# -- low-level one-checkpoint IO ---------------------------------------------
+
+
+def _checkpointer():
+    import orbax.checkpoint as ocp
+
+    return ocp.PyTreeCheckpointer()
+
+
+def save(path: str, arrays: Any, meta: Dict[str, Any]) -> None:
+    """Write one checkpoint: an orbax pytree of arrays + a JSON sidecar.
+
+    Crash-safe: arrays are written to a fresh ``arrays-<id>/`` directory and
+    only then does an atomic ``meta.json`` replace point the checkpoint at
+    them; a crash anywhere mid-save leaves the previous checkpoint valid.
+    """
+    path = os.path.abspath(path)
+    os.makedirs(path, exist_ok=True)
+    arrays_dir = _ARRAYS_PREFIX + uuid.uuid4().hex[:8]
+    _checkpointer().save(os.path.join(path, arrays_dir), arrays, force=True)
+    meta = dict(meta)
+    meta["arrays_dir"] = arrays_dir
+    tmp = os.path.join(path, _META_FILE + ".tmp")
+    with open(tmp, "w") as f:
+        json.dump(meta, f, indent=1, sort_keys=True)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, os.path.join(path, _META_FILE))  # commit point
+    # make the rename durable before deleting the superseded arrays — without
+    # this a power loss could persist the rmtree but not the new meta
+    dir_fd = os.open(path, os.O_RDONLY)
+    try:
+        os.fsync(dir_fd)
+    finally:
+        os.close(dir_fd)
+    for d in os.listdir(path):
+        if d.startswith(_ARRAYS_PREFIX) and d != arrays_dir:
+            shutil.rmtree(os.path.join(path, d), ignore_errors=True)
+
+
+def read_meta(path: str) -> Dict[str, Any]:
+    with open(os.path.join(os.path.abspath(path), _META_FILE)) as f:
+        return json.load(f)
+
+
+def restore(path: str, abstract: Any, meta: Optional[Dict[str, Any]] = None) -> Any:
+    """Restore the array pytree; each leaf adopts the sharding its abstract
+    counterpart (a ``jax.ShapeDtypeStruct`` with ``.sharding``) carries."""
+    import orbax.checkpoint as ocp
+
+    if meta is None:
+        meta = read_meta(path)
+    restore_args = ocp.checkpoint_utils.construct_restore_args(abstract)
+    out = _checkpointer().restore(
+        os.path.join(os.path.abspath(path), meta["arrays_dir"]),
+        args=ocp.args.PyTreeRestore(item=abstract, restore_args=restore_args),
+    )
+
+    # orbax restores some small/scalar leaves onto the default device only;
+    # re-place anything that missed its target sharding
+    def replace(ab, x):
+        want = getattr(ab, "sharding", None)
+        if want is not None and isinstance(x, jax.Array) and x.sharding != want:
+            return jax.device_put(x, want)
+        return x
+
+    return jax.tree_util.tree_map(replace, abstract, out)
+
+
+# -- flat-leaf helpers (structure-free storage of optax states) --------------
+
+
+def flatten_leaves(tree: Any) -> Dict[str, Any]:
+    """Pytree -> index-keyed flat dict (storage form; structure lives in the
+    engine, not the checkpoint)."""
+    return {f"{i:05d}": leaf for i, leaf in enumerate(jax.tree_util.tree_leaves(tree))}
+
+
+def unflatten_like(live_tree: Any, flat: Dict[str, Any]) -> Any:
+    """Rebuild a pytree with ``live_tree``'s structure from a flat dict."""
+    treedef = jax.tree_util.tree_structure(live_tree)
+    leaves = [flat[f"{i:05d}"] for i in range(len(flat))]
+    return jax.tree_util.tree_unflatten(treedef, leaves)
+
+
+def abstract_like(tree: Any) -> Any:
+    """Map live arrays to ShapeDtypeStructs carrying their shardings (the
+    restore targets orbax places shards onto)."""
+
+    def leaf(x):
+        if isinstance(x, jax.Array):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
+        if isinstance(x, np.ndarray):
+            return jax.ShapeDtypeStruct(x.shape, x.dtype)
+        return x
+
+    return jax.tree_util.tree_map(leaf, tree)
+
+
+# -- stale-snapshot key encoding (async worker snapshots) --------------------
+
+
+def encode_stale_key(worker: int, key: str) -> str:
+    return f"{worker}::{key}"
+
+
+def decode_stale_key(s: str):
+    w, key = s.split("::", 1)
+    return int(w), key
+
+
+# -- shared engine checkpoint surface ----------------------------------------
+
+
+class CheckpointMixin:
+    """state_dict/abstract_state_dict/load_state_dict shared by all server
+    engines (single source of truth, like PeekMixin): params + flat optimizer
+    state + async stale snapshots, with engine hooks for mode-specific
+    counters. ``engine_name`` tags the checkpoint so a restore into the
+    wrong mode/backend fails with a clear error instead of a deep KeyError.
+    """
+
+    engine_name = "engine"
+
+    # -- engine hooks --------------------------------------------------------
+
+    def _check_checkpointable(self) -> None:
+        """Raise if mid-step state would be lost (pending/staged pushes)."""
+
+    def _checkpoint_meta(self) -> Dict[str, Any]:
+        """Engine-specific JSON-able counters (versions, apply counts)."""
+        return {}
+
+    def _load_checkpoint_meta(self, meta: Dict[str, Any]) -> None:
+        """Adopt the counters written by :meth:`_checkpoint_meta`."""
+
+    # -- shared implementation ----------------------------------------------
+
+    def state_dict(self):
+        self._check_checkpointable()
+        stale = getattr(self, "_stale", None) or {}
+        arrays = {
+            "params": dict(self._params),
+            "opt": flatten_leaves(self._state),
+            "stale": {
+                encode_stale_key(w, k): v for (w, k), v in stale.items()
+            },
+        }
+        meta = {
+            "engine": self.engine_name,
+            "stale_keys": sorted(arrays["stale"]),
+            # structure fingerprint (NamedTuple type names included): the one
+            # mismatch shapes alone can't catch is a different optimizer with
+            # the same leaf shapes (momentum vs adagrad)
+            "opt_structure": str(jax.tree_util.tree_structure(self._state)),
+        }
+        meta.update(self._checkpoint_meta())
+        return arrays, meta
+
+    def abstract_state_dict(self, meta):
+        ab_params = abstract_like(dict(self._params))
+        return {
+            "params": ab_params,
+            "opt": abstract_like(flatten_leaves(self._state)),
+            "stale": {
+                s: ab_params[decode_stale_key(s)[1]]
+                for s in meta.get("stale_keys", [])
+            },
+        }
+
+    def load_state_dict(self, arrays, meta):
+        if meta.get("engine") != self.engine_name:
+            raise ValueError(
+                f"checkpoint was written by engine {meta.get('engine')!r} but "
+                f"this store runs {self.engine_name!r} — backend/mode mismatch"
+            )
+        if set(arrays["params"]) != set(self._params):
+            raise ValueError("checkpoint keys do not match registered keys")
+        live_structure = str(jax.tree_util.tree_structure(self._state))
+        if meta.get("opt_structure", live_structure) != live_structure:
+            raise ValueError(
+                "checkpoint optimizer state does not match this store's "
+                "optimizer — restore with the optimizer the checkpoint was "
+                f"saved with (saved {meta['opt_structure']!r}, "
+                f"live {live_structure!r})"
+            )
+        self._params = dict(arrays["params"])
+        self._state = unflatten_like(self._state, arrays["opt"])
+        if hasattr(self, "_stale"):
+            self._stale = {
+                decode_stale_key(s): v for s, v in arrays["stale"].items()
+            }
+        self._load_checkpoint_meta(meta)

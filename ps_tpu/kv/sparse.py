@@ -47,7 +47,6 @@ from jax import shard_map
 
 from ps_tpu.api import current_context
 from ps_tpu.optim.rowwise import make_rowwise
-from ps_tpu.parallel import collectives
 from ps_tpu.parallel.mesh import DATA_AXIS
 
 
@@ -232,16 +231,79 @@ class SparseEmbedding:
         self._account_push(ids.shape[0])
 
     def _account_push(self, n_ids: int) -> None:
-        payload = {"g": np.zeros((n_ids, self.dim + 1), np.float32)}
+        # arithmetic only — each routed row is (id:int32 + dim f32 grads)
+        row_bytes = 4 * (self.dim + 1)
+        if self.k <= 1:
+            return
         if self.exchange == "gather":
-            self.collective_bytes += collectives.all_gather_bytes(payload, self.k)
+            payload = n_ids * row_bytes
         else:
             cap = int(math.ceil(n_ids / self.k / self.k * self.capacity_factor))
-            bucket = {"g": np.zeros((self.k * cap, self.dim + 1), np.float32)}
-            self.collective_bytes += collectives.all_to_all_bytes(bucket, self.k)
+            payload = self.k * cap * row_bytes
+        self.collective_bytes += int(payload * (self.k - 1) / self.k)
 
     def state(self):
         return self._state
+
+    # -- checkpoint/resume ---------------------------------------------------
+
+    def save(self, path: str) -> None:
+        """Checkpoint the row-sharded table + per-row optimizer state (the
+        reference server's sparse-table state; SURVEY.md §6)."""
+        from ps_tpu import checkpoint as ckpt
+
+        arrays = {
+            "table": self.table,
+            "opt": ckpt.flatten_leaves(self._state),
+        }
+        meta = {
+            "engine": "sparse",
+            "num_rows": self.num_rows,
+            "dim": self.dim,
+            "dtype": jnp.dtype(self.dtype).name,
+            "push_count": self.push_count,
+            "bytes_pushed": self.bytes_pushed,
+            "bytes_pulled": self.bytes_pulled,
+            "collective_bytes": self.collective_bytes,
+        }
+        ckpt.save(path, arrays, meta)
+
+    def restore(self, path: str) -> jax.Array:
+        """Restore a checkpoint written by :meth:`save`. Call after ``init``
+        (same num_rows/dim/optimizer/mesh) — the restored shards land
+        directly on the live row sharding. Returns the restored table."""
+        from ps_tpu import checkpoint as ckpt
+
+        if self._table is None:
+            raise RuntimeError("SparseEmbedding.init must be called before restore")
+        meta = ckpt.read_meta(path)
+        if meta.get("engine") != "sparse":
+            raise ValueError(
+                f"checkpoint was written by engine {meta.get('engine')!r}, "
+                f"not a sparse table"
+            )
+        if (meta["num_rows"], meta["dim"]) != (self.num_rows, self.dim):
+            raise ValueError(
+                f"checkpoint table is ({meta['num_rows']}, {meta['dim']}), "
+                f"this embedding is ({self.num_rows}, {self.dim})"
+            )
+        if meta["dtype"] != jnp.dtype(self.dtype).name:
+            raise ValueError(
+                f"checkpoint table dtype is {meta['dtype']}, this embedding "
+                f"is {jnp.dtype(self.dtype).name} — restore would silently cast"
+            )
+        abstract = {
+            "table": ckpt.abstract_like(self.table),
+            "opt": ckpt.abstract_like(ckpt.flatten_leaves(self._state)),
+        }
+        arrays = ckpt.restore(path, abstract, meta)
+        self._table = arrays["table"]
+        self._state = ckpt.unflatten_like(self._state, arrays["opt"])
+        self.push_count = int(meta["push_count"])
+        self.bytes_pushed = int(meta["bytes_pushed"])
+        self.bytes_pulled = int(meta["bytes_pulled"])
+        self.collective_bytes = int(meta["collective_bytes"])
+        return self._table
 
 
 def _a2a_route(ids, grads, k: int, axis: str, rows_per_shard: int,
@@ -251,7 +313,10 @@ def _a2a_route(ids, grads, k: int, axis: str, rows_per_shard: int,
     bucket slots stay id=-1 / grad=0)."""
     n = ids.shape[0]
     cap = int(math.ceil(n / k * capacity_factor))
-    dest = jnp.clip(ids // rows_per_shard, 0, k - 1)
+    # filler ids (-1, from push padding) go to overflow destination k — the
+    # scatter's mode='drop' discards them — so they never consume shard 0's
+    # bucket capacity
+    dest = jnp.where(ids < 0, k, jnp.clip(ids // rows_per_shard, 0, k - 1))
     # slot of each row within its destination bucket = rank among same-dest rows
     order = jnp.argsort(dest)  # stable: groups rows by destination
     ids_s, grads_s, dest_s = ids[order], grads[order], dest[order]

@@ -238,7 +238,7 @@ class KVStore:
         return run
 
     def make_async_step(self, loss_fn, has_aux: bool = False):
-        """Build the async worker cycle ``run(batch, worker=w, *extra)``.
+        """Build the async worker cycle ``run(batch, *extra, worker=w)``.
 
         The reference's async flow (SURVEY.md §4d): a worker computes
         gradients against the parameters it LAST pulled — stale by however
@@ -256,7 +256,7 @@ class KVStore:
             )
         grad_fn = jax.jit(jax.value_and_grad(loss_fn, has_aux=has_aux))
 
-        def run(batch, worker: int = 0, *extra):
+        def run(batch, *extra, worker: int = 0):
             params = self._async_params.get(worker)
             if params is None:
                 params = self.pull_all(worker=worker)
@@ -287,6 +287,92 @@ class KVStore:
             return batch
         sharding = self._ctx.backend.batch_sharding()
         return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), batch)
+
+    # -- checkpoint/resume --------------------------------------------------
+
+    def save(self, path: str) -> None:
+        """Checkpoint the full server state to ``path`` (orbax pytree +
+        JSON sidecar): params, optimizer state, and — in async mode — every
+        worker's stale snapshot and the version vector. See
+        ps_tpu/checkpoint.py for the format; restore with :meth:`restore`
+        after an identical ``init``."""
+        from ps_tpu import checkpoint as ckpt
+
+        self._require_init()
+        arrays, meta = self._engine.state_dict()
+        # async workers' cached pulls, saved exactly (not inferred): a worker
+        # that pulled manually without caching must resume cache-less too.
+        # A cached leaf is usually the very array recorded as that worker's
+        # stale snapshot (pull_all does both) — store those as references
+        # into the stale group instead of a second copy.
+        stale = getattr(self._engine, "_stale", {})
+        cache, aliased = {}, []
+        for w, params in self._async_params.items():
+            kv, _ = keymod.flatten_with_keys(params)
+            for k, v in kv.items():
+                s = ckpt.encode_stale_key(w, k)
+                if stale.get((w, k)) is v:
+                    aliased.append(s)
+                else:
+                    cache[s] = v
+        arrays["worker_cache"] = cache
+        meta["store"] = {
+            "step": self.step,
+            "bytes_pushed": self.bytes_pushed,
+            "bytes_pulled": self.bytes_pulled,
+            "key_order": self._key_order,
+            "cache_keys": sorted(cache),
+            "cache_stale_aliases": sorted(aliased),
+        }
+        ckpt.save(path, arrays, meta)
+
+    def restore(self, path: str) -> Any:
+        """Restore a checkpoint written by :meth:`save` into this store.
+
+        Must be called after ``init(params)`` with the same parameter
+        structure and optimizer, so shardings and state wiring exist; every
+        value is then overwritten in place and training resumes
+        bit-identically (tests/test_checkpoint.py). Returns the restored
+        parameter pytree."""
+        from ps_tpu import checkpoint as ckpt
+
+        self._require_init()
+        meta = ckpt.read_meta(path)
+        saved_order = meta["store"]["key_order"]
+        if saved_order != self._key_order:
+            diff = sorted(set(saved_order) ^ set(self._key_order))[:4]
+            raise ValueError(
+                f"checkpoint parameter keys do not match this store: saved "
+                f"{len(saved_order)} keys, registered {len(self._key_order)}"
+                + (f"; differing keys include {diff}" if diff
+                   else "; same keys in a different order")
+            )
+        abstract = self._engine.abstract_state_dict(meta)
+        ab_params = abstract["params"]
+        abstract["worker_cache"] = {
+            s: ab_params[ckpt.decode_stale_key(s)[1]]
+            for s in meta["store"]["cache_keys"]
+        }
+        arrays = ckpt.restore(path, abstract, meta)
+        cache = arrays.pop("worker_cache")
+        self._engine.load_state_dict(arrays, meta)
+        st = meta["store"]
+        self.step = int(st["step"])
+        self.bytes_pushed = int(st["bytes_pushed"])
+        self.bytes_pulled = int(st["bytes_pulled"])
+        stale = getattr(self._engine, "_stale", {})
+        by_worker: Dict[int, Dict[str, Any]] = {}
+        for s, v in cache.items():
+            w, k = ckpt.decode_stale_key(s)
+            by_worker.setdefault(w, {})[k] = v
+        for s in st.get("cache_stale_aliases", []):
+            w, k = ckpt.decode_stale_key(s)
+            by_worker.setdefault(w, {})[k] = stale[(w, k)]
+        self._async_params = {
+            w: keymod.unflatten(self._treedef, kv, self._key_order)
+            for w, kv in by_worker.items()
+        }
+        return self.params()
 
     # -- introspection ------------------------------------------------------
 

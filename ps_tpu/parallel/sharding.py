@@ -52,3 +52,25 @@ def param_sharding(mesh: Mesh, leaf: Any, placement: str,
 def batch_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:
     """Shard the leading (batch) dimension over the data axis."""
     return NamedSharding(mesh, P(axis))
+
+
+def sharded_opt_init(opt_init, params: Any, mesh: Mesh, placement: str) -> Any:
+    """Initialize optimizer state with EXPLICIT placement.
+
+    ``jit(opt.init)`` alone leaves output shardings to the compiler, which
+    (observed on the pinned jax) puts every state leaf on one device —
+    uncommitted, so it happens to run, but a checkpoint restore brings the
+    same leaves back *committed* and the placement mismatch becomes an
+    error. Instead the state is placed by the same policy as the params it
+    sits next to: moment tensors (param-shaped) shard exactly like their
+    param under 'sharded' (ZeRO-1 — state partitioned across servers),
+    scalars (adam's ``count``) replicate. Live and restored placement are
+    then identical by construction.
+    """
+    import jax
+
+    shapes = jax.eval_shape(opt_init, params)
+    shardings = jax.tree_util.tree_map(
+        lambda leaf: param_sharding(mesh, leaf, placement), shapes
+    )
+    return jax.jit(opt_init, out_shardings=shardings)(params)

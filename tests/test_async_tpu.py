@@ -118,7 +118,7 @@ def test_make_async_step_trains():
     for step in range(40):
         for w, stream in enumerate(streams):
             images, labels = next(stream)
-            loss = run((jnp.asarray(images), jnp.asarray(labels)), w)
+            loss = run((jnp.asarray(images), jnp.asarray(labels)), worker=w)
             losses.append(float(loss))
     # with 2 round-robin workers, each cycle is stale by one version
     assert store.staleness(0) == 1
