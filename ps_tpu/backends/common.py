@@ -28,6 +28,32 @@ def make_jit_dc_apply(opt: optax.GradientTransformation):
     return jax.jit(_apply_dc, static_argnums=(4,))
 
 
+def make_jit_dc_apply_tree(opt: optax.GradientTransformation):
+    """Fused whole-tree async apply: ONE XLA dispatch per push_all.
+
+    The per-key loop unrolls at trace time into a single program (the
+    bucketing pass SURVEY.md §3 row 11 reserves for the async host path —
+    XLA fuses the per-key DC corrections and updates instead of the host
+    dispatching one apply per key). Numerically identical to the per-key
+    sequence: keys are independent under per-tensor optimizers, asserted by
+    tests/test_async_stress.py.
+
+    ``fn(params, states, grads, stales, lam) -> (params, states)`` over
+    ``{key: ...}`` dicts with per-key optimizer states.
+    """
+
+    def _apply_dc_tree(params, states, grads, stales, lam):
+        new_p, new_s = {}, {}
+        for k in params:  # unrolled at trace time
+            g = delay_compensate(grads[k], params[k], stales[k], lam)
+            updates, s = opt.update(g, states[k], params[k])
+            new_p[k] = optax.apply_updates(params[k], updates)
+            new_s[k] = s
+        return new_p, new_s
+
+    return jax.jit(_apply_dc_tree, static_argnums=(4,))
+
+
 class PeekMixin:
     """Side-effect-free key read for introspection (KVStore.params()):
     never records async pull snapshots or checks aggregation state."""

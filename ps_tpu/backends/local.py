@@ -26,7 +26,11 @@ from typing import Any, Dict, Optional
 import jax
 import optax
 
-from ps_tpu.backends.common import PeekMixin, make_jit_dc_apply
+from ps_tpu.backends.common import (
+    PeekMixin,
+    make_jit_dc_apply,
+    make_jit_dc_apply_tree,
+)
 from ps_tpu.checkpoint import CheckpointMixin
 from ps_tpu.config import Config
 
@@ -36,6 +40,9 @@ class LocalServer(PeekMixin, CheckpointMixin):
 
     def __init__(self, optimizer: optax.GradientTransformation, num_workers: int,
                  mode: str = "sync", aggregate: str = "mean", dc_lambda: float = 0.04):
+        import collections
+        import threading
+
         if aggregate not in ("mean", "sum"):
             raise ValueError("aggregate must be 'mean' or 'sum'")
         self._opt = optimizer
@@ -50,6 +57,13 @@ class LocalServer(PeekMixin, CheckpointMixin):
         # async: (worker_id, key) -> param snapshot at that worker's last pull
         self._stale: Dict[tuple, jax.Array] = {}
         self.apply_count: Dict[str, int] = {}
+        # async version vector: tree-granularity, mirroring AsyncTpuServer
+        self._version = 0
+        self._partial_applies = 0
+        self._worker_version: Dict[int, int] = {}
+        self.staleness_hist = collections.Counter()
+        # serializes applies/pulls, like the reference server's apply loop
+        self._lock = threading.RLock()
 
         def _apply(param, state, grad):
             updates, new_state = self._opt.update(grad, state, param)
@@ -57,6 +71,7 @@ class LocalServer(PeekMixin, CheckpointMixin):
 
         self._jit_apply = jax.jit(_apply)
         self._jit_apply_dc = make_jit_dc_apply(optimizer)
+        self._jit_apply_dc_tree = make_jit_dc_apply_tree(optimizer)
 
     # -- registration -------------------------------------------------------
 
@@ -77,26 +92,52 @@ class LocalServer(PeekMixin, CheckpointMixin):
             raise KeyError(f"unregistered key {key!r}")
         if not (0 <= worker < self.num_workers):
             raise ValueError(f"worker {worker} out of range [0, {self.num_workers})")
-        if self.mode == "async":
-            self._apply_async(key, grad, worker)
+        with self._lock:
+            if self.mode == "async":
+                self._apply_async(key, grad, worker)
+                return
+            slot = self._pending.setdefault(key, {})
+            if worker in slot:
+                raise RuntimeError(
+                    f"worker {worker} pushed key {key!r} twice before aggregation fired"
+                )
+            slot[worker] = grad
+            if len(slot) == self.num_workers:
+                agg = slot[0]
+                for w in range(1, self.num_workers):
+                    agg = jax.tree_util.tree_map(lambda a, b: a + b, agg, slot[w])
+                if self.aggregate == "mean" and self.num_workers > 1:
+                    agg = jax.tree_util.tree_map(lambda a: a / self.num_workers, agg)
+                self._params[key], self._state[key] = self._jit_apply(
+                    self._params[key], self._state[key], agg
+                )
+                self.apply_count[key] += 1
+                del self._pending[key]
+
+    def push_tree(self, grads_kv: Dict[str, jax.Array], worker: int = 0) -> None:
+        """Whole-tree push. Async: ONE fused DC apply for every key (same
+        math as per-key pushes — keys are independent). Sync: the per-key
+        staging protocol in a loop (aggregation fires per key)."""
+        if self.mode != "async":
+            for k, g in grads_kv.items():
+                self.push(k, g, worker=worker)
             return
-        slot = self._pending.setdefault(key, {})
-        if worker in slot:
-            raise RuntimeError(
-                f"worker {worker} pushed key {key!r} twice before aggregation fired"
+        if set(grads_kv) != set(self._params):
+            raise ValueError("gradient keys do not match registered keys")
+        if not (0 <= worker < self.num_workers):
+            raise ValueError(f"worker {worker} out of range [0, {self.num_workers})")
+        with self._lock:
+            stales = {
+                k: self._stale.get((worker, k), self._params[k])
+                for k in self._params
+            }
+            self._params, self._state = self._jit_apply_dc_tree(
+                self._params, self._state, grads_kv, stales, self.dc_lambda
             )
-        slot[worker] = grad
-        if len(slot) == self.num_workers:
-            agg = slot[0]
-            for w in range(1, self.num_workers):
-                agg = jax.tree_util.tree_map(lambda a, b: a + b, agg, slot[w])
-            if self.aggregate == "mean" and self.num_workers > 1:
-                agg = jax.tree_util.tree_map(lambda a: a / self.num_workers, agg)
-            self._params[key], self._state[key] = self._jit_apply(
-                self._params[key], self._state[key], agg
-            )
-            self.apply_count[key] += 1
-            del self._pending[key]
+            for k in grads_kv:
+                self.apply_count[k] += 1
+            self.staleness_hist[self.staleness(worker)] += 1
+            self._version += 1
 
     def _apply_async(self, key: str, grad: jax.Array, worker: int) -> None:
         stale = self._stale.get((worker, key), self._params[key])
@@ -104,19 +145,41 @@ class LocalServer(PeekMixin, CheckpointMixin):
             self._params[key], self._state[key], grad, stale, self.dc_lambda
         )
         self.apply_count[key] += 1
+        self._partial_applies += 1
+        if self._partial_applies >= len(self._params):
+            self._partial_applies = 0
+            self.staleness_hist[self.staleness(worker)] += 1
+            self._version += 1
 
     def pull(self, key: str, worker: int = 0) -> jax.Array:
         if key not in self._params:
             raise KeyError(f"unregistered key {key!r}")
-        if self.mode == "sync" and key in self._pending:
-            got = sorted(self._pending[key])
-            raise RuntimeError(
-                f"pull({key!r}) would block: only workers {got} of "
-                f"{self.num_workers} have pushed this step"
-            )
-        if self.mode == "async":
-            self._stale[(worker, key)] = self._params[key]
-        return self._params[key]
+        with self._lock:
+            if self.mode == "sync" and key in self._pending:
+                got = sorted(self._pending[key])
+                raise RuntimeError(
+                    f"pull({key!r}) would block: only workers {got} of "
+                    f"{self.num_workers} have pushed this step"
+                )
+            if self.mode == "async":
+                self._stale[(worker, key)] = self._params[key]
+                self._worker_version[worker] = self._version
+            return self._params[key]
+
+    @property
+    def version(self) -> int:
+        """Async mode: server version in whole-model steps."""
+        return self._version
+
+    def staleness(self, worker: int) -> int:
+        """Async mode: whole-model versions since this worker's last pull."""
+        return self._version - self._worker_version.get(worker, 0)
+
+    def pull_tree(self, worker: int = 0) -> Dict[str, jax.Array]:
+        """Atomic whole-tree pull (async: one consistent snapshot + stale
+        record; sync: per-key blocked-pull checks under one lock)."""
+        with self._lock:
+            return {k: self.pull(k, worker=worker) for k in self._params}
 
     def optimizer_state(self, key: str):
         return self._state[key]
@@ -138,9 +201,15 @@ class LocalServer(PeekMixin, CheckpointMixin):
             "num_workers": self.num_workers,
             "aggregate": self.aggregate,
             "apply_count": dict(self.apply_count),
+            "version": self._version,
+            "partial_applies": self._partial_applies,
+            "worker_version": {str(w): v for w, v in self._worker_version.items()},
+            "staleness_hist": {str(t): n for t, n in self.staleness_hist.items()},
         }
 
     def _load_checkpoint_meta(self, meta):
+        import collections
+
         for field in ("mode", "num_workers", "aggregate"):
             if meta[field] != getattr(self, field):
                 raise ValueError(
@@ -150,6 +219,15 @@ class LocalServer(PeekMixin, CheckpointMixin):
                 )
         self._pending = {}
         self.apply_count = {k: int(v) for k, v in meta["apply_count"].items()}
+        # .get defaults accept checkpoints from before version accounting
+        self._version = int(meta.get("version", 0))
+        self._partial_applies = int(meta.get("partial_applies", 0))
+        self._worker_version = {
+            int(w): int(v) for w, v in meta.get("worker_version", {}).items()
+        }
+        self.staleness_hist = collections.Counter(
+            {int(t): int(n) for t, n in meta.get("staleness_hist", {}).items()}
+        )
 
 
 class LocalBackend:

@@ -40,7 +40,11 @@ from ps_tpu.parallel.sharding import (
 )
 
 
-from ps_tpu.backends.common import PeekMixin, make_jit_dc_apply
+from ps_tpu.backends.common import (
+    PeekMixin,
+    make_jit_dc_apply,
+    make_jit_dc_apply_tree,
+)
 from ps_tpu.checkpoint import CheckpointMixin
 
 
@@ -57,9 +61,16 @@ class AsyncTpuServer(PeekMixin, CheckpointMixin):
     intra-node GPU set (the grad psum = NCCL reduce), while the *logical*
     workers (``Config.num_workers``) are the asynchronously-pushing nodes.
 
-    Version accounting: ``version`` advances once per full-tree worth of
-    per-key applies; ``worker_version[w]`` records the version worker w last
-    pulled, so ``staleness(w) = version_at_push - worker_version[w]``.
+    Version accounting is at TREE granularity: ``version`` advances once per
+    whole-model apply (a ``push_tree``, or a full tree's worth of per-key
+    pushes); ``worker_version[w]`` records the version worker w last pulled,
+    so ``staleness(w) = version_at_push - worker_version[w]``. Partial-tree
+    pushes never produce fractional versions.
+
+    Thread safety: the apply/pull paths serialize on a server-side lock —
+    the TPU translation of the reference server's sequential per-key apply
+    loop — so host threads can drive workers concurrently
+    (tests/test_async_stress.py).
     """
 
     mode = "async"
@@ -67,6 +78,9 @@ class AsyncTpuServer(PeekMixin, CheckpointMixin):
     def __init__(self, optimizer: optax.GradientTransformation, mesh,
                  num_workers: int, placement: str = "replicated",
                  dc_lambda: float = 0.04):
+        import collections
+        import threading
+
         self._opt = optimizer
         self.mesh = mesh
         self.placement = placement
@@ -76,17 +90,21 @@ class AsyncTpuServer(PeekMixin, CheckpointMixin):
         self._state: Dict[str, Any] = {}
         self._stale: Dict[tuple, jax.Array] = {}
         self._worker_version: Dict[int, int] = {}
-        self._applies = 0
+        self._applies = 0          # total per-key applies (any granularity)
+        self._version = 0          # whole-model versions
+        self._partial_applies = 0  # per-key applies since last version bump
         self.apply_count: Dict[str, int] = {}
         self.collective_bytes = 0
+        self.staleness_hist = collections.Counter()  # τ -> whole-tree pushes
+        self._lock = threading.RLock()
 
         self._jit_apply_dc = make_jit_dc_apply(optimizer)
+        self._jit_apply_dc_tree = make_jit_dc_apply_tree(optimizer)
 
     @property
     def version(self) -> int:
-        """Server version in whole-model steps (total per-key applies divided
-        by the key count)."""
-        return self._applies // max(len(self._params), 1)
+        """Server version in whole-model steps."""
+        return self._version
 
     def register_tree(self, kv: Dict[str, Any], treedef, key_order: List[str]):
         if self._params:
@@ -109,28 +127,74 @@ class AsyncTpuServer(PeekMixin, CheckpointMixin):
     def keys(self):
         return list(self._params)
 
-    def push(self, key: str, grad: Any, worker: int = 0) -> None:
-        if key not in self._params:
-            raise KeyError(f"unregistered key {key!r}")
+    def _check_worker(self, worker: int) -> None:
         if not (0 <= worker < self.num_workers):
             raise ValueError(f"worker {worker} out of range [0, {self.num_workers})")
-        stale = self._stale.get((worker, key), self._params[key])
-        self._params[key], self._state[key] = self._jit_apply_dc(
-            self._params[key], self._state[key], grad, stale, self.dc_lambda
-        )
-        self.apply_count[key] += 1
-        self._applies += 1
-        k = self.mesh.shape[DATA_AXIS]
-        self.collective_bytes += collectives.allreduce_bytes(
-            {key: self._params[key]}, k
-        )
+
+    def push(self, key: str, grad: Any, worker: int = 0) -> None:
+        """Per-key compatibility path: one jitted DC apply per key. A full
+        tree's worth of per-key pushes advances the version by one."""
+        if key not in self._params:
+            raise KeyError(f"unregistered key {key!r}")
+        self._check_worker(worker)
+        with self._lock:
+            stale = self._stale.get((worker, key), self._params[key])
+            self._params[key], self._state[key] = self._jit_apply_dc(
+                self._params[key], self._state[key], grad, stale, self.dc_lambda
+            )
+            self.apply_count[key] += 1
+            self._applies += 1
+            self._partial_applies += 1
+            if self._partial_applies >= len(self._params):
+                self._partial_applies = 0
+                self.staleness_hist[self.staleness(worker)] += 1
+                self._version += 1
+            k = self.mesh.shape[DATA_AXIS]
+            self.collective_bytes += collectives.allreduce_bytes(
+                {key: self._params[key]}, k
+            )
+
+    def push_tree(self, grads_kv: Dict[str, Any], worker: int = 0) -> None:
+        """Fused whole-tree async push: ONE XLA dispatch applies every key's
+        DC-corrected update (the async bucketing pass — SURVEY.md §3 row 11).
+        Numerically identical to pushing each key (keys are independent under
+        per-tensor optimizers)."""
+        if set(grads_kv) != set(self._params):
+            raise ValueError("gradient keys do not match registered keys")
+        self._check_worker(worker)
+        with self._lock:
+            stales = {
+                k: self._stale.get((worker, k), self._params[k])
+                for k in self._params
+            }
+            self._params, self._state = self._jit_apply_dc_tree(
+                self._params, self._state, grads_kv, stales, self.dc_lambda
+            )
+            for k in grads_kv:
+                self.apply_count[k] += 1
+            self._applies += len(grads_kv)
+            self.staleness_hist[self.staleness(worker)] += 1
+            self._version += 1
+            k = self.mesh.shape[DATA_AXIS]
+            self.collective_bytes += collectives.allreduce_bytes(self._params, k)
 
     def pull(self, key: str, worker: int = 0) -> jax.Array:
         if key not in self._params:
             raise KeyError(f"unregistered key {key!r}")
-        self._stale[(worker, key)] = self._params[key]
-        self._worker_version[worker] = self.version
-        return self._params[key]
+        with self._lock:
+            self._stale[(worker, key)] = self._params[key]
+            self._worker_version[worker] = self.version
+            return self._params[key]
+
+    def pull_tree(self, worker: int = 0) -> Dict[str, Any]:
+        """Atomic whole-tree pull: the snapshot and the version record come
+        from ONE server state — a concurrent push cannot interleave between
+        two keys of the same pull (the torn-read hazard of per-key pulls)."""
+        with self._lock:
+            for k, v in self._params.items():
+                self._stale[(worker, k)] = v
+            self._worker_version[worker] = self.version
+            return dict(self._params)
 
     def staleness(self, worker: int) -> int:
         """Whole-model versions the server advanced since this worker's last
@@ -149,6 +213,9 @@ class AsyncTpuServer(PeekMixin, CheckpointMixin):
     def _checkpoint_meta(self):
         return {
             "applies": self._applies,
+            "version": self._version,
+            "partial_applies": self._partial_applies,
+            "staleness_hist": {str(t): n for t, n in self.staleness_hist.items()},
             "num_workers": self.num_workers,
             "worker_version": {str(w): v for w, v in self._worker_version.items()},
             "apply_count": dict(self.apply_count),
@@ -156,6 +223,8 @@ class AsyncTpuServer(PeekMixin, CheckpointMixin):
         }
 
     def _load_checkpoint_meta(self, meta):
+        import collections
+
         if meta["num_workers"] != self.num_workers:
             raise ValueError(
                 f"checkpoint was written with num_workers={meta['num_workers']} "
@@ -166,6 +235,15 @@ class AsyncTpuServer(PeekMixin, CheckpointMixin):
             int(w): int(v) for w, v in meta["worker_version"].items()
         }
         self._applies = int(meta["applies"])
+        # .get defaults accept checkpoints from before tree-granularity
+        # version accounting (whose version was applies // key count)
+        self._version = int(
+            meta.get("version", self._applies // max(len(self._params), 1))
+        )
+        self._partial_applies = int(meta.get("partial_applies", 0))
+        self.staleness_hist = collections.Counter(
+            {int(t): int(n) for t, n in meta.get("staleness_hist", {}).items()}
+        )
         self.apply_count = {k: int(v) for k, v in meta["apply_count"].items()}
         self.collective_bytes = int(meta["collective_bytes"])
 
@@ -183,11 +261,8 @@ class TpuServer(PeekMixin, CheckpointMixin):
                  placement: str = "replicated", aggregate: str = "mean",
                  mode: str = "sync"):
         assert mode == "sync", "async mode is handled by AsyncTpuServer"
-        if aggregate != "mean":
-            raise NotImplementedError(
-                "the tpu backend has data-parallel mean semantics; for sum "
-                "semantics, sum (not mean) your loss over the global batch"
-            )
+        if aggregate not in ("mean", "sum"):
+            raise ValueError("aggregate must be 'mean' or 'sum'")
         self._opt = optimizer
         self.mesh = mesh
         self.placement = placement
@@ -230,8 +305,12 @@ class TpuServer(PeekMixin, CheckpointMixin):
         # arrays across steps. The fused make_step path owns its buffers
         # exclusively and donates there instead (2x transient memory here is
         # the price of the compatibility semantics).
+        scale = self.grad_scale
+
         @jax.jit
         def apply_fn(params, state, grads):
+            if scale != 1.0:
+                grads = jax.tree_util.tree_map(lambda g: g * scale, grads)
             updates, new_state = self._opt.update(grads, state, params)
             return optax.apply_updates(params, updates), new_state
 
@@ -244,6 +323,14 @@ class TpuServer(PeekMixin, CheckpointMixin):
         return list(self._params)
 
     # -- fused whole-tree update -------------------------------------------
+
+    @property
+    def grad_scale(self) -> float:
+        """Aggregation-semantics factor applied to incoming global-mean
+        gradients: 1 for 'mean'; num_workers for 'sum' (the local backend's
+        sum of per-worker grads equals the global mean times the worker
+        count when worker batches are equal — parity tested)."""
+        return float(self.num_workers) if self.aggregate == "sum" else 1.0
 
     def update_tree(self, grads_kv: Dict[str, Any]) -> Dict[str, Any]:
         """One server step: aggregate(implicit) + apply; returns new params.
@@ -295,11 +382,21 @@ class TpuServer(PeekMixin, CheckpointMixin):
         return self._params[key]
 
     def optimizer_state(self, key: str):
-        """Per-key view into the whole-tree state (PS-API compatibility)."""
+        """Per-key view into the whole-tree state (PS-API compatibility).
+
+        The whole-tree optax state embeds copies of the registered param
+        dict (mu/nu/trace), recognizable as dicts carrying EXACTLY the full
+        key set — an optimizer state field that merely happens to contain a
+        same-named entry does not match (the tree_map-on-'contains' trap)."""
+        full_keys = set(self._params)
+
+        def is_param_dict(x):
+            return isinstance(x, dict) and set(x) == full_keys
+
         return jax.tree_util.tree_map(
-            lambda leaf: leaf[key] if isinstance(leaf, dict) and key in leaf else leaf,
+            lambda leaf: leaf[key] if is_param_dict(leaf) else leaf,
             self._state,
-            is_leaf=lambda x: isinstance(x, dict) and key in x,
+            is_leaf=is_param_dict,
         )
 
     # -- checkpoint hooks (CheckpointMixin) ---------------------------------
@@ -343,6 +440,7 @@ class TpuBackend:
     def __init__(self, config: Config):
         self.config = config
         self._owns_distributed = False
+        self.failure_detector = None
         if config.coordinator_uri is not None:
             jax.distributed.initialize(
                 coordinator_address=config.coordinator_uri,
@@ -350,8 +448,44 @@ class TpuBackend:
                 process_id=config.process_id,
             )
             self._owns_distributed = True
+        if (config.heartbeat_base_port is not None
+                and config.num_processes > 1):
+            from ps_tpu.control import FailureDetector
+
+            base = config.heartbeat_base_port
+            peers = {
+                i: ("127.0.0.1", base + i)
+                for i in range(config.num_processes)
+                if i != config.process_id
+            }
+            try:
+                self.failure_detector = FailureDetector(
+                    node_id=config.process_id,
+                    peers=peers,
+                    port=base + config.process_id,
+                    interval_ms=config.heartbeat_interval_ms,
+                    timeout_ms=config.heartbeat_timeout_ms,
+                )
+                self.failure_detector.wait_for_peers()
+            except Exception:
+                # failed init must not leave beat threads running (peers
+                # would see us alive while we never joined) or the
+                # coordination service up
+                if self.failure_detector is not None:
+                    self.failure_detector.close()
+                    self.failure_detector = None
+                if self._owns_distributed:
+                    jax.distributed.shutdown()
+                    self._owns_distributed = False
+                raise
         self.mesh = make_mesh(config.mesh_shape)
         self.num_workers = self.mesh.shape.get(DATA_AXIS, 1)
+
+    def check_health(self) -> None:
+        """Raise WorkerFailureError if a peer process died (no-op when the
+        failure detector is disabled)."""
+        if self.failure_detector is not None:
+            self.failure_detector.check()
 
     def create_server(self, optimizer, mode: Optional[str] = None,
                       aggregate: str = "mean", placement: str = "replicated"):
@@ -376,6 +510,9 @@ class TpuBackend:
         return batch_sharding(self.mesh)
 
     def shutdown(self) -> None:
+        if self.failure_detector is not None:
+            self.failure_detector.close()
+            self.failure_detector = None
         if self._owns_distributed:
             jax.distributed.shutdown()
             self._owns_distributed = False

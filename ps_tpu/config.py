@@ -43,6 +43,12 @@ class Config:
       mode: 'sync' or 'async' (async = stale apply with delay compensation).
       dc_lambda: DC-ASGD delay-compensation coefficient (async mode).
       seed: global PRNG seed.
+      heartbeat_base_port: enable the control-plane failure detector for
+        multi-process runs: process i's monitor binds base_port+i and beats
+        every peer (localhost topology; multi-host deployments pass explicit
+        peers to ps_tpu.control.FailureDetector). ``None`` disables.
+      heartbeat_interval_ms / heartbeat_timeout_ms: beat cadence and the
+        silent-horizon after which a peer is declared dead.
     """
 
     backend: str = "local"
@@ -54,6 +60,9 @@ class Config:
     mode: str = "sync"
     dc_lambda: float = 0.04
     seed: int = 0
+    heartbeat_base_port: Optional[int] = None
+    heartbeat_interval_ms: int = 100
+    heartbeat_timeout_ms: int = 1000
 
     def __post_init__(self):
         if self.backend not in ("local", "tpu"):
@@ -88,5 +97,11 @@ class Config:
             kwargs["mode"] = env["PS_MODE"]
         if "PS_SEED" in env:
             kwargs["seed"] = int(env["PS_SEED"])
+        if "PS_HEARTBEAT_BASE_PORT" in env:
+            kwargs["heartbeat_base_port"] = int(env["PS_HEARTBEAT_BASE_PORT"])
+        if "PS_HEARTBEAT_INTERVAL_MS" in env:
+            kwargs["heartbeat_interval_ms"] = int(env["PS_HEARTBEAT_INTERVAL_MS"])
+        if "PS_HEARTBEAT_TIMEOUT_MS" in env:
+            kwargs["heartbeat_timeout_ms"] = int(env["PS_HEARTBEAT_TIMEOUT_MS"])
         kwargs.update(overrides)
         return cls(**kwargs)

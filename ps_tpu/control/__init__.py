@@ -1,0 +1,21 @@
+"""Host control plane: the part of the reference's van that stays host-side.
+
+Data-plane tensor traffic rides XLA collectives (SURVEY.md §3 row 9); what
+this package keeps is liveness and failure detection — heartbeats between
+the processes of a multi-process run, so a dead process surfaces as a typed
+:class:`WorkerFailureError` instead of a hung collective.
+"""
+
+from ps_tpu.control.heartbeat import (
+    FailureDetector,
+    HeartbeatClient,
+    HeartbeatServer,
+    WorkerFailureError,
+)
+
+__all__ = [
+    "FailureDetector",
+    "HeartbeatClient",
+    "HeartbeatServer",
+    "WorkerFailureError",
+]

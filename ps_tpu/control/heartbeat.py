@@ -1,0 +1,194 @@
+"""Heartbeat liveness over the native control-plane van.
+
+The reference's scheduler watches worker/server heartbeats and declares
+silent nodes dead (SURVEY.md §2 "Transport/van" row, §6 "Failure
+detection"). ps_tpu keeps the same shape, symmetric instead of
+scheduler-centric: every process runs a monitor (:class:`HeartbeatServer`)
+and beats every peer (:class:`HeartbeatClient`), so each process detects any
+peer's death locally — no single point of failure watching the watchers.
+
+The beat/recv loops live in C++ threads (ps_tpu/native/van.cpp) so a Python
+GIL pause — a long jit trace, a blocking collective — cannot stop a process
+from *beating*; only real death does. Detection polls from Python.
+"""
+
+from __future__ import annotations
+
+import ctypes
+from typing import Dict, List, Optional, Tuple
+
+from ps_tpu.native import load
+
+
+class WorkerFailureError(RuntimeError):
+    """A peer process stopped heartbeating (dead or partitioned)."""
+
+    def __init__(self, dead: List[int]):
+        self.dead = sorted(dead)
+        super().__init__(
+            f"peer process(es) {self.dead} stopped heartbeating — "
+            f"declared dead by the failure detector"
+        )
+
+
+def _lib():
+    lib = load("van")
+    lib.hb_server_start.restype = ctypes.c_void_p
+    lib.hb_server_start.argtypes = [ctypes.c_int, ctypes.c_int]
+    lib.hb_server_port.restype = ctypes.c_int
+    lib.hb_server_port.argtypes = [ctypes.c_void_p]
+    lib.hb_server_poll.restype = ctypes.c_int
+    lib.hb_server_poll.argtypes = [
+        ctypes.c_void_p, ctypes.c_int,
+        ctypes.POINTER(ctypes.c_uint32), ctypes.c_int,
+    ]
+    lib.hb_server_seq.restype = ctypes.c_uint64
+    lib.hb_server_seq.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+    lib.hb_server_stop.argtypes = [ctypes.c_void_p]
+    lib.hb_client_start.restype = ctypes.c_void_p
+    lib.hb_client_start.argtypes = [
+        ctypes.c_char_p, ctypes.c_int, ctypes.c_uint32, ctypes.c_int,
+    ]
+    lib.hb_client_stop.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+class HeartbeatServer:
+    """Liveness monitor: tracks every node that has ever beaten this port.
+
+    A node is *alive* while its beats arrive within ``timeout_ms`` and *dead*
+    once seen-then-silent longer than that.
+    """
+
+    def __init__(self, port: int = 0, timeout_ms: int = 1000):
+        self._lib = _lib()
+        self._h = self._lib.hb_server_start(port, timeout_ms)
+        if not self._h:
+            raise OSError(f"heartbeat server failed to bind port {port}")
+
+    def _require(self):
+        if not self._h:
+            raise RuntimeError("heartbeat server is closed")
+        return self._h
+
+    @property
+    def port(self) -> int:
+        return self._lib.hb_server_port(self._require())
+
+    def _poll(self, state: int) -> List[int]:
+        cap = 1024
+        buf = (ctypes.c_uint32 * cap)()
+        n = self._lib.hb_server_poll(self._require(), state, buf, cap)
+        return sorted(buf[i] for i in range(n))
+
+    def alive(self) -> List[int]:
+        return self._poll(0)
+
+    def dead(self) -> List[int]:
+        return self._poll(1)
+
+    def seq(self, node_id: int) -> int:
+        """Beats received from node_id (0 = never seen)."""
+        return int(self._lib.hb_server_seq(self._require(), node_id))
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.hb_server_stop(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class HeartbeatClient:
+    """Beats ``node_id`` at ``host:port`` every ``interval_ms`` from a C++
+    thread until closed."""
+
+    def __init__(self, host: str, port: int, node_id: int,
+                 interval_ms: int = 100):
+        import socket
+
+        self._lib = _lib()
+        # the native side takes dotted-quad only; resolve names here so a
+        # bad hostname is a loud error, never a silent localhost fallback
+        addr = socket.gethostbyname(host)
+        self._h = self._lib.hb_client_start(
+            addr.encode(), port, node_id, interval_ms
+        )
+        if not self._h:
+            raise OSError(f"heartbeat client to {host} ({addr}):{port} failed")
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.hb_client_stop(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class FailureDetector:
+    """Symmetric peer liveness for one process of a multi-process run.
+
+    Args:
+      node_id: this process's id.
+      peers: ``{node_id: (host, port)}`` of every OTHER process's monitor.
+      port: local monitor port (0 = ephemeral; see :attr:`server`).
+      interval_ms / timeout_ms: beat cadence and death horizon.
+
+    Usage: construct everywhere, then call :meth:`check` between training
+    steps — it raises :class:`WorkerFailureError` naming the dead peers
+    instead of letting the next collective hang.
+    """
+
+    def __init__(self, node_id: int, peers: Dict[int, Tuple[str, int]],
+                 port: int = 0, interval_ms: int = 100,
+                 timeout_ms: int = 1000):
+        self.node_id = node_id
+        self.expected = sorted(peers)
+        self.server = HeartbeatServer(port=port, timeout_ms=timeout_ms)
+        self._clients = [
+            HeartbeatClient(host, p, node_id, interval_ms)
+            for _, (host, p) in sorted(peers.items())
+        ]
+
+    def check(self) -> None:
+        """Raise if any peer that ever beat us has gone silent."""
+        dead = self.server.dead()
+        if dead:
+            raise WorkerFailureError(dead)
+
+    def wait_for_peers(self, timeout_s: float = 30.0) -> None:
+        """Block until every expected peer's first beat arrives (rendezvous
+        barrier for the control plane)."""
+        import time
+
+        deadline = time.monotonic() + timeout_s
+        want = set(self.expected)
+        while time.monotonic() < deadline:
+            seen = set(self.server.alive()) | set(self.server.dead())
+            if want <= seen:
+                return
+            time.sleep(0.02)
+        missing = sorted(want - (set(self.server.alive()) | set(self.server.dead())))
+        raise TimeoutError(
+            f"peers {missing} never started heartbeating within {timeout_s}s"
+        )
+
+    def close(self) -> None:
+        for c in self._clients:
+            c.close()
+        self._clients = []
+        self.server.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

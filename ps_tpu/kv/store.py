@@ -72,6 +72,12 @@ class KVStore:
         self.bytes_pushed = 0
         self.bytes_pulled = 0
         self.step = 0
+        # every protocol entry point consults the failure detector (when
+        # enabled) so a dead peer surfaces as a typed error BEFORE the next
+        # collective can hang on it
+        self._check_health = getattr(ctx.backend, "check_health", None) or (
+            lambda: None
+        )
 
     # -- registration -------------------------------------------------------
 
@@ -97,11 +103,13 @@ class KVStore:
     def push(self, key: str, grad: jax.Array, worker: int = 0) -> None:
         """Send a gradient for one key to its server (stages or applies,
         depending on mode/backend)."""
+        self._check_health()
         self.bytes_pushed += _nbytes(grad)
         self._engine.push(key, grad, worker=worker)
 
     def pull(self, key: str, worker: int = 0) -> jax.Array:
         """Fetch the current (post-apply) value of one key."""
+        self._check_health()
         val = self._engine.pull(key, worker=worker)
         self.bytes_pulled += _nbytes(val)
         return val
@@ -113,18 +121,36 @@ class KVStore:
             raise RuntimeError("KVStore.init(params) must be called first")
 
     def push_all(self, grads: Any, worker: int = 0) -> None:
-        """Push every key of a gradient pytree (structure must match init)."""
+        """Push every key of a gradient pytree (structure must match init).
+
+        Engines with a fused whole-tree apply (``push_tree``) get ONE
+        dispatch for the full push — the async bucketing path; others get
+        the per-key protocol in key order.
+        """
         self._require_init()
         kv, _ = keymod.flatten_with_keys(grads)
         if set(kv) != set(self._key_order):
             raise ValueError("gradient pytree structure does not match registered params")
+        push_tree = getattr(self._engine, "push_tree", None)
+        if push_tree is not None:
+            self._check_health()
+            self.bytes_pushed += sum(_nbytes(v) for v in kv.values())
+            push_tree(kv, worker=worker)
+            return
         for k in self._key_order:
             self.push(k, kv[k], worker=worker)
 
     def pull_all(self, worker: int = 0) -> Any:
-        """Pull every key and rebuild the parameter pytree."""
+        """Pull every key and rebuild the parameter pytree (one atomic
+        snapshot on engines with ``pull_tree``)."""
         self._require_init()
-        kv = {k: self.pull(k, worker=worker) for k in self._key_order}
+        pull_tree = getattr(self._engine, "pull_tree", None)
+        if pull_tree is not None:
+            self._check_health()
+            kv = pull_tree(worker=worker)
+            self.bytes_pulled += sum(_nbytes(v) for v in kv.values())
+        else:
+            kv = {k: self.pull(k, worker=worker) for k in self._key_order}
         return keymod.unflatten(self._treedef, kv, self._key_order)
 
     def push_pull(self, grads: Any, worker: int = 0) -> Any:
@@ -138,6 +164,7 @@ class KVStore:
         """
         self._require_init()
         if hasattr(self._engine, "update_tree"):
+            self._check_health()
             kv, _ = keymod.flatten_with_keys(grads)
             if set(kv) != set(self._key_order):
                 raise ValueError("gradient pytree structure does not match registered params")
@@ -184,25 +211,60 @@ class KVStore:
         treedef, key_order = self._treedef, self._key_order
 
         if not hasattr(engine, "get_tree_and_state"):
-            if engine.num_workers != 1:
-                raise NotImplementedError(
-                    "make_step on the local backend drives a single logical "
-                    "worker; with num_workers > 1 use push_all/pull_all per "
-                    "worker (see examples/train_mnist_mlp.py)"
-                )
             grad_fn = jax.jit(jax.value_and_grad(loss_fn, has_aux=has_aux))
+            nw = engine.num_workers
 
             def run_local(batch, *extra):
                 params = self.params()
+                if nw == 1:
+                    if has_aux:
+                        (loss, aux), grads = grad_fn(params, batch, *extra)
+                        return loss, self.push_pull(grads), aux
+                    loss, grads = grad_fn(params, batch, *extra)
+                    return loss, self.push_pull(grads)
+
+                # num_workers > 1: the batch is the GLOBAL batch; each
+                # logical worker grads its equal slice and pushes, the
+                # server aggregates on the last push — the reference's
+                # per-worker trainer loop driven from one host. Loss (and
+                # aux, e.g. BN stats) are worker-means, matching the
+                # server's 'mean' aggregation of the gradients.
+                def slice_w(x, w):
+                    n = x.shape[0]
+                    if n % nw:
+                        raise ValueError(
+                            f"global batch dim {n} not divisible by "
+                            f"num_workers={nw}"
+                        )
+                    r = n // nw
+                    return x[w * r:(w + 1) * r]
+
+                losses, auxes = [], []
+                for w in range(nw):
+                    shard = jax.tree_util.tree_map(
+                        lambda x, _w=w: slice_w(x, _w), batch
+                    )
+                    if has_aux:
+                        (loss, aux), grads = grad_fn(params, shard, *extra)
+                        auxes.append(aux)
+                    else:
+                        loss, grads = grad_fn(params, shard, *extra)
+                    losses.append(loss)
+                    self.push_all(grads, worker=w)
+                self.step += 1
+                new_params = self.pull_all()
+                loss = sum(losses) / nw
                 if has_aux:
-                    (loss, aux), grads = grad_fn(params, batch, *extra)
-                    return loss, self.push_pull(grads), aux
-                loss, grads = grad_fn(params, batch, *extra)
-                return loss, self.push_pull(grads)
+                    aux = jax.tree_util.tree_map(
+                        lambda *xs: sum(xs) / nw, *auxes
+                    )
+                    return loss, new_params, aux
+                return loss, new_params
 
             return run_local
 
         opt = self._opt
+        grad_scale = float(getattr(engine, "grad_scale", 1.0))
 
         def kv_loss(params_kv, batch, *extra):
             return loss_fn(
@@ -218,11 +280,16 @@ class KVStore:
             else:
                 loss, grads = jax.value_and_grad(kv_loss)(params_kv, batch, *extra)
                 aux = None
+            if grad_scale != 1.0:  # aggregate='sum' semantics
+                grads = jax.tree_util.tree_map(lambda g: g * grad_scale, grads)
             updates, state = opt.update(grads, state, params_kv)
             params_kv = optax.apply_updates(params_kv, updates)
             return params_kv, state, loss, aux
 
+        check_health = self._check_health
+
         def run(batch, *extra):
+            check_health()  # dead peer -> typed error, not a hung psum
             params_kv, state = engine.get_tree_and_state()
             params_kv, state, loss, aux = fused(params_kv, state, batch, *extra)
             engine.set_tree_and_state(params_kv, state)
@@ -280,12 +347,34 @@ class KVStore:
         fn = getattr(self._engine, "staleness", None)
         return fn(worker) if fn else 0
 
+    @property
+    def staleness_histogram(self) -> Dict[int, int]:
+        """Async mode: ``{τ: count}`` of whole-tree pushes by the staleness
+        they were applied at (empty in sync mode / on engines without
+        version tracking)."""
+        hist = getattr(self._engine, "staleness_hist", None)
+        return dict(hist) if hist else {}
+
     def shard_batch(self, batch: Any) -> Any:
         """Place a host batch on the mesh, sharded over the data axis
-        (identity on the local backend)."""
+        (identity on the local backend).
+
+        Single-process: pass the GLOBAL batch; it is device_put sharded.
+        Multi-process (``jax.distributed`` initialized): pass this process's
+        LOCAL slice of the global batch — the slices are assembled into one
+        global ``jax.Array`` spanning all processes' devices, exactly how
+        the reference's per-worker data loaders feed a distributed job.
+        """
         if self._ctx.mesh is None:
             return batch
         sharding = self._ctx.backend.batch_sharding()
+        if jax.process_count() > 1:
+            return jax.tree_util.tree_map(
+                lambda x: jax.make_array_from_process_local_data(
+                    sharding, np.asarray(x)
+                ),
+                batch,
+            )
         return jax.tree_util.tree_map(lambda x: jax.device_put(x, sharding), batch)
 
     # -- checkpoint/resume --------------------------------------------------

@@ -1,0 +1,62 @@
+"""Native (C++) components, compiled on demand with the system toolchain.
+
+The reference keeps its control-plane van in C++ (SURVEY.md §3 rows 9/12);
+ps_tpu does the same for the heartbeat van — :func:`load` compiles
+``van.cpp`` to a shared library once (cached beside the source, keyed on the
+source hash) and returns a ``ctypes.CDLL``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+import threading
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_lock = threading.Lock()
+_cache: dict = {}
+
+
+class NativeBuildError(RuntimeError):
+    pass
+
+
+def _cache_dir() -> str:
+    """Writable build-artifact cache OUTSIDE the package tree (the install
+    may be read-only, and .so binaries do not belong in the source tree)."""
+    base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+        os.path.expanduser("~"), ".cache"
+    )
+    d = os.path.join(base, "ps_tpu", "native")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def load(name: str = "van") -> ctypes.CDLL:
+    """Compile (if needed) and dlopen the named native component."""
+    with _lock:
+        if name in _cache:
+            return _cache[name]
+        src = os.path.join(_DIR, f"{name}.cpp")
+        with open(src, "rb") as f:
+            digest = hashlib.sha256(f.read()).hexdigest()[:16]
+        lib = os.path.join(_cache_dir(), f"lib{name}-{digest}.so")
+        if not os.path.exists(lib):
+            tmp = lib + f".tmp{os.getpid()}"
+            cmd = [
+                "g++", "-O2", "-std=c++17", "-shared", "-fPIC",
+                "-pthread", "-o", tmp, src,
+            ]
+            try:
+                subprocess.run(
+                    cmd, check=True, capture_output=True, text=True
+                )
+            except subprocess.CalledProcessError as e:
+                raise NativeBuildError(
+                    f"building {name}.cpp failed:\n{e.stderr}"
+                ) from None
+            os.replace(tmp, lib)  # atomic: concurrent builders race safely
+        _cache[name] = ctypes.CDLL(lib)
+        return _cache[name]

@@ -1,0 +1,177 @@
+// Control-plane "van": heartbeat liveness over UDP.
+//
+// The reference family's ZMQ van carries BOTH the data plane (tensor
+// push/pull) and the control plane (connect/barrier/heartbeat). On TPU the
+// data plane is XLA collectives over ICI/DCN (SURVEY.md §3 row 9) — what
+// remains host-side is liveness: every node beats, every node watches its
+// peers, and a silent peer is declared dead after a timeout instead of the
+// job hanging in a collective. This file is that control plane, kept native
+// (C++, like the reference's van) so beat/poll latency is independent of the
+// Python interpreter (GIL pauses during jit dispatch must not fake a death).
+//
+// Exposed as a C ABI for ctypes (ps_tpu/control/heartbeat.py). Threading
+// model: one receiver thread per server, one sender thread per client;
+// handles are opaque pointers; all public calls are thread-safe.
+
+#include <arpa/inet.h>
+#include <netinet/in.h>
+#include <sys/socket.h>
+#include <sys/time.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstring>
+#include <map>
+#include <mutex>
+#include <thread>
+#include <vector>
+
+namespace {
+
+using Clock = std::chrono::steady_clock;
+
+struct Beat {
+  uint32_t magic;    // 'PSHB'
+  uint32_t node_id;
+  uint64_t seq;
+};
+
+constexpr uint32_t kMagic = 0x50534842;  // "PSHB"
+
+struct Server {
+  int fd = -1;
+  int port = 0;
+  int timeout_ms = 1000;
+  std::atomic<bool> stop{false};
+  std::thread rx;
+  std::mutex mu;
+  std::map<uint32_t, Clock::time_point> last_seen;
+  std::map<uint32_t, uint64_t> last_seq;
+
+  void run() {
+    Beat b;
+    while (!stop.load(std::memory_order_relaxed)) {
+      ssize_t n = recv(fd, &b, sizeof(b), 0);
+      if (n == (ssize_t)sizeof(b) && b.magic == kMagic) {
+        std::lock_guard<std::mutex> lock(mu);
+        last_seen[b.node_id] = Clock::now();
+        last_seq[b.node_id] = b.seq;
+      }
+      // timeouts fall through so the stop flag is polled
+    }
+  }
+};
+
+struct Client {
+  int fd = -1;
+  sockaddr_in dest{};
+  uint32_t node_id = 0;
+  int interval_ms = 100;
+  std::atomic<bool> stop{false};
+  std::thread tx;
+
+  void run() {
+    Beat b{kMagic, node_id, 0};
+    while (!stop.load(std::memory_order_relaxed)) {
+      ++b.seq;
+      sendto(fd, &b, sizeof(b), 0, (sockaddr*)&dest, sizeof(dest));
+      std::this_thread::sleep_for(std::chrono::milliseconds(interval_ms));
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Start a heartbeat monitor bound to `port` (0 = ephemeral). A node is
+// "alive" once its first beat arrives and "dead" when silent > timeout_ms.
+void* hb_server_start(int port, int timeout_ms) {
+  // no SO_REUSEADDR: a port collision must fail loudly at bind, not split
+  // the beat stream between two silently-coexisting sockets
+  int fd = socket(AF_INET, SOCK_DGRAM, 0);
+  if (fd < 0) return nullptr;
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  addr.sin_port = htons((uint16_t)port);
+  if (bind(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  socklen_t len = sizeof(addr);
+  getsockname(fd, (sockaddr*)&addr, &len);
+  timeval tv{0, 100 * 1000};  // 100ms recv timeout: stop-flag poll cadence
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+
+  auto* s = new Server();
+  s->fd = fd;
+  s->port = ntohs(addr.sin_port);
+  s->timeout_ms = timeout_ms;
+  s->rx = std::thread([s] { s->run(); });
+  return s;
+}
+
+int hb_server_port(void* h) { return static_cast<Server*>(h)->port; }
+
+// Fill `out` (capacity `cap`) with ids in the given state; returns the count.
+// state 0 = alive (beating within timeout), 1 = dead (seen, then silent).
+int hb_server_poll(void* h, int state, uint32_t* out, int cap) {
+  auto* s = static_cast<Server*>(h);
+  auto now = Clock::now();
+  auto horizon = std::chrono::milliseconds(s->timeout_ms);
+  std::lock_guard<std::mutex> lock(s->mu);
+  int n = 0;
+  for (const auto& kv : s->last_seen) {
+    bool dead = (now - kv.second) > horizon;
+    if ((state == 1) == dead && n < cap) out[n++] = kv.first;
+  }
+  return n;
+}
+
+uint64_t hb_server_seq(void* h, uint32_t node_id) {
+  auto* s = static_cast<Server*>(h);
+  std::lock_guard<std::mutex> lock(s->mu);
+  auto it = s->last_seq.find(node_id);
+  return it == s->last_seq.end() ? 0 : it->second;
+}
+
+void hb_server_stop(void* h) {
+  auto* s = static_cast<Server*>(h);
+  s->stop.store(true);
+  if (s->rx.joinable()) s->rx.join();
+  close(s->fd);
+  delete s;
+}
+
+// Start beating `node_id` at `host:port` every interval_ms. `host` must be
+// a dotted-quad IPv4 address (the Python wrapper resolves hostnames);
+// anything else is a hard error, never a silent localhost fallback.
+void* hb_client_start(const char* host, int port, uint32_t node_id,
+                      int interval_ms) {
+  sockaddr_in dest{};
+  dest.sin_family = AF_INET;
+  dest.sin_port = htons((uint16_t)port);
+  if (inet_pton(AF_INET, host, &dest.sin_addr) != 1) return nullptr;
+  int fd = socket(AF_INET, SOCK_DGRAM, 0);
+  if (fd < 0) return nullptr;
+  auto* c = new Client();
+  c->fd = fd;
+  c->node_id = node_id;
+  c->interval_ms = interval_ms;
+  c->dest = dest;
+  c->tx = std::thread([c] { c->run(); });
+  return c;
+}
+
+void hb_client_stop(void* h) {
+  auto* c = static_cast<Client*>(h);
+  c->stop.store(true);
+  if (c->tx.joinable()) c->tx.join();
+  close(c->fd);
+  delete c;
+}
+
+}  // extern "C"

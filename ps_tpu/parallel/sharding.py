@@ -14,39 +14,63 @@ from typing import Any
 
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-from ps_tpu.parallel.mesh import DATA_AXIS
+from ps_tpu.parallel.mesh import DATA_AXIS, MODEL_AXIS
 
 
 def replicated(mesh: Mesh) -> NamedSharding:
     return NamedSharding(mesh, P())
 
 
+def _pick_dim(shape, n, taken=None):
+    """Largest dim divisible by n (ties toward the leading dim), skipping
+    dims already assigned to another mesh axis. None if no dim qualifies."""
+    order = sorted(range(len(shape)), key=lambda i: (-shape[i], i))
+    for i in order:
+        if taken is not None and i in taken:
+            continue
+        if shape[i] % n == 0 and shape[i] >= n:
+            return i
+    return None
+
+
 def param_sharding(mesh: Mesh, leaf: Any, placement: str,
                    axis: str = DATA_AXIS) -> NamedSharding:
     """Choose a NamedSharding for one parameter tensor.
 
-    - 'replicated': every device holds the full tensor (pure data parallel;
-      grads psum, update computed everywhere — fastest for small models).
-    - 'sharded': split the largest dimension divisible by the axis size
+    - 'replicated': every device holds the full tensor along the data axis
+      (pure data parallel; grads psum, update computed everywhere).
+    - 'sharded': split the largest dimension divisible by the data-axis size
       (ZeRO-1-style; grads reduce-scatter to the owner shard, the update runs
       shard-local, pulls all-gather). Falls back to replicated for tensors
       with no evenly divisible dimension.
+
+    If the mesh carries a 'model' axis of size > 1, tensors additionally
+    shard one dimension over it (tensor parallelism: GSPMD partitions the
+    matmuls and inserts the activation collectives). Under 'sharded' the
+    model axis takes the largest dim and ZeRO takes the next; the two axes
+    never share a dimension.
     """
-    if placement == "replicated":
-        return replicated(mesh)
-    if placement != "sharded":
+    if placement not in ("replicated", "sharded"):
         raise ValueError(f"unknown placement {placement!r}")
-    n = mesh.shape[axis]
     ndim = getattr(leaf, "ndim", 0)
-    if ndim:
-        # prefer the largest dim; ties break toward the leading dim
-        order = sorted(range(ndim), key=lambda i: (-leaf.shape[i], i))
-        for i in order:
-            if leaf.shape[i] % n == 0 and leaf.shape[i] >= n:
-                spec = [None] * ndim
-                spec[i] = axis
-                return NamedSharding(mesh, P(*spec))
-    return replicated(mesh)
+    if not ndim:
+        return replicated(mesh)
+    spec = [None] * ndim
+    taken = set()
+    m = mesh.shape.get(MODEL_AXIS, 1)
+    if m > 1:
+        i = _pick_dim(leaf.shape, m)
+        if i is not None:
+            spec[i] = MODEL_AXIS
+            taken.add(i)
+    if placement == "sharded":
+        n = mesh.shape[axis]
+        i = _pick_dim(leaf.shape, n, taken)
+        if i is not None:
+            spec[i] = axis
+    if all(s is None for s in spec):
+        return replicated(mesh)
+    return NamedSharding(mesh, P(*spec))
 
 
 def batch_sharding(mesh: Mesh, axis: str = DATA_AXIS) -> NamedSharding:

@@ -114,4 +114,7 @@ class TrainMetrics:
             out["ici_gbps_per_device"] = round(
                 (self.store.collective_bytes - c0) / 1e9 / dt, 4
             )
+            hist = getattr(self.store, "staleness_histogram", None)
+            if hist:
+                out["staleness_hist"] = {str(t): n for t, n in sorted(hist.items())}
         return out

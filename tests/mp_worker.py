@@ -1,0 +1,117 @@
+"""Subprocess entry for the multi-process tests (tests/test_multiprocess.py).
+
+One OS process per PS node: each sets up its own local CPU devices, joins the
+``jax.distributed`` coordination service through ``Config.coordinator_uri``
+(the scheduler/rendezvous equivalent — SURVEY.md §3 row 10), builds the
+GLOBAL mesh spanning every process's devices, and runs fused PS steps whose
+psum rides the cross-process transport. This is the TPU-native analogue of
+the reference family's multi-process localhost tests (SURVEY.md §5).
+
+Fault-injection mode (SURVEY.md §6 "Failure detection"): with
+``PS_TEST_FAULT_VICTIM`` set, heartbeats are enabled, the victim process
+dies hard (``os._exit``) after its first step, and the survivors must
+surface a typed :class:`WorkerFailureError` naming it — instead of hanging
+in the next collective — then report what they detected.
+
+Not a pytest module — invoked as ``python mp_worker.py <pid> <nproc> <port>
+<out_dir> <local_devices> [steps]``; writes ``proc<pid>.json`` with per-step
+losses and a parameter checksum for the parent to compare.
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def main() -> int:
+    pid = int(sys.argv[1])
+    nproc = int(sys.argv[2])
+    port = sys.argv[3]
+    out_dir = sys.argv[4]
+    local_devices = int(sys.argv[5])
+    steps = int(sys.argv[6]) if len(sys.argv) > 6 else 3
+    victim = int(os.environ.get("PS_TEST_FAULT_VICTIM", "-1"))
+
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    os.environ["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={local_devices}"
+    )
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import jax.numpy as jnp
+
+    import ps_tpu as ps
+    from ps_tpu.data.synthetic import mnist_batches
+    from ps_tpu.models.mlp import MLP, cross_entropy_loss
+
+    total_devices = nproc * local_devices
+    ps.init(
+        backend="tpu",
+        coordinator_uri=f"localhost:{port}" if nproc > 1 else None,
+        num_processes=nproc,
+        process_id=pid,
+        mesh_shape={"data": total_devices},
+    )
+    from ps_tpu.control import WorkerFailureError
+    assert jax.process_count() == nproc, jax.process_count()
+    assert len(jax.devices()) == total_devices, len(jax.devices())
+
+    model = MLP(hidden=16)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)))["params"]
+
+    def loss_fn(p, batch):
+        images, labels = batch
+        return cross_entropy_loss(model.apply({"params": p}, images), labels)
+
+    store = ps.KVStore(optimizer="sgd", learning_rate=0.1, placement="sharded")
+    store.init(params)
+    run = store.make_step(loss_fn)
+
+    global_batch = 4 * total_devices
+    rows = global_batch // nproc  # this process's slice of the global batch
+    stream = mnist_batches(global_batch, seed=0)
+    losses = []
+    try:
+        for step in range(steps):
+            images, labels = next(stream)
+            batch = store.shard_batch(
+                (images[pid * rows:(pid + 1) * rows],
+                 labels[pid * rows:(pid + 1) * rows])
+            )
+            loss, _ = run(batch)
+            losses.append(float(loss))
+            if victim >= 0:
+                if pid == victim and step == 0:
+                    os._exit(17)  # hard death mid-run, no cleanup
+                # slow cadence so the pre-step health check sees the death
+                # horizon expire (real jobs step slower than the timeout)
+                time.sleep(0.8)
+    except WorkerFailureError as e:
+        with open(os.path.join(out_dir, f"proc{pid}.json"), "w") as f:
+            json.dump({"pid": pid, "failure_detected": e.dead,
+                       "losses": losses}, f)
+        os._exit(0)  # skip ps.shutdown(): the distributed barrier would hang
+
+    @jax.jit
+    def checksum(tree):
+        return jax.tree_util.tree_reduce(
+            lambda acc, x: acc + jnp.sum(jnp.abs(x)), tree, jnp.float32(0)
+        )
+
+    out = {
+        "pid": pid,
+        "process_count": jax.process_count(),
+        "losses": losses,
+        "checksum": float(checksum(store._engine._params)),
+    }
+    with open(os.path.join(out_dir, f"proc{pid}.json"), "w") as f:
+        json.dump(out, f)
+    ps.shutdown()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,185 @@
+"""Async engine hardening — VERDICT r1 item 5; SURVEY.md §4d, §6 (race
+section), §3 row 11 (async bucketing).
+
+Covers: the fused whole-tree async apply (one dispatch per push_all) against
+the per-key spec, tree-granularity version accounting, the staleness
+histogram, and a THREADED multi-worker stress run whose apply-count/version
+invariants must hold exactly (the server-side lock serializes applies, like
+the reference server's apply loop).
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import ps_tpu as ps
+from ps_tpu.data.synthetic import mnist_batches
+from ps_tpu.models.mlp import MLP, cross_entropy_loss
+
+LR = 0.05
+
+
+def _params(hidden=16):
+    model = MLP(hidden=hidden)
+    params = model.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)))["params"]
+    return model, params
+
+
+def _grads_like(params, seed):
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    rng = np.random.default_rng(seed)
+    return jax.tree_util.tree_unflatten(
+        treedef,
+        [jnp.asarray(rng.normal(0, 0.1, x.shape).astype(np.float32)) for x in leaves],
+    )
+
+
+@pytest.mark.parametrize("backend", ["local", "tpu"])
+def test_fused_tree_apply_matches_per_key(backend):
+    """push_tree (one fused dispatch) ≡ per-key push sequence."""
+    _, params = _params()
+    gs = [_grads_like(params, s) for s in range(3)]
+
+    def run(per_key: bool):
+        ps.init(backend=backend, mode="async", num_workers=2)
+        store = ps.KVStore(optimizer="adam", learning_rate=1e-3, mode="async")
+        store.init(params)
+        from ps_tpu.kv import keys as keymod
+
+        store.pull_all(worker=0)
+        for i, g in enumerate(gs):
+            w = i % 2
+            if per_key:
+                kv, _ = keymod.flatten_with_keys(g)
+                for k in store.keys():
+                    store._engine.push(k, kv[k], worker=w)
+            else:
+                store.push_all(g, worker=w)
+        out = jax.tree_util.tree_map(np.asarray, store.params())
+        version = store._engine.version
+        ps.shutdown()
+        return out, version
+
+    fused, v_fused = run(per_key=False)
+    perkey, v_perkey = run(per_key=True)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-6, atol=1e-7),
+        fused, perkey,
+    )
+    # tree-granularity versions agree between the two protocols
+    assert v_fused == v_perkey == 3
+
+
+def test_partial_tree_push_does_not_advance_version():
+    _, params = _params()
+    ps.init(backend="tpu", mode="async", num_workers=1)
+    store = ps.KVStore(optimizer="sgd", learning_rate=LR, mode="async")
+    store.init(params)
+    g = _grads_like(params, 0)
+    from ps_tpu.kv import keys as keymod
+
+    kv, _ = keymod.flatten_with_keys(g)
+    keys = store.keys()
+    store._engine.push(keys[0], kv[keys[0]])
+    assert store._engine.version == 0  # partial tree: no fractional version
+    for k in keys[1:]:
+        store._engine.push(k, kv[k])
+    assert store._engine.version == 1
+    ps.shutdown()
+
+
+def test_staleness_histogram_counts_pushes():
+    _, params = _params()
+    ps.init(backend="tpu", mode="async", num_workers=2)
+    store = ps.KVStore(optimizer="sgd", learning_rate=LR, mode="async")
+    store.init(params)
+    store.pull_all(worker=0)
+    store.push_all(_grads_like(params, 1), worker=1)  # τ=0 for w1
+    store.push_all(_grads_like(params, 2), worker=1)  # τ=1 (w1 never re-pulled)
+    store.push_all(_grads_like(params, 3), worker=0)  # τ=2 for w0
+    hist = store.staleness_histogram
+    assert sum(hist.values()) == 3
+    assert hist[2] == 1  # w0's stale-by-2 push
+    ps.shutdown()
+
+
+@pytest.mark.parametrize("backend", ["local", "tpu"])
+def test_threaded_stress_invariants(backend):
+    """4 host threads drive 4 async workers concurrently; the server lock
+    must keep every invariant exact (no lost applies, no torn versions)."""
+    num_workers, cycles = 4, 12
+    model, params = _params(hidden=8)
+    nkeys = len(jax.tree_util.tree_leaves(params))
+
+    def loss_fn(p, batch):
+        images, labels = batch
+        return cross_entropy_loss(model.apply({"params": p}, images), labels)
+
+    ps.init(backend=backend, mode="async", num_workers=num_workers)
+    store = ps.KVStore(optimizer="sgd", learning_rate=LR, mode="async")
+    store.init(params)
+    run = store.make_async_step(loss_fn)
+
+    errors = []
+
+    def worker(w):
+        try:
+            stream = mnist_batches(16, seed=w, worker=w,
+                                   num_workers=num_workers, steps=cycles)
+            for images, labels in stream:
+                run((jnp.asarray(images), jnp.asarray(labels)), worker=w)
+        except Exception as e:  # pragma: no cover - surfaced by the assert
+            errors.append((w, e))
+
+    threads = [threading.Thread(target=worker, args=(w,))
+               for w in range(num_workers)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+
+    engine = store._engine
+    total_pushes = num_workers * cycles
+    assert engine.version == total_pushes
+    if hasattr(engine, "_applies"):
+        assert engine._applies == total_pushes * nkeys
+    assert all(c == total_pushes for c in engine.apply_count.values())
+    hist = store.staleness_histogram
+    if hist:
+        assert sum(hist.values()) == total_pushes
+    for leaf in jax.tree_util.tree_leaves(store.params()):
+        assert bool(jnp.isfinite(leaf).all())
+    ps.shutdown()
+
+
+def test_sequential_async_is_deterministic():
+    """Round-robin (non-threaded) async with fixed seeds is bit-reproducible."""
+    model, params = _params(hidden=8)
+
+    def loss_fn(p, batch):
+        images, labels = batch
+        return cross_entropy_loss(model.apply({"params": p}, images), labels)
+
+    def once():
+        ps.init(backend="tpu", mode="async", num_workers=2)
+        store = ps.KVStore(optimizer="sgd", learning_rate=LR, mode="async")
+        store.init(params)
+        run = store.make_async_step(loss_fn)
+        streams = [
+            mnist_batches(16, seed=w, worker=w, num_workers=2, steps=6)
+            for w in range(2)
+        ]
+        for _ in range(6):
+            for w, s in enumerate(streams):
+                images, labels = next(s)
+                run((jnp.asarray(images), jnp.asarray(labels)), worker=w)
+        out = jax.tree_util.tree_map(np.asarray, store.params())
+        ps.shutdown()
+        return out
+
+    a, b = once(), once()
+    jax.tree_util.tree_map(np.testing.assert_array_equal, a, b)
