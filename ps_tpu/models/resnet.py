@@ -4,8 +4,11 @@
 ResNet-v1.5 written TPU-first:
 
 - bfloat16 compute / float32 params by default: convs and the final matmul
-  hit the MXU at full rate; BatchNorm statistics and the softmax/loss stay
-  in float32 for numerics.
+  hit the MXU at full rate; BatchNorm batch statistics are still accumulated
+  in float32 (flax's force_float32_reductions) but its *output* stays in the
+  compute dtype — an r3 profiler trace showed f32 BN outputs doubled every
+  activation/gradient byte on an HBM-bound chip (88% of device time was
+  HBM-bound; see BASELINE.md). The softmax/loss stays float32.
 - BatchNorm under GSPMD jit: with the batch sharded over the 'data' mesh
   axis, the batch-mean/variance reductions are *global* means — XLA inserts
   the cross-device collectives, so this is synchronized BatchNorm with no
@@ -107,7 +110,7 @@ class ResNet(nn.Module):
         )
         norm = functools.partial(
             nn.BatchNorm, use_running_average=not train, momentum=0.9,
-            epsilon=1e-5, dtype=jnp.float32, param_dtype=jnp.float32,
+            epsilon=1e-5, dtype=self.dtype, param_dtype=jnp.float32,
         )
         act = nn.relu
 
