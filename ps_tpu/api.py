@@ -74,13 +74,20 @@ def init(backend: Optional[str] = None, config: Optional[Config] = None, **overr
         return _context
 
 
-def shutdown() -> None:
+def shutdown(abort: bool = False) -> None:
     """Tear down the runtime (barrier + socket close in the reference family;
-    here: drop the context so a fresh init can follow)."""
+    here: drop the context so a fresh init can follow).
+
+    ``abort=True`` is the post-failure escape hatch: after a
+    :class:`~ps_tpu.control.WorkerFailureError`, the normal teardown would
+    hang in the ``jax.distributed`` shutdown barrier (a dead peer can never
+    arrive), so abort announces a clean goodbye on the control plane and
+    severs the coordination-service connection without barriers. The process
+    can then exit normally."""
     global _context
     with _lock:
         if _context is not None:
-            _context.backend.shutdown()
+            _context.backend.shutdown(abort=abort)
             _context = None
 
 

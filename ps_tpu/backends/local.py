@@ -248,5 +248,5 @@ class LocalBackend:
             dc_lambda=self.config.dc_lambda,
         )
 
-    def shutdown(self) -> None:
-        pass
+    def shutdown(self, abort: bool = False) -> None:
+        del abort  # single-process: nothing to barrier on either way

@@ -24,6 +24,7 @@ step (before the automatic reduction).
 
 from __future__ import annotations
 
+import contextlib
 from typing import Any, Dict, List, Optional
 
 import jax
@@ -432,6 +433,45 @@ class TpuServer(PeekMixin, CheckpointMixin):
         self._account_update()
 
 
+# Coordination-service handles parked by shutdown(abort=True): destroying one
+# cancels all in-flight RPCs, which peers' poll threads treat as fatal. Kept
+# alive until process exit instead.
+_LEAKED_SERVICES: list = []
+
+
+@contextlib.contextmanager
+def _coordination_client_options():
+    """Within the block, ``jax.distributed.initialize`` builds its
+    coordination client as a *recoverable* task with
+    ``shutdown_on_destruction=False``. Recoverable means the coordination
+    service does NOT propagate one task's death to the others (jax's default
+    reaction is a LOG(FATAL) from the error-poll thread — it would kill the
+    survivors our failure detector is trying to hand a typed error), and the
+    distributed shutdown barrier no longer blocks on dead peers. Dropping
+    the client handle is barrier-free, which is what ``shutdown(abort=True)``
+    relies on. Wraps a private jax seam; if the factory ever stops accepting
+    the kwarg, initialization falls back to jax's defaults."""
+    from jax._src import distributed as _dist
+
+    orig = _dist._jax.get_distributed_runtime_client
+
+    def patched(*args, **kwargs):
+        kwargs["recoverable"] = True
+        kwargs["shutdown_on_destruction"] = False
+        try:
+            return orig(*args, **kwargs)
+        except TypeError:
+            kwargs.pop("recoverable", None)
+            kwargs.pop("shutdown_on_destruction", None)
+            return orig(*args, **kwargs)
+
+    _dist._jax.get_distributed_runtime_client = patched
+    try:
+        yield
+    finally:
+        _dist._jax.get_distributed_runtime_client = orig
+
+
 class TpuBackend:
     """Backend for ``ps_tpu.init(backend='tpu')``. Despite the name it runs
     anywhere JAX has devices — on CPU it uses virtual devices (tests), on a
@@ -441,28 +481,37 @@ class TpuBackend:
         self.config = config
         self._owns_distributed = False
         self.failure_detector = None
+        all_peers = config.heartbeat_peers()
+        detector_on = all_peers is not None and config.num_processes > 1
         if config.coordinator_uri is not None:
-            jax.distributed.initialize(
-                coordinator_address=config.coordinator_uri,
-                num_processes=config.num_processes,
-                process_id=config.process_id,
-            )
+            # With the failure detector on, it owns failure handling: the
+            # typed WorkerFailureError surfaces in the training thread and
+            # the job exits through shutdown(abort=True). jax's default
+            # coordination client would instead LOG(FATAL) the process from
+            # its error-poll thread on any peer death/teardown, and its
+            # destructor would block in the shutdown barrier — both defeat
+            # the clean abort path, so swap in recoverable client options.
+            opts = (_coordination_client_options() if detector_on
+                    else contextlib.nullcontext())
+            with opts:
+                jax.distributed.initialize(
+                    coordinator_address=config.coordinator_uri,
+                    num_processes=config.num_processes,
+                    process_id=config.process_id,
+                )
             self._owns_distributed = True
-        if (config.heartbeat_base_port is not None
-                and config.num_processes > 1):
+        if detector_on:
             from ps_tpu.control import FailureDetector
 
-            base = config.heartbeat_base_port
-            peers = {
-                i: ("127.0.0.1", base + i)
-                for i in range(config.num_processes)
-                if i != config.process_id
-            }
+            my_port = all_peers[config.process_id][1]
+            peers = {i: hp for i, hp in all_peers.items()
+                     if i != config.process_id}
             try:
                 self.failure_detector = FailureDetector(
                     node_id=config.process_id,
                     peers=peers,
-                    port=base + config.process_id,
+                    port=my_port,
+                    bind=config.resolved_heartbeat_bind(),
                     interval_ms=config.heartbeat_interval_ms,
                     timeout_ms=config.heartbeat_timeout_ms,
                 )
@@ -509,10 +558,43 @@ class TpuBackend:
     def batch_sharding(self):
         return batch_sharding(self.mesh)
 
-    def shutdown(self) -> None:
+    def shutdown(self, abort: bool = False) -> None:
+        """Tear down. ``abort=True`` is the post-failure path: announce a
+        goodbye so fellow survivors don't also flag THIS exit as a death,
+        then drop the ``jax.distributed`` connection WITHOUT the distributed
+        shutdown barrier — with a peer dead, that barrier can never complete
+        and would hang every survivor."""
         if self.failure_detector is not None:
-            self.failure_detector.close()
+            self.failure_detector.close(goodbye=True)
             self.failure_detector = None
         if self._owns_distributed:
-            jax.distributed.shutdown()
+            if abort:
+                from jax._src import distributed as _dist
+
+                # This client was built recoverable (see
+                # _coordination_client_options): its shutdown RPC skips the
+                # all-process barrier, so disconnecting here cannot hang on
+                # the dead peer. The coordination SERVICE handle (process 0)
+                # is deliberately leaked instead of destroyed — its
+                # destructor cancels every in-flight RPC, which other
+                # processes' error-poll threads answer with LOG(FATAL);
+                # the OS reclaims it at exit, after everyone disconnected.
+                # Known limit: if the coordinator PROCESS itself is the one
+                # that died, survivors' poll threads may still terminate
+                # them before this runs (scheduler SPOF, as in the
+                # reference family).
+                _dist.global_state.preemption_sync_manager = None
+                try:
+                    if _dist.global_state.client is not None:
+                        _dist.global_state.client.shutdown()
+                except Exception:
+                    pass  # service already gone: the disconnect is moot
+                _dist.global_state.client = None
+                if _dist.global_state.service is not None:
+                    _LEAKED_SERVICES.append(_dist.global_state.service)
+                    _dist.global_state.service = None
+                _dist.global_state.coordinator_address = None
+                _dist.global_state.process_id = 0
+            else:
+                jax.distributed.shutdown()
             self._owns_distributed = False

@@ -16,12 +16,22 @@ that, per backend:
 - **sparse tables**: the row-sharded table + per-row optimizer state
   (SparseEmbedding.save/restore).
 
-Layout under ``<path>/``: orbax pytree checkpoint in ``arrays-<id>/`` plus a
+Layout under ``<path>/``: orbax pytree checkpoint in ``arrays-<gen>/`` plus a
 JSON sidecar ``meta.json`` naming it. The meta write is the commit point:
-arrays land in a fresh uniquely-named directory first, then ``meta.json`` is
-atomically replaced to point at it — a crash mid-save leaves the previous
-checkpoint fully intact (old meta → old arrays). Superseded array dirs are
+arrays land in a fresh generation-numbered directory first, then ``meta.json``
+is atomically replaced to point at it — a crash mid-save leaves the previous
+checkpoint fully intact (old meta → old arrays). The immediately-previous
+generation's arrays are retained for one generation (a restore that read the
+old meta before a concurrent resave can still finish); older ones are
 garbage-collected after the commit.
+
+Multi-process jobs: the arrays directory name is derived deterministically
+from the last committed generation, so every process of a
+``jax.distributed``-initialized job writes its shards into the SAME orbax
+directory (orbax coordinates the per-process writes). Processes barrier
+before the commit; process 0 alone writes ``meta.json`` and runs GC; a final
+barrier makes the commit visible to all processes before ``save`` returns.
+Single-writer assumption: at most one job saves into a given path at a time.
 
 Optimizer-state pytrees are stored as *flat leaf lists* (optax states are
 NamedTuples, whose structure the live engine already holds — storing flat
@@ -39,7 +49,6 @@ from __future__ import annotations
 import json
 import os
 import shutil
-import uuid
 from typing import Any, Dict, Optional
 
 import jax
@@ -59,35 +68,68 @@ def _checkpointer():
     return ocp.PyTreeCheckpointer()
 
 
+def _barrier(name: str) -> None:
+    """Cross-process sync point; a no-op in single-process jobs."""
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(name)
+
+
+def _last_commit(path: str):
+    """(generation, arrays_dir) of the committed checkpoint, or (-1, None)."""
+    try:
+        meta = read_meta(path)
+        return int(meta.get("generation", -1)), meta.get("arrays_dir")
+    except (FileNotFoundError, json.JSONDecodeError, ValueError, KeyError):
+        return -1, None
+
+
 def save(path: str, arrays: Any, meta: Dict[str, Any]) -> None:
     """Write one checkpoint: an orbax pytree of arrays + a JSON sidecar.
 
-    Crash-safe: arrays are written to a fresh ``arrays-<id>/`` directory and
-    only then does an atomic ``meta.json`` replace point the checkpoint at
-    them; a crash anywhere mid-save leaves the previous checkpoint valid.
+    Crash-safe: arrays are written to a fresh generation-numbered directory
+    and only then does an atomic ``meta.json`` replace point the checkpoint
+    at them; a crash anywhere mid-save leaves the previous checkpoint valid.
+    Every process of a multi-process job must call this with the same
+    ``path`` — the directory name is derived from the committed generation
+    (identical everywhere), orbax writes each process's shards into it, and
+    process 0 alone performs the commit and GC between two barriers.
     """
     path = os.path.abspath(path)
     os.makedirs(path, exist_ok=True)
-    arrays_dir = _ARRAYS_PREFIX + uuid.uuid4().hex[:8]
+    gen, prev_dir = _last_commit(path)
+    gen += 1
+    arrays_dir = f"{_ARRAYS_PREFIX}{gen:08d}"
+    # force=True also clears a partial dir left by a crashed earlier attempt
+    # at this same generation
     _checkpointer().save(os.path.join(path, arrays_dir), arrays, force=True)
-    meta = dict(meta)
-    meta["arrays_dir"] = arrays_dir
-    tmp = os.path.join(path, _META_FILE + ".tmp")
-    with open(tmp, "w") as f:
-        json.dump(meta, f, indent=1, sort_keys=True)
-        f.flush()
-        os.fsync(f.fileno())
-    os.replace(tmp, os.path.join(path, _META_FILE))  # commit point
-    # make the rename durable before deleting the superseded arrays — without
-    # this a power loss could persist the rmtree but not the new meta
-    dir_fd = os.open(path, os.O_RDONLY)
-    try:
-        os.fsync(dir_fd)
-    finally:
-        os.close(dir_fd)
-    for d in os.listdir(path):
-        if d.startswith(_ARRAYS_PREFIX) and d != arrays_dir:
-            shutil.rmtree(os.path.join(path, d), ignore_errors=True)
+    _barrier(f"ps_ckpt_precommit_{gen}")
+    if jax.process_index() == 0:
+        meta = dict(meta)
+        meta["arrays_dir"] = arrays_dir
+        meta["generation"] = gen
+        tmp = os.path.join(path, _META_FILE + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(meta, f, indent=1, sort_keys=True)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(path, _META_FILE))  # commit point
+        # make the rename durable before deleting superseded arrays — without
+        # this a power loss could persist the rmtree but not the new meta
+        dir_fd = os.open(path, os.O_RDONLY)
+        try:
+            os.fsync(dir_fd)
+        finally:
+            os.close(dir_fd)
+        # GC: keep the new arrays and the immediately-previous committed ones
+        # (a restore that read the old meta just before this commit can still
+        # complete); everything older is superseded twice over and deleted.
+        keep = {arrays_dir, prev_dir}
+        for d in os.listdir(path):
+            if d.startswith(_ARRAYS_PREFIX) and d not in keep:
+                shutil.rmtree(os.path.join(path, d), ignore_errors=True)
+    _barrier(f"ps_ckpt_commit_{gen}")
 
 
 def read_meta(path: str) -> Dict[str, Any]:

@@ -44,9 +44,20 @@ class Config:
       dc_lambda: DC-ASGD delay-compensation coefficient (async mode).
       seed: global PRNG seed.
       heartbeat_base_port: enable the control-plane failure detector for
-        multi-process runs: process i's monitor binds base_port+i and beats
-        every peer (localhost topology; multi-host deployments pass explicit
-        peers to ps_tpu.control.FailureDetector). ``None`` disables.
+        multi-process runs. Without ``peer_hosts``, process i's monitor binds
+        base_port+i on this host (single-host/localhost topology). With
+        ``peer_hosts``, it is the default monitor port for entries that name
+        no port. ``None`` disables the detector.
+      peer_hosts: per-process monitor addresses for multi-HOST pods:
+        comma-separated, entry i addresses process i, each ``host`` or
+        ``host:port`` (port defaults to ``heartbeat_base_port`` — distinct
+        hosts can share one port number). Example:
+        ``PS_PEER_HOSTS=10.0.0.1:7777,10.0.0.2:7777``.
+      heartbeat_bind: the monitor's listen address. Default (``None``)
+        follows the topology: ``0.0.0.0`` when ``peer_hosts`` names remote
+        machines, loopback for the single-host ``heartbeat_base_port``
+        layout — the detector is never exposed off-host unless the config
+        says the job spans hosts. Set explicitly to override either way.
       heartbeat_interval_ms / heartbeat_timeout_ms: beat cadence and the
         silent-horizon after which a peer is declared dead.
     """
@@ -61,8 +72,46 @@ class Config:
     dc_lambda: float = 0.04
     seed: int = 0
     heartbeat_base_port: Optional[int] = None
+    peer_hosts: Optional[str] = None
+    heartbeat_bind: Optional[str] = None
     heartbeat_interval_ms: int = 100
     heartbeat_timeout_ms: int = 1000
+
+    def resolved_heartbeat_bind(self) -> str:
+        """The monitor listen address: explicit setting, else 0.0.0.0 for
+        multi-host ``peer_hosts`` topologies and loopback otherwise."""
+        if self.heartbeat_bind is not None:
+            return self.heartbeat_bind
+        return "0.0.0.0" if self.peer_hosts else "127.0.0.1"
+
+    def heartbeat_peers(self) -> Optional[dict]:
+        """Resolve the full monitor address map ``{process_id: (host, port)}``
+        (including this process's own entry) from ``peer_hosts`` /
+        ``heartbeat_base_port``; ``None`` when the detector is disabled."""
+        if self.heartbeat_base_port is None and not self.peer_hosts:
+            return None
+        if self.peer_hosts:
+            entries = [e.strip() for e in self.peer_hosts.split(",") if e.strip()]
+            if len(entries) != self.num_processes:
+                raise ValueError(
+                    f"peer_hosts names {len(entries)} processes but "
+                    f"num_processes={self.num_processes}"
+                )
+            peers = {}
+            for i, e in enumerate(entries):
+                if ":" in e:
+                    host, port = e.rsplit(":", 1)
+                    peers[i] = (host, int(port))
+                elif self.heartbeat_base_port is not None:
+                    peers[i] = (e, self.heartbeat_base_port)
+                else:
+                    raise ValueError(
+                        f"peer_hosts entry {e!r} has no port and "
+                        "heartbeat_base_port is unset"
+                    )
+            return peers
+        base = self.heartbeat_base_port
+        return {i: ("127.0.0.1", base + i) for i in range(self.num_processes)}
 
     def __post_init__(self):
         if self.backend not in ("local", "tpu"):
@@ -99,6 +148,10 @@ class Config:
             kwargs["seed"] = int(env["PS_SEED"])
         if "PS_HEARTBEAT_BASE_PORT" in env:
             kwargs["heartbeat_base_port"] = int(env["PS_HEARTBEAT_BASE_PORT"])
+        if "PS_PEER_HOSTS" in env:
+            kwargs["peer_hosts"] = env["PS_PEER_HOSTS"]
+        if "PS_HEARTBEAT_BIND" in env:
+            kwargs["heartbeat_bind"] = env["PS_HEARTBEAT_BIND"]
         if "PS_HEARTBEAT_INTERVAL_MS" in env:
             kwargs["heartbeat_interval_ms"] = int(env["PS_HEARTBEAT_INTERVAL_MS"])
         if "PS_HEARTBEAT_TIMEOUT_MS" in env:

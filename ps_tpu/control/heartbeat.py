@@ -34,7 +34,7 @@ class WorkerFailureError(RuntimeError):
 def _lib():
     lib = load("van")
     lib.hb_server_start.restype = ctypes.c_void_p
-    lib.hb_server_start.argtypes = [ctypes.c_int, ctypes.c_int]
+    lib.hb_server_start.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
     lib.hb_server_port.restype = ctypes.c_int
     lib.hb_server_port.argtypes = [ctypes.c_void_p]
     lib.hb_server_poll.restype = ctypes.c_int
@@ -49,6 +49,7 @@ def _lib():
     lib.hb_client_start.argtypes = [
         ctypes.c_char_p, ctypes.c_int, ctypes.c_uint32, ctypes.c_int,
     ]
+    lib.hb_client_goodbye.argtypes = [ctypes.c_void_p]
     lib.hb_client_stop.argtypes = [ctypes.c_void_p]
     return lib
 
@@ -56,15 +57,25 @@ def _lib():
 class HeartbeatServer:
     """Liveness monitor: tracks every node that has ever beaten this port.
 
-    A node is *alive* while its beats arrive within ``timeout_ms`` and *dead*
-    once seen-then-silent longer than that.
+    A node is *alive* while its beats arrive within ``timeout_ms``, *dead*
+    once seen-then-silent longer than that, and *left* — permanently, never
+    dead — once its goodbye arrives (clean membership change ≠ failure).
+
+    ``bind`` is the listen address: "0.0.0.0" accepts beats from any host
+    (pod deployments), "127.0.0.1" restricts to this host (tests).
     """
 
-    def __init__(self, port: int = 0, timeout_ms: int = 1000):
+    def __init__(self, port: int = 0, timeout_ms: int = 1000,
+                 bind: str = "0.0.0.0"):
+        import socket
+
         self._lib = _lib()
-        self._h = self._lib.hb_server_start(port, timeout_ms)
+        addr = socket.gethostbyname(bind)  # names ok; native side wants IPv4
+        self._h = self._lib.hb_server_start(addr.encode(), port, timeout_ms)
         if not self._h:
-            raise OSError(f"heartbeat server failed to bind port {port}")
+            raise OSError(
+                f"heartbeat server failed to bind {bind} ({addr}):{port}"
+            )
 
     def _require(self):
         if not self._h:
@@ -85,7 +96,12 @@ class HeartbeatServer:
         return self._poll(0)
 
     def dead(self) -> List[int]:
+        """Seen, then silent past the horizon, with no goodbye."""
         return self._poll(1)
+
+    def left(self) -> List[int]:
+        """Nodes that announced a clean leave (goodbye received)."""
+        return self._poll(2)
 
     def seq(self, node_id: int) -> int:
         """Beats received from node_id (0 = never seen)."""
@@ -121,8 +137,12 @@ class HeartbeatClient:
         if not self._h:
             raise OSError(f"heartbeat client to {host} ({addr}):{port} failed")
 
-    def close(self) -> None:
+    def close(self, goodbye: bool = False) -> None:
+        """Stop beating. ``goodbye=True`` first announces a clean leave so
+        the peer marks this node *left* instead of eventually *dead*."""
         if self._h:
+            if goodbye:
+                self._lib.hb_client_goodbye(self._h)
             self._lib.hb_client_stop(self._h)
             self._h = None
 
@@ -140,29 +160,38 @@ class FailureDetector:
       node_id: this process's id.
       peers: ``{node_id: (host, port)}`` of every OTHER process's monitor.
       port: local monitor port (0 = ephemeral; see :attr:`server`).
+      bind: local monitor listen address ("0.0.0.0" for multi-host pods,
+        "127.0.0.1" to restrict to this host).
       interval_ms / timeout_ms: beat cadence and death horizon.
 
     Usage: construct everywhere, then call :meth:`check` between training
     steps — it raises :class:`WorkerFailureError` naming the dead peers
-    instead of letting the next collective hang.
+    instead of letting the next collective hang. A peer that closed with
+    ``goodbye=True`` is *left*, not dead: :meth:`check` stays silent.
     """
 
     def __init__(self, node_id: int, peers: Dict[int, Tuple[str, int]],
                  port: int = 0, interval_ms: int = 100,
-                 timeout_ms: int = 1000):
+                 timeout_ms: int = 1000, bind: str = "0.0.0.0"):
         self.node_id = node_id
         self.expected = sorted(peers)
-        self.server = HeartbeatServer(port=port, timeout_ms=timeout_ms)
+        self.server = HeartbeatServer(port=port, timeout_ms=timeout_ms,
+                                      bind=bind)
         self._clients = [
             HeartbeatClient(host, p, node_id, interval_ms)
             for _, (host, p) in sorted(peers.items())
         ]
 
     def check(self) -> None:
-        """Raise if any peer that ever beat us has gone silent."""
+        """Raise if any peer that ever beat us has gone silent (a clean
+        goodbye-leave never raises)."""
         dead = self.server.dead()
         if dead:
             raise WorkerFailureError(dead)
+
+    def left(self) -> List[int]:
+        """Peers that announced a clean leave."""
+        return self.server.left()
 
     def wait_for_peers(self, timeout_s: float = 30.0) -> None:
         """Block until every expected peer's first beat arrives (rendezvous
@@ -181,9 +210,11 @@ class FailureDetector:
             f"peers {missing} never started heartbeating within {timeout_s}s"
         )
 
-    def close(self) -> None:
+    def close(self, goodbye: bool = False) -> None:
+        """``goodbye=True`` announces a clean leave to every peer before
+        stopping (so survivors see *left*, not an eventual *dead*)."""
         for c in self._clients:
-            c.close()
+            c.close(goodbye=goodbye)
         self._clients = []
         self.server.close()
 

@@ -302,6 +302,16 @@ class KVStore:
                 return loss, params, aux
             return loss, params
 
+        def cost_analysis(batch, *extra):
+            """XLA HLO cost analysis of the whole fused step (gradient +
+            aggregation + server apply + pull) — no execution, no extra
+            compile: lowering stops at pre-optimization HLO, so 'flops' is
+            the exact model+optimizer arithmetic while 'bytes accessed' is an
+            unfused upper bound. Benchmarks turn this into MFU."""
+            params_kv, state = engine.get_tree_and_state()
+            return fused.lower(params_kv, state, batch, *extra).cost_analysis()
+
+        run.cost_analysis = cost_analysis
         return run
 
     def make_async_step(self, loss_fn, has_aux: bool = False):

@@ -25,6 +25,7 @@
 #include <cstring>
 #include <map>
 #include <mutex>
+#include <set>
 #include <thread>
 #include <vector>
 
@@ -33,12 +34,13 @@ namespace {
 using Clock = std::chrono::steady_clock;
 
 struct Beat {
-  uint32_t magic;    // 'PSHB'
+  uint32_t magic;    // 'PSHB' beat | 'PSGB' goodbye
   uint32_t node_id;
   uint64_t seq;
 };
 
-constexpr uint32_t kMagic = 0x50534842;  // "PSHB"
+constexpr uint32_t kMagic = 0x50534842;    // "PSHB"
+constexpr uint32_t kGoodbye = 0x50534742;  // "PSGB" — clean leave, not death
 
 struct Server {
   int fd = -1;
@@ -49,15 +51,38 @@ struct Server {
   std::mutex mu;
   std::map<uint32_t, Clock::time_point> last_seen;
   std::map<uint32_t, uint64_t> last_seq;
+  std::map<uint32_t, uint64_t> beat_addr;  // ip:port the node beats from
+  std::set<uint32_t> left;  // nodes that said goodbye: never declared dead
+
+  static uint64_t addr_key(const sockaddr_in& a) {
+    return ((uint64_t)a.sin_addr.s_addr << 16) | a.sin_port;
+  }
 
   void run() {
     Beat b;
+    sockaddr_in src{};
+    socklen_t slen = sizeof(src);
     while (!stop.load(std::memory_order_relaxed)) {
-      ssize_t n = recv(fd, &b, sizeof(b), 0);
-      if (n == (ssize_t)sizeof(b) && b.magic == kMagic) {
+      slen = sizeof(src);
+      ssize_t n = recvfrom(fd, &b, sizeof(b), 0, (sockaddr*)&src, &slen);
+      if (n == (ssize_t)sizeof(b) &&
+          (b.magic == kMagic || b.magic == kGoodbye)) {
         std::lock_guard<std::mutex> lock(mu);
+        if (b.magic == kGoodbye) {
+          // a goodbye permanently suppresses death detection for the node,
+          // so it is only honored from the exact source address the node's
+          // beats came from — a stray or forged datagram from anywhere
+          // else cannot silence the detector (beats share the client fd,
+          // so a genuine goodbye always matches)
+          auto it = beat_addr.find(b.node_id);
+          if (it == beat_addr.end() || it->second != addr_key(src)) continue;
+          left.insert(b.node_id);
+          last_seen[b.node_id] = Clock::now();
+          continue;
+        }
         last_seen[b.node_id] = Clock::now();
         last_seq[b.node_id] = b.seq;
+        beat_addr[b.node_id] = addr_key(src);
       }
       // timeouts fall through so the stop flag is polled
     }
@@ -86,16 +111,19 @@ struct Client {
 
 extern "C" {
 
-// Start a heartbeat monitor bound to `port` (0 = ephemeral). A node is
-// "alive" once its first beat arrives and "dead" when silent > timeout_ms.
-void* hb_server_start(int port, int timeout_ms) {
+// Start a heartbeat monitor bound to `bind_addr:port` (0 = ephemeral port).
+// `bind_addr` is a dotted-quad IPv4 address — "0.0.0.0" accepts beats from
+// any host (pod deployments), "127.0.0.1" restricts to this host (tests).
+// A node is "alive" once its first beat arrives and "dead" when silent >
+// timeout_ms — unless it said goodbye first (clean leave, state "left").
+void* hb_server_start(const char* bind_addr, int port, int timeout_ms) {
   // no SO_REUSEADDR: a port collision must fail loudly at bind, not split
   // the beat stream between two silently-coexisting sockets
-  int fd = socket(AF_INET, SOCK_DGRAM, 0);
-  if (fd < 0) return nullptr;
   sockaddr_in addr{};
   addr.sin_family = AF_INET;
-  addr.sin_addr.s_addr = htonl(INADDR_LOOPBACK);
+  if (inet_pton(AF_INET, bind_addr, &addr.sin_addr) != 1) return nullptr;
+  int fd = socket(AF_INET, SOCK_DGRAM, 0);
+  if (fd < 0) return nullptr;
   addr.sin_port = htons((uint16_t)port);
   if (bind(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
     close(fd);
@@ -117,7 +145,8 @@ void* hb_server_start(int port, int timeout_ms) {
 int hb_server_port(void* h) { return static_cast<Server*>(h)->port; }
 
 // Fill `out` (capacity `cap`) with ids in the given state; returns the count.
-// state 0 = alive (beating within timeout), 1 = dead (seen, then silent).
+// state 0 = alive (beating within timeout), 1 = dead (seen, then silent
+// WITHOUT a goodbye), 2 = left (sent a goodbye — clean membership change).
 int hb_server_poll(void* h, int state, uint32_t* out, int cap) {
   auto* s = static_cast<Server*>(h);
   auto now = Clock::now();
@@ -125,8 +154,9 @@ int hb_server_poll(void* h, int state, uint32_t* out, int cap) {
   std::lock_guard<std::mutex> lock(s->mu);
   int n = 0;
   for (const auto& kv : s->last_seen) {
-    bool dead = (now - kv.second) > horizon;
-    if ((state == 1) == dead && n < cap) out[n++] = kv.first;
+    int st = s->left.count(kv.first) ? 2
+             : ((now - kv.second) > horizon ? 1 : 0);
+    if (st == state && n < cap) out[n++] = kv.first;
   }
   return n;
 }
@@ -164,6 +194,19 @@ void* hb_client_start(const char* host, int port, uint32_t node_id,
   c->dest = dest;
   c->tx = std::thread([c] { c->run(); });
   return c;
+}
+
+// Announce a clean leave: a burst of goodbye datagrams (UDP may drop some;
+// any one arriving flips the peer's state to "left" permanently). Safe to
+// call while the beat thread runs — concurrent sendto on one UDP fd is
+// per-datagram atomic.
+void hb_client_goodbye(void* h) {
+  auto* c = static_cast<Client*>(h);
+  Beat b{kGoodbye, c->node_id, ~0ull};
+  for (int i = 0; i < 3; ++i) {
+    sendto(c->fd, &b, sizeof(b), 0, (sockaddr*)&c->dest, sizeof(c->dest));
+    std::this_thread::sleep_for(std::chrono::milliseconds(1));
+  }
 }
 
 void hb_client_stop(void* h) {

@@ -207,10 +207,18 @@ def test_resave_is_crash_safe_and_gcs_old_arrays(tmp_path):
     store.push_all(_grads_like(params, 0))
     store.save(path)
     meta = ps.checkpoint.read_meta(path)
-    # a resave commits by meta replace: new arrays dir, old one GC'd
+    # a resave commits by meta replace: new generation-numbered arrays dir;
+    # the previous generation is retained (concurrent-restore grace) ...
     assert meta["arrays_dir"] != first
-    dirs = [d for d in os.listdir(path) if d.startswith("arrays-")]
-    assert dirs == [meta["arrays_dir"]]
+    dirs = sorted(d for d in os.listdir(path) if d.startswith("arrays-"))
+    assert dirs == sorted([first, meta["arrays_dir"]])
+    # ... and a third save GCs the oldest, keeping exactly two generations
+    store.push_all(_grads_like(params, 1))
+    store.save(path)
+    meta3 = ps.checkpoint.read_meta(path)
+    dirs = sorted(d for d in os.listdir(path) if d.startswith("arrays-"))
+    assert dirs == sorted([meta["arrays_dir"], meta3["arrays_dir"]])
+    assert meta3["generation"] == meta["generation"] + 1
     ps.shutdown()
 
 

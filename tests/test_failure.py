@@ -104,6 +104,57 @@ def test_detector_wait_for_peers_timeout():
     d.close()
 
 
+def test_clean_leave_is_not_death():
+    """A client that closes with goodbye=True becomes *left*, never *dead*:
+    the surviving detector's check() stays silent past the death horizon."""
+    pa, pb = _free_udp_port(), _free_udp_port()
+    a = FailureDetector(0, peers={1: ("127.0.0.1", pb)}, port=pa,
+                        interval_ms=40, timeout_ms=300)
+    b = FailureDetector(1, peers={0: ("127.0.0.1", pa)}, port=pb,
+                        interval_ms=40, timeout_ms=300)
+    a.wait_for_peers(timeout_s=5)
+    b.wait_for_peers(timeout_s=5)
+    b.close(goodbye=True)  # clean leave
+    assert _wait_until(lambda: a.left() == [1], timeout=2.0)
+    time.sleep(0.5)  # well past timeout_ms: silence after goodbye stays clean
+    a.check()  # must not raise
+    assert a.server.dead() == []
+    assert a.left() == [1]
+    a.close()
+
+
+def test_forged_goodbye_is_ignored():
+    """A goodbye is only honored from the exact source address the node's
+    beats come from: a datagram forged from any other socket must not
+    silence death detection (code-review r3 finding on the 'left' state)."""
+    import struct
+
+    with HeartbeatServer(timeout_ms=400, bind="127.0.0.1") as srv:
+        c = HeartbeatClient("127.0.0.1", srv.port, node_id=5, interval_ms=40)
+        assert _wait_until(lambda: srv.alive() == [5])
+        # forge a goodbye for node 5 from a different socket (source port
+        # differs from the beating client's fd)
+        forged = struct.pack("<IIQ", 0x50534742, 5, 2**64 - 1)
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            for _ in range(3):
+                s.sendto(forged, ("127.0.0.1", srv.port))
+        time.sleep(0.2)
+        assert srv.left() == []  # forgery rejected
+        assert srv.alive() == [5]
+        c.close()  # silent stop: a real death must still be detected
+        assert _wait_until(lambda: srv.dead() == [5], timeout=2.0)
+
+
+def test_bind_loopback_and_any():
+    """Both bind modes produce a working monitor (the pod-real default is
+    0.0.0.0; tests may confine to loopback)."""
+    for bind in ("0.0.0.0", "127.0.0.1"):
+        with HeartbeatServer(timeout_ms=300, bind=bind) as srv:
+            with HeartbeatClient("127.0.0.1", srv.port, node_id=3,
+                                 interval_ms=30):
+                assert _wait_until(lambda: srv.alive() == [3]), bind
+
+
 # -- layer 2: kill a process mid-run -----------------------------------------
 
 
@@ -143,3 +194,40 @@ def test_kill_process_mid_run_surfaces_typed_error(tmp_path):
         assert len(r["losses"]) >= 1  # it really was mid-run
     # timely: well under the 10-step runtime, nowhere near a hang
     assert elapsed < 120, f"detection took {elapsed:.1f}s"
+
+
+@pytest.mark.slow
+def test_clean_leave_mid_run_no_error(tmp_path):
+    """3 processes with heartbeats on; process 2 leaves CLEANLY after step 0
+    (goodbye + barrier-free teardown). Survivors must observe *left* — not
+    raise WorkerFailureError — and exit 0 through ps.shutdown(abort=True)."""
+    nproc, leaver = 3, 2
+    port = _free_port()
+    hb_base = _free_udp_port()
+    env_base = {k: v for k, v in os.environ.items()
+                if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env_base["PYTHONPATH"] = _REPO + os.pathsep + env_base.get("PYTHONPATH", "")
+    env_base["PS_TEST_LEAVER"] = str(leaver)
+    env_base["PS_HEARTBEAT_BASE_PORT"] = str(hb_base)
+    env_base["PS_HEARTBEAT_TIMEOUT_MS"] = "500"
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, str(pid), str(nproc), str(port),
+             str(tmp_path), "1", "10"],
+            env=dict(env_base),
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for pid in range(nproc)
+    ]
+    outs = [p.communicate(timeout=180)[0] for p in procs]
+    for pid in range(nproc):
+        assert procs[pid].returncode == 0, f"proc {pid}:\n{outs[pid]}"
+    with open(os.path.join(tmp_path, f"proc{leaver}.json")) as f:
+        assert json.load(f)["left"] is True
+    for pid in (0, 1):
+        with open(os.path.join(tmp_path, f"proc{pid}.json")) as f:
+            r = json.load(f)
+        # the other survivor's own clean goodbye may race into the snapshot;
+        # what matters is the leaver was seen as LEFT and nobody saw a death
+        assert leaver in r["left_detected"], r
+        assert "failure_detected" not in r
