@@ -207,16 +207,24 @@ class LocalServer(PeekMixin, CheckpointMixin):
             "staleness_hist": {str(t): n for t, n in self.staleness_hist.items()},
         }
 
-    def _load_checkpoint_meta(self, meta):
-        import collections
-
-        for field in ("mode", "num_workers", "aggregate"):
+    def _validate_checkpoint_meta(self, meta, elastic=False):
+        # mode/aggregate always strict (different math, not topology);
+        # num_workers relaxes under elastic resume
+        strict = ("mode", "aggregate") if elastic else (
+            "mode", "num_workers", "aggregate")
+        for field in strict:
             if meta[field] != getattr(self, field):
                 raise ValueError(
                     f"checkpoint was written with {field}={meta[field]!r} but "
                     f"this store runs {field}={getattr(self, field)!r} — "
                     f"resume semantics would differ"
                 )
+
+    def _load_checkpoint_meta(self, meta, elastic=False):
+        import collections
+
+        from ps_tpu.checkpoint import keep_worker
+
         self._pending = {}
         self.apply_count = {k: int(v) for k, v in meta["apply_count"].items()}
         # .get defaults accept checkpoints from before version accounting
@@ -224,6 +232,7 @@ class LocalServer(PeekMixin, CheckpointMixin):
         self._partial_applies = int(meta.get("partial_applies", 0))
         self._worker_version = {
             int(w): int(v) for w, v in meta.get("worker_version", {}).items()
+            if keep_worker(int(w), self.num_workers, elastic)
         }
         self.staleness_hist = collections.Counter(
             {int(t): int(n) for t, n in meta.get("staleness_hist", {}).items()}

@@ -223,17 +223,24 @@ class AsyncTpuServer(PeekMixin, CheckpointMixin):
             "collective_bytes": self.collective_bytes,
         }
 
-    def _load_checkpoint_meta(self, meta):
-        import collections
-
-        if meta["num_workers"] != self.num_workers:
+    def _validate_checkpoint_meta(self, meta, elastic=False):
+        if meta["num_workers"] != self.num_workers and not elastic:
             raise ValueError(
                 f"checkpoint was written with num_workers={meta['num_workers']} "
                 f"but this store runs num_workers={self.num_workers} — "
-                f"staleness semantics would differ"
+                f"staleness semantics would differ (restore(elastic=True) "
+                f"remaps: surviving workers keep their versions, removed "
+                f"workers' state is dropped, new workers join fresh)"
             )
+
+    def _load_checkpoint_meta(self, meta, elastic=False):
+        import collections
+
+        from ps_tpu.checkpoint import keep_worker
+
         self._worker_version = {
             int(w): int(v) for w, v in meta["worker_version"].items()
+            if keep_worker(int(w), self.num_workers, elastic)
         }
         self._applies = int(meta["applies"])
         # .get defaults accept checkpoints from before tree-granularity
@@ -417,10 +424,13 @@ class TpuServer(PeekMixin, CheckpointMixin):
             "collective_bytes": self.collective_bytes,
         }
 
-    def _load_checkpoint_meta(self, meta):
+    def _load_checkpoint_meta(self, meta, elastic=False):
+        del elastic  # sync SPMD state is topology-free: shardings are live
         self._staged = {}
         self.apply_count = int(meta["apply_count"])
         self.collective_bytes = int(meta["collective_bytes"])
+
+    # no _validate_checkpoint_meta: nothing topology-bound to refuse
 
     # -- internals for the fused train step ---------------------------------
 

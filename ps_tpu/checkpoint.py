@@ -145,9 +145,13 @@ def restore(path: str, abstract: Any, meta: Optional[Dict[str, Any]] = None) -> 
     if meta is None:
         meta = read_meta(path)
     restore_args = ocp.checkpoint_utils.construct_restore_args(abstract)
+    # partial_restore: the targets may name a SUBSET of the saved tree (an
+    # elastic shrink skips dropped workers' snapshots); untargeted leaves
+    # are never read off disk
     out = _checkpointer().restore(
         os.path.join(os.path.abspath(path), meta["arrays_dir"]),
-        args=ocp.args.PyTreeRestore(item=abstract, restore_args=restore_args),
+        args=ocp.args.PyTreeRestore(item=abstract, restore_args=restore_args,
+                                    partial_restore=True),
     )
 
     # orbax restores some small/scalar leaves onto the default device only;
@@ -203,6 +207,13 @@ def decode_stale_key(s: str):
     return int(w), key
 
 
+def keep_worker(worker: int, num_workers, elastic: bool) -> bool:
+    """THE elastic remap policy, in one place: an elastic shrink drops all
+    per-worker state (stale snapshots, cached pulls, version-vector entries)
+    of workers >= the new worker count; everything else survives."""
+    return not (elastic and num_workers is not None and worker >= num_workers)
+
+
 # -- shared engine checkpoint surface ----------------------------------------
 
 
@@ -225,8 +236,20 @@ class CheckpointMixin:
         """Engine-specific JSON-able counters (versions, apply counts)."""
         return {}
 
-    def _load_checkpoint_meta(self, meta: Dict[str, Any]) -> None:
-        """Adopt the counters written by :meth:`_checkpoint_meta`."""
+    def _validate_checkpoint_meta(self, meta: Dict[str, Any],
+                                  elastic: bool = False) -> None:
+        """Reject a semantically-incompatible checkpoint. Runs BEFORE any
+        engine state is mutated, so a refused restore leaves the live engine
+        exactly as it was (a caller may catch and fall back to fresh
+        training). ``elastic`` relaxes topology equality (worker count) for
+        cross-topology resume."""
+
+    def _load_checkpoint_meta(self, meta: Dict[str, Any],
+                              elastic: bool = False) -> None:
+        """Adopt the counters written by :meth:`_checkpoint_meta` (the meta
+        already passed :meth:`_validate_checkpoint_meta`). Under ``elastic``,
+        engines drop per-worker entries of workers that no longer exist
+        (:func:`keep_worker`) and let new workers join fresh."""
 
     # -- shared implementation ----------------------------------------------
 
@@ -251,18 +274,24 @@ class CheckpointMixin:
         meta.update(self._checkpoint_meta())
         return arrays, meta
 
-    def abstract_state_dict(self, meta):
+    def abstract_state_dict(self, meta, elastic: bool = False):
+        """Restore targets from the LIVE engine (live shardings = elastic
+        mesh restore for free). Under ``elastic``, dropped workers' stale
+        snapshots are excluded from the targets so their bytes are never
+        read off disk."""
         ab_params = abstract_like(dict(self._params))
+        nw = getattr(self, "num_workers", None)
         return {
             "params": ab_params,
             "opt": abstract_like(flatten_leaves(self._state)),
             "stale": {
                 s: ab_params[decode_stale_key(s)[1]]
                 for s in meta.get("stale_keys", [])
+                if keep_worker(decode_stale_key(s)[0], nw, elastic)
             },
         }
 
-    def load_state_dict(self, arrays, meta):
+    def load_state_dict(self, arrays, meta, elastic: bool = False):
         if meta.get("engine") != self.engine_name:
             raise ValueError(
                 f"checkpoint was written by engine {meta.get('engine')!r} but "
@@ -278,10 +307,15 @@ class CheckpointMixin:
                 f"saved with (saved {meta['opt_structure']!r}, "
                 f"live {live_structure!r})"
             )
+        # all validation — including the engine's topology checks — happens
+        # before any mutation: a refused restore leaves the engine untouched
+        self._validate_checkpoint_meta(meta, elastic=elastic)
         self._params = dict(arrays["params"])
         self._state = unflatten_like(self._state, arrays["opt"])
         if hasattr(self, "_stale"):
+            nw = getattr(self, "num_workers", None)
             self._stale = {
                 decode_stale_key(s): v for s, v in arrays["stale"].items()
+                if keep_worker(decode_stale_key(s)[0], nw, elastic)
             }
-        self._load_checkpoint_meta(meta)
+        self._load_checkpoint_meta(meta, elastic=elastic)

@@ -425,14 +425,24 @@ class KVStore:
         }
         ckpt.save(path, arrays, meta)
 
-    def restore(self, path: str) -> Any:
+    def restore(self, path: str, elastic: bool = False) -> Any:
         """Restore a checkpoint written by :meth:`save` into this store.
 
         Must be called after ``init(params)`` with the same parameter
         structure and optimizer, so shardings and state wiring exist; every
         value is then overwritten in place and training resumes
         bit-identically (tests/test_checkpoint.py). Returns the restored
-        parameter pytree."""
+        parameter pytree.
+
+        Elastic resume (SURVEY.md §6 "elastic resharding"): the restore
+        targets carry the LIVE mesh's shardings, so a checkpoint written on
+        one mesh size restores onto another (8→4, 4→8) with identical
+        values — orbax reshards on read. ``elastic=True`` additionally
+        relaxes the async ``num_workers`` equality check: surviving workers
+        keep their version-vector entries and stale snapshots, removed
+        workers' are dropped, and new workers join fresh (their first pull
+        sets their version; pull before pushing, as make_async_step does).
+        """
         from ps_tpu import checkpoint as ckpt
 
         self._require_init()
@@ -446,15 +456,19 @@ class KVStore:
                 + (f"; differing keys include {diff}" if diff
                    else "; same keys in a different order")
             )
-        abstract = self._engine.abstract_state_dict(meta)
+        nw = getattr(self._engine, "num_workers", None)
+        abstract = self._engine.abstract_state_dict(meta, elastic=elastic)
         ab_params = abstract["params"]
+        # dropped workers' caches are excluded from the restore targets too:
+        # an elastic shrink never reads ex-workers' bytes off disk
         abstract["worker_cache"] = {
             s: ab_params[ckpt.decode_stale_key(s)[1]]
             for s in meta["store"]["cache_keys"]
+            if ckpt.keep_worker(ckpt.decode_stale_key(s)[0], nw, elastic)
         }
         arrays = ckpt.restore(path, abstract, meta)
         cache = arrays.pop("worker_cache")
-        self._engine.load_state_dict(arrays, meta)
+        self._engine.load_state_dict(arrays, meta, elastic=elastic)
         st = meta["store"]
         self.step = int(st["step"])
         self.bytes_pushed = int(st["bytes_pushed"])
@@ -466,7 +480,8 @@ class KVStore:
             by_worker.setdefault(w, {})[k] = v
         for s in st.get("cache_stale_aliases", []):
             w, k = ckpt.decode_stale_key(s)
-            by_worker.setdefault(w, {})[k] = stale[(w, k)]
+            if ckpt.keep_worker(w, nw, elastic):
+                by_worker.setdefault(w, {})[k] = stale[(w, k)]
         self._async_params = {
             w: keymod.unflatten(self._treedef, kv, self._key_order)
             for w, kv in by_worker.items()
