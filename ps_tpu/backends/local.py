@@ -15,8 +15,9 @@ Semantics implemented here (the spec the TPU backend must match numerically):
   logical workers have pushed for the current step; gradients are averaged
   (matching data-parallel pmean semantics). A pull that would observe a
   half-aggregated key raises instead of silently returning stale values.
-- **Async apply** (mode='async'): every push applies immediately with
-  DC-ASGD delay compensation against the pusher's last-pulled version.
+- **Async apply** (mode='async'): whole-tree pushes apply immediately with
+  DC-ASGD delay compensation against the pusher's last-pulled version;
+  per-key pushes stage per worker and commit as one tree (AsyncStagingMixin).
 """
 
 from __future__ import annotations
@@ -27,15 +28,15 @@ import jax
 import optax
 
 from ps_tpu.backends.common import (
+    AsyncStagingMixin,
     PeekMixin,
-    make_jit_dc_apply,
     make_jit_dc_apply_tree,
 )
 from ps_tpu.checkpoint import CheckpointMixin
 from ps_tpu.config import Config
 
 
-class LocalServer(PeekMixin, CheckpointMixin):
+class LocalServer(PeekMixin, AsyncStagingMixin, CheckpointMixin):
     """In-memory server for one KVStore: params + per-key optimizer state."""
 
     def __init__(self, optimizer: optax.GradientTransformation, num_workers: int,
@@ -59,7 +60,8 @@ class LocalServer(PeekMixin, CheckpointMixin):
         self.apply_count: Dict[str, int] = {}
         # async version vector: tree-granularity, mirroring AsyncTpuServer
         self._version = 0
-        self._partial_applies = 0
+        self._partial_applies = 0  # vestigial (pre-staging checkpoints)
+        self._staged_async = {}  # worker -> {key: grad} (async per-key staging)
         self._worker_version: Dict[int, int] = {}
         self.staleness_hist = collections.Counter()
         # serializes applies/pulls, like the reference server's apply loop
@@ -70,7 +72,6 @@ class LocalServer(PeekMixin, CheckpointMixin):
             return optax.apply_updates(param, updates), new_state
 
         self._jit_apply = jax.jit(_apply)
-        self._jit_apply_dc = make_jit_dc_apply(optimizer)
         self._jit_apply_dc_tree = make_jit_dc_apply_tree(optimizer)
 
     # -- registration -------------------------------------------------------
@@ -94,7 +95,9 @@ class LocalServer(PeekMixin, CheckpointMixin):
             raise ValueError(f"worker {worker} out of range [0, {self.num_workers})")
         with self._lock:
             if self.mode == "async":
-                self._apply_async(key, grad, worker)
+                # stage per worker; commit as ONE fused tree apply when this
+                # worker's tree completes (AsyncStagingMixin)
+                self._stage_async_push(key, grad, worker)
                 return
             slot = self._pending.setdefault(key, {})
             if worker in slot:
@@ -127,29 +130,21 @@ class LocalServer(PeekMixin, CheckpointMixin):
         if not (0 <= worker < self.num_workers):
             raise ValueError(f"worker {worker} out of range [0, {self.num_workers})")
         with self._lock:
-            stales = {
-                k: self._stale.get((worker, k), self._params[k])
-                for k in self._params
-            }
-            self._params, self._state = self._jit_apply_dc_tree(
-                self._params, self._state, grads_kv, stales, self.dc_lambda
-            )
-            for k in grads_kv:
-                self.apply_count[k] += 1
-            self.staleness_hist[self.staleness(worker)] += 1
-            self._version += 1
+            self._commit_tree(grads_kv, worker)
 
-    def _apply_async(self, key: str, grad: jax.Array, worker: int) -> None:
-        stale = self._stale.get((worker, key), self._params[key])
-        self._params[key], self._state[key] = self._jit_apply_dc(
-            self._params[key], self._state[key], grad, stale, self.dc_lambda
+    def _commit_tree(self, grads_kv: Dict[str, jax.Array], worker: int) -> None:
+        """Fused DC apply of a full tree (lock held; AsyncStagingMixin)."""
+        stales = {
+            k: self._stale.get((worker, k), self._params[k])
+            for k in self._params
+        }
+        self._params, self._state = self._jit_apply_dc_tree(
+            self._params, self._state, grads_kv, stales, self.dc_lambda
         )
-        self.apply_count[key] += 1
-        self._partial_applies += 1
-        if self._partial_applies >= len(self._params):
-            self._partial_applies = 0
-            self.staleness_hist[self.staleness(worker)] += 1
-            self._version += 1
+        for k in grads_kv:
+            self.apply_count[k] += 1
+        self.staleness_hist[self.staleness(worker)] += 1
+        self._version += 1
 
     def pull(self, key: str, worker: int = 0) -> jax.Array:
         if key not in self._params:
@@ -194,6 +189,7 @@ class LocalServer(PeekMixin, CheckpointMixin):
                 f"cannot checkpoint mid-step: keys {sorted(self._pending)} "
                 f"have pending sync pushes"
             )
+        self._check_staged_async()
 
     def _checkpoint_meta(self):
         return {

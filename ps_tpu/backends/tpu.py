@@ -42,21 +42,22 @@ from ps_tpu.parallel.sharding import (
 
 
 from ps_tpu.backends.common import (
+    AsyncStagingMixin,
     PeekMixin,
-    make_jit_dc_apply,
     make_jit_dc_apply_tree,
 )
 from ps_tpu.checkpoint import CheckpointMixin
 
 
-class AsyncTpuServer(PeekMixin, CheckpointMixin):
+class AsyncTpuServer(PeekMixin, AsyncStagingMixin, CheckpointMixin):
     """Mesh-placed parameter server with ASYNC (stale, delay-compensated)
     apply — reference workload config 5 (SURVEY.md §4d).
 
     Semantics mirror the local backend's async mode exactly (the spec; parity
-    asserted in tests/test_async_tpu.py): every push applies immediately with
-    the DC-ASGD correction against the pusher's last-pulled snapshot of that
-    key. The difference is placement: params and per-key optimizer state live
+    asserted in tests/test_async_tpu.py): every whole-tree push applies
+    immediately with the DC-ASGD correction against the pusher's last-pulled
+    snapshot of that key; per-key pushes stage and commit as one tree
+    (AsyncStagingMixin). The difference is placement: params and state live
     on the mesh (replicated or ZeRO-1 sharded), and each worker's gradient
     computation runs SPMD over the mesh — the mesh plays the reference's
     intra-node GPU set (the grad psum = NCCL reduce), while the *logical*
@@ -90,16 +91,16 @@ class AsyncTpuServer(PeekMixin, CheckpointMixin):
         self._params: Dict[str, jax.Array] = {}
         self._state: Dict[str, Any] = {}
         self._stale: Dict[tuple, jax.Array] = {}
+        self._staged_async: Dict[int, Dict[str, Any]] = {}  # per-key staging
         self._worker_version: Dict[int, int] = {}
         self._applies = 0          # total per-key applies (any granularity)
         self._version = 0          # whole-model versions
-        self._partial_applies = 0  # per-key applies since last version bump
+        self._partial_applies = 0  # vestigial (pre-staging checkpoints)
         self.apply_count: Dict[str, int] = {}
         self.collective_bytes = 0
         self.staleness_hist = collections.Counter()  # τ -> whole-tree pushes
         self._lock = threading.RLock()
 
-        self._jit_apply_dc = make_jit_dc_apply(optimizer)
         self._jit_apply_dc_tree = make_jit_dc_apply_tree(optimizer)
 
     @property
@@ -133,27 +134,15 @@ class AsyncTpuServer(PeekMixin, CheckpointMixin):
             raise ValueError(f"worker {worker} out of range [0, {self.num_workers})")
 
     def push(self, key: str, grad: Any, worker: int = 0) -> None:
-        """Per-key compatibility path: one jitted DC apply per key. A full
-        tree's worth of per-key pushes advances the version by one."""
+        """Per-key compatibility path: stages per worker and commits the
+        whole tree through ONE fused dispatch when this worker's last key
+        arrives (AsyncStagingMixin — N-key push costs one dispatch, and the
+        version/staleness sample is attributed to the completing worker)."""
         if key not in self._params:
             raise KeyError(f"unregistered key {key!r}")
         self._check_worker(worker)
         with self._lock:
-            stale = self._stale.get((worker, key), self._params[key])
-            self._params[key], self._state[key] = self._jit_apply_dc(
-                self._params[key], self._state[key], grad, stale, self.dc_lambda
-            )
-            self.apply_count[key] += 1
-            self._applies += 1
-            self._partial_applies += 1
-            if self._partial_applies >= len(self._params):
-                self._partial_applies = 0
-                self.staleness_hist[self.staleness(worker)] += 1
-                self._version += 1
-            k = self.mesh.shape[DATA_AXIS]
-            self.collective_bytes += collectives.allreduce_bytes(
-                {key: self._params[key]}, k
-            )
+            self._stage_async_push(key, grad, worker)
 
     def push_tree(self, grads_kv: Dict[str, Any], worker: int = 0) -> None:
         """Fused whole-tree async push: ONE XLA dispatch applies every key's
@@ -164,20 +153,24 @@ class AsyncTpuServer(PeekMixin, CheckpointMixin):
             raise ValueError("gradient keys do not match registered keys")
         self._check_worker(worker)
         with self._lock:
-            stales = {
-                k: self._stale.get((worker, k), self._params[k])
-                for k in self._params
-            }
-            self._params, self._state = self._jit_apply_dc_tree(
-                self._params, self._state, grads_kv, stales, self.dc_lambda
-            )
-            for k in grads_kv:
-                self.apply_count[k] += 1
-            self._applies += len(grads_kv)
-            self.staleness_hist[self.staleness(worker)] += 1
-            self._version += 1
-            k = self.mesh.shape[DATA_AXIS]
-            self.collective_bytes += collectives.allreduce_bytes(self._params, k)
+            self._commit_tree(grads_kv, worker)
+
+    def _commit_tree(self, grads_kv: Dict[str, Any], worker: int) -> None:
+        """Fused DC apply of a full tree (lock held)."""
+        stales = {
+            k: self._stale.get((worker, k), self._params[k])
+            for k in self._params
+        }
+        self._params, self._state = self._jit_apply_dc_tree(
+            self._params, self._state, grads_kv, stales, self.dc_lambda
+        )
+        for k in grads_kv:
+            self.apply_count[k] += 1
+        self._applies += len(grads_kv)
+        self.staleness_hist[self.staleness(worker)] += 1
+        self._version += 1
+        k = self.mesh.shape[DATA_AXIS]
+        self.collective_bytes += collectives.allreduce_bytes(self._params, k)
 
     def pull(self, key: str, worker: int = 0) -> jax.Array:
         if key not in self._params:
@@ -222,6 +215,9 @@ class AsyncTpuServer(PeekMixin, CheckpointMixin):
             "apply_count": dict(self.apply_count),
             "collective_bytes": self.collective_bytes,
         }
+
+    def _check_checkpointable(self):
+        self._check_staged_async()
 
     def _validate_checkpoint_meta(self, meta, elastic=False):
         if meta["num_workers"] != self.num_workers and not elastic:
