@@ -59,28 +59,60 @@ class AsyncStagingMixin:
     are attributed to the worker that actually completed a tree, never to
     whichever worker happened to push last under interleaving (ADVICE r2).
 
-    Semantics note: keys of a partially-pushed tree are unapplied until the
-    tree completes; a concurrent pull observes the pre-commit parameters
-    (previously each key applied immediately). Final post-tree state is
-    numerically identical — keys are independent under per-tensor
-    optimizers.
+    Liveness: a worker that pushes only a SUBSET of keys commits that
+    partial tree the moment it pulls (the pull marks the end of its push
+    phase in the PS cycle), so per-key callers that never touch every key
+    still make progress — one dispatch per push-pull cycle. Keys are
+    independent under per-tensor optimizers, so a partial commit is
+    numerically the same as the old immediate per-key applies.
 
-    Engine contract: ``self._staged_async`` dict exists, ``self._params`` is
-    the registered key set, caller holds the engine lock, and
-    ``self._commit_tree(grads_kv, worker)`` performs the fused apply.
+    Engine contract: ``self._staged_async``/``self._params``/``self._state``/
+    ``self._stale`` dicts, ``self._jit_apply_dc_tree``, ``self.dc_lambda``,
+    ``self.apply_count``, ``self.staleness_hist``, ``self._version`` exist;
+    the caller holds the engine lock. Engines may override
+    ``_commit_tree_accounting`` for extra per-commit counters.
     """
 
     def _stage_async_push(self, key, grad, worker) -> None:
         staged = self._staged_async.setdefault(worker, {})
         if key in staged:
             raise RuntimeError(
-                f"worker {worker} pushed key {key!r} twice before completing "
-                f"a tree — per-key async pushes commit at tree granularity"
+                f"worker {worker} pushed key {key!r} twice before committing "
+                f"— per-key async pushes commit when the full tree is pushed "
+                f"or at this worker's next pull (partial tree)"
             )
         staged[key] = grad
         if len(staged) == len(self._params):
             del self._staged_async[worker]
             self._commit_tree(staged, worker)
+
+    def _flush_staged(self, worker) -> None:
+        """Commit this worker's staged partial tree, if any (call at the top
+        of every async pull, lock held)."""
+        staged = self._staged_async.pop(worker, None)
+        if staged:
+            self._commit_tree(staged, worker)
+
+    def _commit_tree(self, grads_kv, worker) -> None:
+        """ONE fused DC apply of a (possibly partial) tree — lock held."""
+        sub_p = {k: self._params[k] for k in grads_kv}
+        sub_s = {k: self._state[k] for k in grads_kv}
+        stales = {
+            k: self._stale.get((worker, k), self._params[k]) for k in grads_kv
+        }
+        new_p, new_s = self._jit_apply_dc_tree(
+            sub_p, sub_s, grads_kv, stales, self.dc_lambda
+        )
+        self._params.update(new_p)
+        self._state.update(new_s)
+        for k in grads_kv:
+            self.apply_count[k] += 1
+        self.staleness_hist[self.staleness(worker)] += 1
+        self._version += 1
+        self._commit_tree_accounting(grads_kv)
+
+    def _commit_tree_accounting(self, grads_kv) -> None:
+        """Engine hook: extra counters per committed tree (default none)."""
 
     def _check_staged_async(self) -> None:
         """Checkpoint guard: staged-but-uncommitted grads would be lost."""

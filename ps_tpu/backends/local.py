@@ -60,7 +60,6 @@ class LocalServer(PeekMixin, AsyncStagingMixin, CheckpointMixin):
         self.apply_count: Dict[str, int] = {}
         # async version vector: tree-granularity, mirroring AsyncTpuServer
         self._version = 0
-        self._partial_applies = 0  # vestigial (pre-staging checkpoints)
         self._staged_async = {}  # worker -> {key: grad} (async per-key staging)
         self._worker_version: Dict[int, int] = {}
         self.staleness_hist = collections.Counter()
@@ -132,20 +131,6 @@ class LocalServer(PeekMixin, AsyncStagingMixin, CheckpointMixin):
         with self._lock:
             self._commit_tree(grads_kv, worker)
 
-    def _commit_tree(self, grads_kv: Dict[str, jax.Array], worker: int) -> None:
-        """Fused DC apply of a full tree (lock held; AsyncStagingMixin)."""
-        stales = {
-            k: self._stale.get((worker, k), self._params[k])
-            for k in self._params
-        }
-        self._params, self._state = self._jit_apply_dc_tree(
-            self._params, self._state, grads_kv, stales, self.dc_lambda
-        )
-        for k in grads_kv:
-            self.apply_count[k] += 1
-        self.staleness_hist[self.staleness(worker)] += 1
-        self._version += 1
-
     def pull(self, key: str, worker: int = 0) -> jax.Array:
         if key not in self._params:
             raise KeyError(f"unregistered key {key!r}")
@@ -157,6 +142,7 @@ class LocalServer(PeekMixin, AsyncStagingMixin, CheckpointMixin):
                     f"{self.num_workers} have pushed this step"
                 )
             if self.mode == "async":
+                self._flush_staged(worker)  # pull ends the push phase
                 self._stale[(worker, key)] = self._params[key]
                 self._worker_version[worker] = self._version
             return self._params[key]
@@ -198,7 +184,6 @@ class LocalServer(PeekMixin, AsyncStagingMixin, CheckpointMixin):
             "aggregate": self.aggregate,
             "apply_count": dict(self.apply_count),
             "version": self._version,
-            "partial_applies": self._partial_applies,
             "worker_version": {str(w): v for w, v in self._worker_version.items()},
             "staleness_hist": {str(t): n for t, n in self.staleness_hist.items()},
         }
@@ -225,7 +210,6 @@ class LocalServer(PeekMixin, AsyncStagingMixin, CheckpointMixin):
         self.apply_count = {k: int(v) for k, v in meta["apply_count"].items()}
         # .get defaults accept checkpoints from before version accounting
         self._version = int(meta.get("version", 0))
-        self._partial_applies = int(meta.get("partial_applies", 0))
         self._worker_version = {
             int(w): int(v) for w, v in meta.get("worker_version", {}).items()
             if keep_worker(int(w), self.num_workers, elastic)

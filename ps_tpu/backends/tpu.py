@@ -95,7 +95,6 @@ class AsyncTpuServer(PeekMixin, AsyncStagingMixin, CheckpointMixin):
         self._worker_version: Dict[int, int] = {}
         self._applies = 0          # total per-key applies (any granularity)
         self._version = 0          # whole-model versions
-        self._partial_applies = 0  # vestigial (pre-staging checkpoints)
         self.apply_count: Dict[str, int] = {}
         self.collective_bytes = 0
         self.staleness_hist = collections.Counter()  # τ -> whole-tree pushes
@@ -155,27 +154,18 @@ class AsyncTpuServer(PeekMixin, AsyncStagingMixin, CheckpointMixin):
         with self._lock:
             self._commit_tree(grads_kv, worker)
 
-    def _commit_tree(self, grads_kv: Dict[str, Any], worker: int) -> None:
-        """Fused DC apply of a full tree (lock held)."""
-        stales = {
-            k: self._stale.get((worker, k), self._params[k])
-            for k in self._params
-        }
-        self._params, self._state = self._jit_apply_dc_tree(
-            self._params, self._state, grads_kv, stales, self.dc_lambda
-        )
-        for k in grads_kv:
-            self.apply_count[k] += 1
+    def _commit_tree_accounting(self, grads_kv) -> None:
         self._applies += len(grads_kv)
-        self.staleness_hist[self.staleness(worker)] += 1
-        self._version += 1
         k = self.mesh.shape[DATA_AXIS]
-        self.collective_bytes += collectives.allreduce_bytes(self._params, k)
+        self.collective_bytes += collectives.allreduce_bytes(
+            {key: self._params[key] for key in grads_kv}, k
+        )
 
     def pull(self, key: str, worker: int = 0) -> jax.Array:
         if key not in self._params:
             raise KeyError(f"unregistered key {key!r}")
         with self._lock:
+            self._flush_staged(worker)  # pull ends this worker's push phase
             self._stale[(worker, key)] = self._params[key]
             self._worker_version[worker] = self.version
             return self._params[key]
@@ -185,6 +175,7 @@ class AsyncTpuServer(PeekMixin, AsyncStagingMixin, CheckpointMixin):
         from ONE server state — a concurrent push cannot interleave between
         two keys of the same pull (the torn-read hazard of per-key pulls)."""
         with self._lock:
+            self._flush_staged(worker)  # pull ends this worker's push phase
             for k, v in self._params.items():
                 self._stale[(worker, k)] = v
             self._worker_version[worker] = self.version
@@ -208,7 +199,6 @@ class AsyncTpuServer(PeekMixin, AsyncStagingMixin, CheckpointMixin):
         return {
             "applies": self._applies,
             "version": self._version,
-            "partial_applies": self._partial_applies,
             "staleness_hist": {str(t): n for t, n in self.staleness_hist.items()},
             "num_workers": self.num_workers,
             "worker_version": {str(w): v for w, v in self._worker_version.items()},
@@ -244,7 +234,6 @@ class AsyncTpuServer(PeekMixin, AsyncStagingMixin, CheckpointMixin):
         self._version = int(
             meta.get("version", self._applies // max(len(self._params), 1))
         )
-        self._partial_applies = int(meta.get("partial_applies", 0))
         self.staleness_hist = collections.Counter(
             {int(t): int(n) for t, n in meta.get("staleness_hist", {}).items()}
         )

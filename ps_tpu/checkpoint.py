@@ -312,6 +312,10 @@ class CheckpointMixin:
         self._validate_checkpoint_meta(meta, elastic=elastic)
         self._params = dict(arrays["params"])
         self._state = unflatten_like(self._state, arrays["opt"])
+        if hasattr(self, "_staged_async"):
+            # in-flight per-key pushes belong to the pre-restore timeline; a
+            # later commit would splice stale grads into the restored params
+            self._staged_async = {}
         if hasattr(self, "_stale"):
             nw = getattr(self, "num_workers", None)
             self._stale = {

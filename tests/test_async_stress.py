@@ -224,3 +224,52 @@ def test_per_key_pushes_commit_as_one_dispatch():
     assert calls["n"] == 2 and eng.version == 2
     assert eng._staged_async == {}
     ps.shutdown()
+
+
+@pytest.mark.parametrize("backend", ["local", "tpu"])
+def test_subset_per_key_push_commits_on_pull(backend):
+    """A worker that pushes only SOME keys still makes progress: its staged
+    partial tree commits at its next pull (code-review r3 liveness finding),
+    and a restore clears pre-restore staging."""
+    _, params = _params()
+    ps.init(backend=backend, mode="async", num_workers=1)
+    store = ps.KVStore(optimizer="sgd", learning_rate=LR, mode="async")
+    store.init(params)
+    eng = store._engine
+    from ps_tpu.kv import keys as keymod
+
+    kv, _ = keymod.flatten_with_keys(_grads_like(params, 0))
+    k0 = store.keys()[0]
+    before = np.asarray(eng.peek(k0))
+    eng.push(k0, kv[k0])            # subset: stages, no commit yet
+    np.testing.assert_array_equal(before, np.asarray(eng.peek(k0)))
+    got = eng.pull(k0)              # pull commits the partial tree
+    assert eng.version == 1
+    assert not np.allclose(before, np.asarray(got))
+    others = [k for k in store.keys() if k != k0]
+    for k in others:                # untouched keys stayed untouched
+        assert eng.apply_count[k] == 0
+    ps.shutdown()
+
+
+def test_restore_clears_staged_pushes(tmp_path):
+    _, params = _params()
+    path = str(tmp_path / "ckpt")
+    ps.init(backend="tpu", mode="async", num_workers=1)
+    store = ps.KVStore(optimizer="sgd", learning_rate=LR, mode="async")
+    store.init(params)
+    store.save(path)
+    from ps_tpu.kv import keys as keymod
+
+    kv, _ = keymod.flatten_with_keys(_grads_like(params, 0))
+    k0 = store.keys()[0]
+    store._engine.push(k0, kv[k0])  # staged, uncommitted
+    saved = jax.tree_util.tree_map(np.asarray, store.params())
+    restored = store.restore(path)
+    assert store._engine._staged_async == {}  # pre-restore staging dropped
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_array_equal(a, np.asarray(b)),
+        saved, restored,
+    )
+    store._engine.push(k0, kv[k0])  # no spurious 'pushed twice'
+    ps.shutdown()
