@@ -112,6 +112,12 @@ def main():
           f"dense ICI {s['ici_gb_per_device']:.3f} GB, "
           f"sparse row traffic {emb_gb:.3f} GB "
           f"(+{(deep.collective_bytes + wide.collective_bytes)/1e9:.3f} GB/device collective)")
+    for name, emb in (("deep", deep), ("wide", wide)):
+        if emb.exchange == "a2a":
+            print(f"  {name}: a2a dropped {emb.dropped_rows} of "
+                  f"{emb.rows_pushed} rows "
+                  f"({100 * emb.dropped_fraction:.3f}%) — raise "
+                  f"--capacity-factor if this is not ~0")
     log.close()
 
 

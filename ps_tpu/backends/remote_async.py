@@ -1,0 +1,262 @@
+"""Cross-process async PS: server state in ONE process, workers elsewhere.
+
+This is the reference's actual async deployment shape (SURVEY.md §4d: the
+server applies each worker's stale gradient immediately; workers are
+separate, unsynchronized NODES — not host threads). The sync path collapses
+into SPMD collectives; async cannot, by design, so it runs host-side:
+
+- the SERVER process owns an async ``KVStore`` (``AsyncTpuServer`` engine —
+  params + per-key state on ITS mesh, DC-ASGD applies, tree-granularity
+  version vector) and serves it over the native van's TCP layer
+  (:class:`AsyncPSService`);
+- each WORKER process runs :class:`RemoteAsyncWorker`: pull params, compute
+  gradients on its OWN jax devices, push — one ``PUSH_PULL`` round trip per
+  cycle. Staleness is real cross-process staleness: whatever other workers
+  committed between this worker's pull and its push.
+
+Parity contract (tests/test_remote_async.py, tests/mp_async_worker.py): the
+server records its apply order; replaying that exact (worker, grads)
+sequence through a threaded ``AsyncTpuServer`` yields bit-identical
+parameters — the wire changes nothing about the math.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ps_tpu.control import tensor_van as tv
+from ps_tpu.kv import keys as keymod
+
+
+class AsyncPSService:
+    """Serve an async KVStore to remote workers over the tensor van.
+
+    Args:
+      store: an initialized async-mode KVStore (the server engine).
+      port: TCP port (0 = ephemeral; read :attr:`port`).
+      bind: listen address ("0.0.0.0" pod-wide, "127.0.0.1" tests).
+    """
+
+    def __init__(self, store, port: int = 0, bind: str = "0.0.0.0"):
+        engine = store._engine
+        if getattr(engine, "mode", "sync") != "async":
+            raise ValueError("AsyncPSService requires an async-mode KVStore")
+        self._store = store
+        self._engine = engine
+        self._key_order = list(store._key_order)
+        self._listener = tv.Listener(port=port, bind=bind)
+        self._stop = threading.Event()
+        self._conns: List[threading.Thread] = []
+        self._log_lock = threading.Lock()
+        self.apply_log: List[int] = []  # worker id per committed tree, in order
+        # full ordered (op, worker) history — "pull" records matter because
+        # the DC apply depends on WHAT each worker last pulled; replaying
+        # this log through a threaded engine reproduces params bit-for-bit
+        self.event_log: List[List] = []
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True
+        )
+        self._accept_thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._listener.port
+
+    # -- server internals -----------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            ch = self._listener.accept(timeout_ms=200)
+            if ch is None:
+                continue
+            t = threading.Thread(target=self._serve, args=(ch,), daemon=True)
+            t.start()
+            self._conns.append(t)
+
+    def _params_payload(self, worker: int) -> bytes:
+        # engine lock makes snapshot+version+log-append atomic (torn-read
+        # hazard, and the event log must mirror true engine order)
+        with self._engine._lock:
+            kv = self._engine.pull_tree(worker=worker)
+            version = self._engine.version
+            with self._log_lock:
+                self.event_log.append(["pull", worker])
+        host = {k: np.asarray(v) for k, v in kv.items()}
+        return tv.encode(tv.OK, worker, host, extra={"version": version})
+
+    def _apply_push(self, worker: int, grads: Dict[str, np.ndarray]) -> None:
+        if sorted(grads) != sorted(self._key_order):
+            raise KeyError("push keys do not match the registered tree")
+        # copy out of the recv buffer: the engine may keep references beyond
+        # this frame's lifetime
+        grads = {k: np.array(v) for k, v in grads.items()}
+        with self._engine._lock:
+            self._engine.push_tree(grads, worker=worker)
+            with self._log_lock:
+                self.apply_log.append(worker)
+                self.event_log.append(["push", worker])
+
+    def _serve(self, ch: tv.Channel) -> None:
+        try:
+            while not self._stop.is_set():
+                try:
+                    msg = ch.recv()
+                except tv.VanError:
+                    return  # worker hung up
+                kind, worker, tensors, extra = tv.decode(msg)
+                try:
+                    if kind == tv.HELLO:
+                        ch.send(tv.encode(tv.OK, worker, None, extra={
+                            "keys": self._key_order,
+                            "version": self._engine.version,
+                            "num_workers": self._engine.num_workers,
+                        }))
+                    elif kind == tv.PULL:
+                        ch.send(self._params_payload(worker))
+                    elif kind == tv.PUSH:
+                        self._apply_push(worker, tensors)
+                        ch.send(tv.encode(tv.OK, worker, None, extra={
+                            "version": self._engine.version,
+                        }))
+                    elif kind == tv.PUSH_PULL:
+                        self._apply_push(worker, tensors)
+                        ch.send(self._params_payload(worker))
+                    elif kind == tv.STATS:
+                        with self._log_lock:
+                            log = list(self.apply_log)
+                        ch.send(tv.encode(tv.OK, worker, None, extra={
+                            "version": self._engine.version,
+                            "staleness_hist": {
+                                str(t): n for t, n in
+                                self._engine.staleness_hist.items()
+                            },
+                            "apply_log": log,
+                            "worker_version": {
+                                str(w): v for w, v in
+                                self._engine._worker_version.items()
+                            },
+                        }))
+                    elif kind == tv.SHUTDOWN:
+                        ch.send(tv.encode(tv.OK, worker, None))
+                        return
+                    else:
+                        ch.send(tv.encode(tv.ERR, worker, None,
+                                          extra={"error": f"bad kind {kind}"}))
+                except Exception as e:  # surface server-side errors to worker
+                    ch.send(tv.encode(tv.ERR, worker, None,
+                                      extra={"error": repr(e)}))
+        finally:
+            ch.close()
+
+    def stop(self) -> None:
+        self._stop.set()
+        # join BEFORE closing: the accept thread may be inside tv_accept on
+        # the listener handle (its 200ms timeout bounds the wait); closing
+        # first would hand it a freed pointer
+        self._accept_thread.join(timeout=5)
+        self._listener.close()
+
+
+class RemoteAsyncWorker:
+    """A worker NODE of the cross-process async PS.
+
+    Computes gradients on this process's own jax devices against the params
+    it last pulled (stale by whatever other workers pushed since), and
+    exchanges them with the server over one TCP round trip per cycle.
+    """
+
+    def __init__(self, host: str, port: int, worker: int, params_like):
+        self.worker = worker
+        kv, self._treedef = keymod.flatten_with_keys(params_like)
+        self._key_order = sorted(kv)
+        self._ch = tv.Channel.connect(host, port)
+        _, _, _, extra = tv.decode(
+            self._ch.request(tv.encode(tv.HELLO, worker, None))
+        )
+        if sorted(extra["keys"]) != self._key_order:
+            raise ValueError(
+                "server tree does not match this worker's params structure"
+            )
+        self.version = int(extra["version"])
+        self._params = None
+
+    # -- protocol -------------------------------------------------------------
+
+    def _unpack_params(self, msg) -> Any:
+        kind, _, tensors, extra = tv.decode(msg)
+        if kind != tv.OK:
+            raise RuntimeError(f"server error: {extra.get('error')}")
+        import jax.numpy as jnp
+
+        self.version = int(extra["version"])
+        kv = {k: jnp.asarray(np.array(v)) for k, v in tensors.items()}
+        self._params = keymod.unflatten(self._treedef, kv, self._key_order)
+        return self._params
+
+    def pull_all(self) -> Any:
+        """Fetch current params (server records this worker's snapshot)."""
+        return self._unpack_params(
+            self._ch.request(tv.encode(tv.PULL, self.worker, None))
+        )
+
+    def push_all(self, grads) -> None:
+        """Push a gradient tree; the server applies it immediately with the
+        DC-ASGD correction against this worker's last pull."""
+        kv, _ = keymod.flatten_with_keys(grads)
+        msg = self._ch.request(tv.encode(
+            tv.PUSH, self.worker, {k: np.asarray(v) for k, v in kv.items()}
+        ))
+        kind, _, _, extra = tv.decode(msg)
+        if kind != tv.OK:
+            raise RuntimeError(f"server error: {extra.get('error')}")
+        self.version = int(extra["version"])
+
+    def push_pull(self, grads) -> Any:
+        """push_all + pull_all in ONE round trip (the async cycle)."""
+        kv, _ = keymod.flatten_with_keys(grads)
+        return self._unpack_params(self._ch.request(tv.encode(
+            tv.PUSH_PULL, self.worker,
+            {k: np.asarray(v) for k, v in kv.items()}
+        )))
+
+    def stats(self) -> dict:
+        _, _, _, extra = tv.decode(
+            self._ch.request(tv.encode(tv.STATS, self.worker, None))
+        )
+        return extra
+
+    def make_async_step(self, loss_fn, has_aux: bool = False):
+        """``run(batch, *extra) -> loss`` — grad against the last-pulled
+        (stale) params on THIS process's devices, then one push_pull."""
+        import jax
+
+        grad_fn = jax.jit(jax.value_and_grad(loss_fn, has_aux=has_aux))
+
+        def run(batch, *extra):
+            params = self._params if self._params is not None else self.pull_all()
+            if has_aux:
+                (loss, aux), grads = grad_fn(params, batch, *extra)
+            else:
+                loss, grads = grad_fn(params, batch, *extra)
+                aux = None
+            self.push_pull(grads)
+            return (loss, aux) if has_aux else loss
+
+        return run
+
+    def close(self) -> None:
+        try:
+            self._ch.request(tv.encode(tv.SHUTDOWN, self.worker, None))
+        except tv.VanError:
+            pass
+        self._ch.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,203 @@
+"""Framed tensor messages over the native TCP van.
+
+The async data plane (SURVEY.md §4d): async workers are separate,
+deliberately unsynchronized OS processes, so their grad/param exchange with
+the server process cannot ride an XLA collective — it travels as framed byte
+messages over the native van's TCP layer (``tv_*`` in ps_tpu/native/van.cpp;
+this module does the encoding). A message is::
+
+    [u8 kind][u32 worker_id][u64 meta_len][meta json][raw buffers...]
+
+where the json carries each tensor's (name, dtype, shape, nbytes) in order,
+followed by the concatenated raw row-major buffers — no pickling, no copies
+beyond the single send buffer.
+
+Channel/Listener are thin blocking wrappers over the C ABI; ctypes releases
+the GIL during sends/recvs, so a multi-MB push never stalls other Python
+threads (the server serves each connection from its own thread).
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import struct
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+from ps_tpu.native import load
+
+# message kinds (u8)
+HELLO = 0       # worker announces itself; server replies SERVER_INFO
+PULL = 1        # -> params + version
+PUSH = 2        # grads -> ack (applied with DC; version advances)
+PUSH_PULL = 3   # grads -> params + version (one round trip per cycle)
+STATS = 4       # -> json: version, staleness_hist, apply_log
+SHUTDOWN = 5    # server drains and stops serving this connection
+OK = 6
+ERR = 7
+
+_HDR = struct.Struct("<BIQ")  # kind, worker_id, meta_len
+
+
+def _lib():
+    lib = load("van")
+    lib.tv_listen.restype = ctypes.c_void_p
+    lib.tv_listen.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+    lib.tv_listener_port.restype = ctypes.c_int
+    lib.tv_listener_port.argtypes = [ctypes.c_void_p]
+    lib.tv_accept.restype = ctypes.c_void_p
+    lib.tv_accept.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.tv_listener_close.argtypes = [ctypes.c_void_p]
+    lib.tv_connect.restype = ctypes.c_void_p
+    lib.tv_connect.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
+    lib.tv_send.restype = ctypes.c_int
+    lib.tv_send.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+    lib.tv_recv_size.restype = ctypes.c_int64
+    lib.tv_recv_size.argtypes = [ctypes.c_void_p]
+    lib.tv_recv_into.restype = ctypes.c_int
+    lib.tv_recv_into.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                 ctypes.c_uint64]
+    lib.tv_close.argtypes = [ctypes.c_void_p]
+    return lib
+
+
+# -- tensor-tree codec -------------------------------------------------------
+
+
+def encode(kind: int, worker: int, tensors: Optional[Dict[str, np.ndarray]],
+           extra: Optional[dict] = None) -> bytes:
+    """One message: header + json meta (+ optional 'extra' json fields) +
+    concatenated raw buffers. Keys are encoded in sorted order."""
+    names = sorted(tensors) if tensors else []
+    arrays = [np.ascontiguousarray(np.asarray(tensors[n])) for n in names]
+    meta = {
+        "tensors": [
+            {"name": n, "dtype": a.dtype.str, "shape": list(a.shape)}
+            for n, a in zip(names, arrays)
+        ],
+        "extra": extra or {},
+    }
+    mj = json.dumps(meta).encode()
+    parts = [_HDR.pack(kind, worker, len(mj)), mj]
+    parts += [a.tobytes() for a in arrays]
+    return b"".join(parts)
+
+
+def decode(buf: memoryview) -> Tuple[int, int, Dict[str, np.ndarray], dict]:
+    """Inverse of :func:`encode`; tensor buffers are zero-copy views."""
+    kind, worker, mlen = _HDR.unpack_from(buf, 0)
+    off = _HDR.size
+    meta = json.loads(bytes(buf[off:off + mlen]))
+    off += mlen
+    tensors = {}
+    for t in meta["tensors"]:
+        dt = np.dtype(t["dtype"])
+        n = int(np.prod(t["shape"], dtype=np.int64)) * dt.itemsize
+        tensors[t["name"]] = np.frombuffer(
+            buf[off:off + n], dtype=dt
+        ).reshape(t["shape"])
+        off += n
+    return kind, worker, tensors, meta.get("extra", {})
+
+
+# -- blocking channel / listener ---------------------------------------------
+
+
+class VanError(ConnectionError):
+    """The peer closed or the frame was invalid."""
+
+
+class Channel:
+    """One framed TCP connection (blocking; one driving thread at a time)."""
+
+    def __init__(self, handle, lib):
+        self._h = handle
+        self._lib = lib
+
+    @classmethod
+    def connect(cls, host: str, port: int, timeout_ms: int = 10_000,
+                retries: int = 50, retry_delay_s: float = 0.1) -> "Channel":
+        """Dial host:port, retrying while the server comes up."""
+        import socket as pysocket
+        import time
+
+        lib = _lib()
+        addr = pysocket.gethostbyname(host)
+        for attempt in range(retries):
+            h = lib.tv_connect(addr.encode(), port, timeout_ms)
+            if h:
+                return cls(h, lib)
+            time.sleep(retry_delay_s)
+        raise VanError(f"could not connect to {host}:{port} "
+                       f"after {retries} attempts")
+
+    def send(self, payload: bytes) -> None:
+        if not self._lib.tv_send(self._h, payload, len(payload)):
+            self.close()  # half-sent frame: the stream is unusable
+            raise VanError("send failed: peer closed")
+
+    def recv(self) -> memoryview:
+        n = self._lib.tv_recv_size(self._h)
+        if n < 0:
+            # EOF, or an insane length word — either way the framing is
+            # gone; poison the channel so a caught error can't silently
+            # misparse the next bytes as a fresh frame
+            self.close()
+            raise VanError("recv failed: peer closed" if n == -1
+                           else "recv failed: oversized frame")
+        buf = bytearray(n)
+        if n and not self._lib.tv_recv_into(
+                self._h, (ctypes.c_char * n).from_buffer(buf), n):
+            self.close()
+            raise VanError("recv failed mid-frame: peer closed")
+        return memoryview(buf)
+
+    def request(self, payload: bytes) -> memoryview:
+        self.send(payload)
+        return self.recv()
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.tv_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+
+
+class Listener:
+    """Accept loop handle for the server side."""
+
+    def __init__(self, port: int = 0, bind: str = "0.0.0.0",
+                 backlog: int = 64):
+        import socket as pysocket
+
+        self._lib = _lib()
+        addr = pysocket.gethostbyname(bind)
+        self._h = self._lib.tv_listen(addr.encode(), port, backlog)
+        if not self._h:
+            raise OSError(f"tensor van failed to listen on {bind}:{port}")
+
+    @property
+    def port(self) -> int:
+        return self._lib.tv_listener_port(self._h)
+
+    def accept(self, timeout_ms: int = -1) -> Optional[Channel]:
+        h = self._lib.tv_accept(self._h, timeout_ms)
+        return Channel(h, self._lib) if h else None
+
+    def close(self) -> None:
+        if self._h:
+            self._lib.tv_listener_close(self._h)
+            self._h = None
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

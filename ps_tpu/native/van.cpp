@@ -1,20 +1,30 @@
-// Control-plane "van": heartbeat liveness over UDP.
+// Native "van": heartbeat liveness over UDP + framed tensor messages over
+// TCP.
 //
 // The reference family's ZMQ van carries BOTH the data plane (tensor
 // push/pull) and the control plane (connect/barrier/heartbeat). On TPU the
-// data plane is XLA collectives over ICI/DCN (SURVEY.md §3 row 9) — what
-// remains host-side is liveness: every node beats, every node watches its
-// peers, and a silent peer is declared dead after a timeout instead of the
-// job hanging in a collective. This file is that control plane, kept native
-// (C++, like the reference's van) so beat/poll latency is independent of the
-// Python interpreter (GIL pauses during jit dispatch must not fake a death).
+// sync data plane is XLA collectives over ICI/DCN (SURVEY.md §3 row 9); what
+// remains host-side is (a) liveness — every node beats, every node watches
+// its peers, a silent peer is declared dead instead of the job hanging in a
+// collective — and (b) the ASYNC data plane (SURVEY.md §4d): async workers
+// are deliberately unsynchronized processes, so their grad/param exchange
+// with the server process cannot ride a collective and travels as framed
+// byte messages over TCP (the `tv_*` ABI below; ps_tpu/control/tensor_van.py
+// does the tensor encoding). Kept native (C++, like the reference's van) so
+// beat/poll latency and bulk sends are independent of the Python
+// interpreter (GIL pauses during jit dispatch must not fake a death, and a
+// multi-MB push must not stall the beat loops).
 //
-// Exposed as a C ABI for ctypes (ps_tpu/control/heartbeat.py). Threading
-// model: one receiver thread per server, one sender thread per client;
-// handles are opaque pointers; all public calls are thread-safe.
+// Exposed as a C ABI for ctypes (ps_tpu/control/heartbeat.py,
+// ps_tpu/control/tensor_van.py). Threading model: one receiver thread per
+// heartbeat server, one sender thread per heartbeat client; TCP handles are
+// plain blocking sockets driven by the caller's threads (ctypes releases
+// the GIL for the duration of each call); handles are opaque pointers.
 
 #include <arpa/inet.h>
 #include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <cerrno>
 #include <sys/socket.h>
 #include <sys/time.h>
 #include <unistd.h>
@@ -213,6 +223,174 @@ void hb_client_stop(void* h) {
   auto* c = static_cast<Client*>(h);
   c->stop.store(true);
   if (c->tx.joinable()) c->tx.join();
+  close(c->fd);
+  delete c;
+}
+
+// ---------------------------------------------------------------------------
+// Tensor van: length-framed byte messages over TCP. A frame on the wire is
+// [u64 little-endian length][length bytes]. The payload encoding (tensor
+// trees) lives in Python; this layer only moves opaque frames reliably.
+// All calls are blocking (ctypes releases the GIL); one connection is meant
+// to be driven by one thread at a time.
+
+namespace {
+
+constexpr uint64_t kMaxFrame = 1ull << 34;  // 16 GiB sanity bound
+
+bool read_exact(int fd, void* buf, uint64_t n) {
+  auto* p = static_cast<char*>(buf);
+  while (n > 0) {
+    ssize_t r = recv(fd, p, n, 0);
+    if (r <= 0) {
+      if (r < 0 && (errno == EINTR)) continue;
+      return false;  // peer closed or hard error
+    }
+    p += r;
+    n -= (uint64_t)r;
+  }
+  return true;
+}
+
+bool write_exact(int fd, const void* buf, uint64_t n) {
+  auto* p = static_cast<const char*>(buf);
+  while (n > 0) {
+    ssize_t r = send(fd, p, n, MSG_NOSIGNAL);
+    if (r <= 0) {
+      if (r < 0 && errno == EINTR) continue;
+      return false;
+    }
+    p += r;
+    n -= (uint64_t)r;
+  }
+  return true;
+}
+
+struct Listener {
+  int fd = -1;
+  int port = 0;
+};
+
+struct Conn {
+  int fd = -1;
+  uint64_t pending = 0;  // size of the frame body announced but not yet read
+};
+
+}  // namespace
+
+// Listen on bind_addr:port (0 = ephemeral). Returns nullptr on failure.
+void* tv_listen(const char* bind_addr, int port, int backlog) {
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  if (inet_pton(AF_INET, bind_addr, &addr.sin_addr) != 1) return nullptr;
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof(one));
+  addr.sin_port = htons((uint16_t)port);
+  if (bind(fd, (sockaddr*)&addr, sizeof(addr)) != 0 ||
+      listen(fd, backlog) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  socklen_t len = sizeof(addr);
+  getsockname(fd, (sockaddr*)&addr, &len);
+  auto* l = new Listener();
+  l->fd = fd;
+  l->port = ntohs(addr.sin_port);
+  return l;
+}
+
+int tv_listener_port(void* h) { return static_cast<Listener*>(h)->port; }
+
+// Accept one connection; timeout_ms < 0 blocks forever; returns nullptr on
+// timeout or listener close.
+void* tv_accept(void* h, int timeout_ms) {
+  auto* l = static_cast<Listener*>(h);
+  if (timeout_ms >= 0) {
+    timeval tv{timeout_ms / 1000, (timeout_ms % 1000) * 1000};
+    setsockopt(l->fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  } else {
+    timeval tv{0, 0};
+    setsockopt(l->fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  }
+  int fd = accept(l->fd, nullptr, nullptr);
+  if (fd < 0) return nullptr;
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  auto* c = new Conn();
+  c->fd = fd;
+  return c;
+}
+
+void tv_listener_close(void* h) {
+  auto* l = static_cast<Listener*>(h);
+  close(l->fd);
+  delete l;
+}
+
+// Connect to host:port (dotted quad; Python resolves names). nullptr on
+// failure/timeout. timeout_ms bounds the CONNECT only — once connected the
+// socket blocks indefinitely (a server mid-jit-compile may legitimately
+// take minutes to answer; a short lingering SO_RCVTIMEO would misreport
+// that as a dead peer and desync the framing).
+void* tv_connect(const char* host, int port, int timeout_ms) {
+  sockaddr_in addr{};
+  addr.sin_family = AF_INET;
+  addr.sin_port = htons((uint16_t)port);
+  if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) return nullptr;
+  int fd = socket(AF_INET, SOCK_STREAM, 0);
+  if (fd < 0) return nullptr;
+  if (timeout_ms >= 0) {
+    timeval tv{timeout_ms / 1000, (timeout_ms % 1000) * 1000};
+    setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &tv, sizeof(tv));
+    setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
+  }
+  if (connect(fd, (sockaddr*)&addr, sizeof(addr)) != 0) {
+    close(fd);
+    return nullptr;
+  }
+  timeval off{0, 0};  // clear the connect deadline: block forever from here
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &off, sizeof(off));
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &off, sizeof(off));
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  auto* c = new Conn();
+  c->fd = fd;
+  return c;
+}
+
+// Send one frame. Returns 1 on success, 0 on a broken connection.
+int tv_send(void* h, const void* buf, uint64_t n) {
+  auto* c = static_cast<Conn*>(h);
+  uint64_t len_le = n;  // this ABI is little-endian-host only (x86/ARM)
+  if (!write_exact(c->fd, &len_le, sizeof(len_le))) return 0;
+  return write_exact(c->fd, buf, n) ? 1 : 0;
+}
+
+// Read the next frame's size (blocking). Returns -1 on EOF/error, -2 on an
+// insane frame. The body MUST then be drained with tv_recv_into.
+int64_t tv_recv_size(void* h) {
+  auto* c = static_cast<Conn*>(h);
+  uint64_t n = 0;
+  if (!read_exact(c->fd, &n, sizeof(n))) return -1;
+  if (n > kMaxFrame) return -2;
+  c->pending = n;
+  return (int64_t)n;
+}
+
+// Read exactly n bytes of the announced frame body into buf. 1 on success.
+int tv_recv_into(void* h, void* buf, uint64_t n) {
+  auto* c = static_cast<Conn*>(h);
+  if (n > c->pending) return 0;
+  if (!read_exact(c->fd, buf, n)) return 0;
+  c->pending -= n;
+  return 1;
+}
+
+void tv_close(void* h) {
+  auto* c = static_cast<Conn*>(h);
+  shutdown(c->fd, SHUT_RDWR);
   close(c->fd);
   delete c;
 }

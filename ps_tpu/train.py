@@ -77,14 +77,15 @@ def make_composite_step(
             aux = None
         updates, state = opt.update(gkv, state, params_kv)
         params_kv = optax.apply_updates(params_kv, updates)
+        dropped = {}
         for n in names:
             store = emb_stores[n]
             flat_ids = ids[n].reshape(-1)
             flat_grows = grows[n].reshape(-1, store.dim)
-            tables[n], estates[n] = store.apply(
+            tables[n], estates[n], dropped[n] = store.apply(
                 tables[n], estates[n], flat_ids, flat_grows
             )
-        return params_kv, state, tables, estates, loss, aux
+        return params_kv, state, tables, estates, loss, aux, dropped
 
     sizes: Dict[str, int] = {}
 
@@ -97,7 +98,7 @@ def make_composite_step(
         params_kv, state = engine.get_tree_and_state()
         tables = {n: emb_stores[n].table for n in names}
         estates = {n: emb_stores[n]._state for n in names}
-        params_kv, state, tables, estates, loss, aux = fused(
+        params_kv, state, tables, estates, loss, aux, dropped = fused(
             params_kv, state, tables, estates, batch, *extra
         )
         engine.set_tree_and_state(params_kv, state)
@@ -108,6 +109,7 @@ def make_composite_step(
         for n in names:
             store = emb_stores[n]
             store._table, store._state = tables[n], estates[n]
+            store.record_dropped(dropped[n])  # sync-free; read at log time
             row_bytes = sizes[n] * store.dim * np.dtype(store.dtype).itemsize
             store.bytes_pushed += row_bytes   # row grads out
             store.bytes_pulled += row_bytes   # gathered rows in

@@ -1,0 +1,123 @@
+"""Subprocess entries for the cross-process async PS test
+(tests/test_remote_async.py).
+
+Roles (argv[1]):
+  server <port> <out_dir> <nworkers> <cycles>
+      owns the async KVStore + AsyncPSService; waits until every worker's
+      pushes arrived, then dumps final params (exact bytes), the apply/pull
+      event log, and the staleness histogram.
+  worker <port> <out_dir> <worker_id> <cycles>
+      a separate async NODE: pull -> local grad (deterministic fn of
+      (worker, cycle)) -> push, with jitter so pushes interleave across
+      processes and real cross-process staleness accrues.
+
+The parity contract: replaying the server's event log through a threaded
+AsyncTpuServer in the parent reproduces the final params bit-for-bit.
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def _model_params():
+    import jax
+    import jax.numpy as jnp
+
+    from ps_tpu.models.mlp import MLP
+
+    model = MLP(hidden=16)
+    return model.init(jax.random.key(0), jnp.zeros((1, 28, 28, 1)))["params"]
+
+
+def make_grads(params, worker: int, cycle: int):
+    """Deterministic per-(worker, cycle) gradient tree — the replay in the
+    parent regenerates the same values."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    rng = np.random.default_rng([worker, cycle])
+    return jax.tree_util.tree_unflatten(
+        treedef,
+        [jnp.asarray(rng.normal(0, 0.1, x.shape).astype(np.float32))
+         for x in leaves],
+    )
+
+
+def run_server(port: int, out_dir: str, nworkers: int, cycles: int) -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    import ps_tpu as ps
+    from ps_tpu.backends.remote_async import AsyncPSService
+
+    params = _model_params()
+    ps.init(backend="tpu", mode="async", num_workers=nworkers, dc_lambda=0.04)
+    store = ps.KVStore(optimizer="sgd", learning_rate=0.05, mode="async")
+    store.init(params)
+    svc = AsyncPSService(store, port=port, bind="127.0.0.1")
+    target = nworkers * cycles
+    deadline = time.monotonic() + 120
+    while len(svc.apply_log) < target:
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"only {len(svc.apply_log)}/{target} pushes arrived"
+            )
+        time.sleep(0.02)
+    final = {k: np.asarray(v)
+             for k, v in store._engine.pull_tree(worker=0).items()}
+    np.savez(os.path.join(out_dir, "server_params.npz"), **final)
+    with open(os.path.join(out_dir, "server.json"), "w") as f:
+        json.dump({
+            "event_log": svc.event_log,
+            "apply_log": svc.apply_log,
+            "staleness_hist": {
+                str(t): n for t, n in store._engine.staleness_hist.items()
+            },
+            "version": store._engine.version,
+        }, f)
+    svc.stop()
+    ps.shutdown()
+    return 0
+
+
+def run_worker(port: int, out_dir: str, worker: int, cycles: int) -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from ps_tpu.backends.remote_async import RemoteAsyncWorker
+
+    params = _model_params()
+    w = RemoteAsyncWorker("127.0.0.1", port, worker=worker,
+                          params_like=params)
+    versions = []
+    w.pull_all()
+    for c in range(cycles):
+        # jitter so the three workers' pushes interleave (staleness > 0)
+        time.sleep(0.003 * ((worker * 7 + c * 3) % 5))
+        w.push_pull(make_grads(params, worker, c))
+        versions.append(w.version)
+    with open(os.path.join(out_dir, f"worker{worker}.json"), "w") as f:
+        json.dump({"worker": worker, "versions": versions}, f)
+    w.close()
+    return 0
+
+
+def main() -> int:
+    role = sys.argv[1]
+    port, out_dir = int(sys.argv[2]), sys.argv[3]
+    a, b = int(sys.argv[4]), int(sys.argv[5])
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if role == "server":
+        return run_server(port, out_dir, a, b)
+    return run_worker(port, out_dir, a, b)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -155,6 +155,24 @@ def test_bind_loopback_and_any():
                 assert _wait_until(lambda: srv.alive() == [3]), bind
 
 
+@pytest.mark.slow
+def test_tsan_van_clean():
+    """SURVEY.md §6: the native van runs its full concurrent surface under
+    ThreadSanitizer (tools/tsan_van.cpp driver) with zero reports."""
+    import shutil
+
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    script = os.path.join(_REPO, "tools", "tsan_van.sh")
+    proc = subprocess.run([script], capture_output=True, text=True,
+                          timeout=300)
+    if "libtsan" in proc.stderr and proc.returncode != 0 and (
+            "cannot find" in proc.stderr or "No such file" in proc.stderr):
+        pytest.skip("libtsan unavailable")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "TSAN: clean" in proc.stdout
+
+
 # -- layer 2: kill a process mid-run -----------------------------------------
 
 

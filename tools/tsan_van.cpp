@@ -1,0 +1,142 @@
+// ThreadSanitizer driver for the native control-plane van (SURVEY.md §6:
+// "any C++ control-plane code gets TSAN/ASAN"). Exercises every public ABI
+// function from multiple threads concurrently — monitor rx thread, client tx
+// threads, host poll threads, goodbye-while-beating, start/stop churn — so
+// TSAN can observe any data race in van.cpp's threading model.
+//
+// Build + run: tools/tsan_van.sh (clean exit + no TSAN report = pass).
+
+#include <atomic>
+#include <chrono>
+#include <cstdint>
+#include <cstdio>
+#include <thread>
+#include <vector>
+
+extern "C" {
+void* hb_server_start(const char* bind_addr, int port, int timeout_ms);
+int hb_server_port(void* h);
+int hb_server_poll(void* h, int state, uint32_t* out, int cap);
+uint64_t hb_server_seq(void* h, uint32_t node_id);
+void hb_server_stop(void* h);
+void* hb_client_start(const char* host, int port, uint32_t node_id,
+                      int interval_ms);
+void hb_client_goodbye(void* h);
+void hb_client_stop(void* h);
+void* tv_listen(const char* bind_addr, int port, int backlog);
+int tv_listener_port(void* h);
+void* tv_accept(void* h, int timeout_ms);
+void tv_listener_close(void* h);
+void* tv_connect(const char* host, int port, int timeout_ms);
+int tv_send(void* h, const void* buf, uint64_t n);
+int64_t tv_recv_size(void* h);
+int tv_recv_into(void* h, void* buf, uint64_t n);
+void tv_close(void* h);
+}
+
+static void sleep_ms(int ms) {
+  std::this_thread::sleep_for(std::chrono::milliseconds(ms));
+}
+
+int main() {
+  void* srv = hb_server_start("127.0.0.1", 0, 300);
+  if (!srv) { std::fprintf(stderr, "server start failed\n"); return 1; }
+  int port = hb_server_port(srv);
+
+  // 4 clients beating fast
+  std::vector<void*> clients;
+  for (uint32_t id = 1; id <= 4; ++id) {
+    void* c = hb_client_start("127.0.0.1", port, id, 5);
+    if (!c) { std::fprintf(stderr, "client %u start failed\n", id); return 1; }
+    clients.push_back(c);
+  }
+
+  // 3 poller threads hammering every read path while beats arrive
+  std::atomic<bool> stop{false};
+  std::vector<std::thread> pollers;
+  for (int t = 0; t < 3; ++t) {
+    pollers.emplace_back([&] {
+      uint32_t buf[16];
+      while (!stop.load()) {
+        for (int state = 0; state <= 2; ++state)
+          hb_server_poll(srv, state, buf, 16);
+        for (uint32_t id = 1; id <= 4; ++id) hb_server_seq(srv, id);
+      }
+    });
+  }
+
+  sleep_ms(100);
+  // goodbye from one thread while its tx thread still beats (the
+  // concurrent-sendto path), then a hard stop of another client
+  hb_client_goodbye(clients[0]);
+  hb_client_stop(clients[0]);
+  hb_client_stop(clients[1]);  // silent death
+  sleep_ms(400);               // past the horizon: states move under pollers
+
+  uint32_t buf[16];
+  int alive = hb_server_poll(srv, 0, buf, 16);
+  int dead = hb_server_poll(srv, 1, buf, 16);
+  int left = hb_server_poll(srv, 2, buf, 16);
+  stop.store(true);
+  for (auto& t : pollers) t.join();
+  hb_client_stop(clients[2]);
+  hb_client_stop(clients[3]);
+  hb_server_stop(srv);
+  std::printf("alive=%d dead=%d left=%d\n", alive, dead, left);
+  if (alive != 2 || dead != 1 || left != 1) {
+    std::fprintf(stderr, "unexpected states\n");
+    return 1;
+  }
+  // --- tensor van: a server echoing frames to 3 concurrent client threads
+  void* lst = tv_listen("127.0.0.1", 0, 8);
+  if (!lst) { std::fprintf(stderr, "tv_listen failed\n"); return 1; }
+  int tport = tv_listener_port(lst);
+  std::atomic<int> echoed{0};
+  std::thread server([&] {
+    std::vector<std::thread> handlers;
+    for (int i = 0; i < 3; ++i) {
+      void* conn = tv_accept(lst, 2000);
+      if (!conn) break;
+      handlers.emplace_back([conn, &echoed] {
+        for (;;) {
+          int64_t n = tv_recv_size(conn);
+          if (n < 0) break;
+          std::vector<char> buf(n);
+          if (!tv_recv_into(conn, buf.data(), n)) break;
+          if (!tv_send(conn, buf.data(), n)) break;
+          echoed.fetch_add(1);
+        }
+        tv_close(conn);
+      });
+    }
+    for (auto& h : handlers) h.join();
+  });
+  std::vector<std::thread> tx;
+  std::atomic<int> ok_frames{0};
+  for (int t = 0; t < 3; ++t) {
+    tx.emplace_back([&, t] {
+      void* c = tv_connect("127.0.0.1", tport, 2000);
+      if (!c) return;
+      std::vector<char> payload(1 << 16, (char)t);
+      for (int i = 0; i < 20; ++i) {
+        if (!tv_send(c, payload.data(), payload.size())) break;
+        int64_t n = tv_recv_size(c);
+        if (n != (int64_t)payload.size()) break;
+        std::vector<char> back(n);
+        if (!tv_recv_into(c, back.data(), n)) break;
+        ok_frames.fetch_add(back == payload ? 1 : 0);
+      }
+      tv_close(c);
+    });
+  }
+  for (auto& t : tx) t.join();
+  server.join();
+  tv_listener_close(lst);
+  std::printf("tv echoed=%d ok=%d\n", echoed.load(), ok_frames.load());
+  if (ok_frames.load() != 60) {
+    std::fprintf(stderr, "tensor van frames lost/corrupted\n");
+    return 1;
+  }
+  std::printf("tsan van driver: OK\n");
+  return 0;
+}

@@ -1,0 +1,15 @@
+#!/usr/bin/env bash
+# ThreadSanitizer run for the native control-plane van (SURVEY.md §6).
+# Builds van.cpp + the concurrency driver with -fsanitize=thread and runs it;
+# any data race aborts with a TSAN report and a non-zero exit.
+#
+# Usage: tools/tsan_van.sh   (from the repo root; also wired into
+# tests/test_failure.py::test_tsan_van_clean)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+out=$(mktemp -d)
+trap 'rm -rf "$out"' EXIT
+g++ -std=c++17 -O1 -g -fsanitize=thread -fno-omit-frame-pointer \
+    ps_tpu/native/van.cpp tools/tsan_van.cpp -o "$out/tsan_van" -lpthread
+TSAN_OPTIONS="halt_on_error=1 exitcode=66" "$out/tsan_van"
+echo "TSAN: clean"
