@@ -1,0 +1,137 @@
+"""Async-SGD MNIST — workload config 5 in its REAL deployment shape.
+
+The reference's async mode runs the server and each worker as separate,
+deliberately unsynchronized nodes (SURVEY.md §4d): the server applies every
+arriving gradient immediately with the DC-ASGD correction; workers compute
+against whatever (stale) parameters they last pulled. This trainer exposes
+both the single-process form (threads as workers — quick start) and the
+cross-process form over the native van's TCP layer.
+
+Single process (threads drive the workers round-robin):
+    python examples/train_mnist_async.py --steps 60 --num-workers 3
+
+Cross-process (one terminal per node; server first):
+    python examples/train_mnist_async.py --role server --port 7077 \
+        --num-workers 2 --steps 60
+    python examples/train_mnist_async.py --role worker --server localhost:7077 \
+        --worker-id 0 --steps 30
+    python examples/train_mnist_async.py --role worker --server localhost:7077 \
+        --worker-id 1 --steps 30
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+
+import jax
+
+if os.environ.get("JAX_PLATFORMS"):
+    # some images preload jax with a pinned platform; the env var wins here
+    # (the async nodes of one job may deliberately run on different backends)
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
+import jax.numpy as jnp
+
+import ps_tpu as ps
+from ps_tpu.data.synthetic import mnist_batches
+from ps_tpu.models.mlp import MLP, cross_entropy_loss
+from ps_tpu.utils import StepLogger
+
+
+def build(seed: int):
+    model = MLP(hidden=32)
+    params = model.init(jax.random.key(seed), jnp.zeros((1, 28, 28, 1)))["params"]
+
+    def loss_fn(p, batch):
+        images, labels = batch
+        return cross_entropy_loss(model.apply({"params": p}, images), labels)
+
+    return params, loss_fn
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--role", default="single",
+                    choices=["single", "server", "worker"])
+    ap.add_argument("--steps", type=int, default=60,
+                    help="single/worker: this node's cycles; server: total "
+                         "pushes to serve before draining")
+    ap.add_argument("--batch-size", type=int, default=64)
+    ap.add_argument("--num-workers", type=int, default=3)
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--dc-lambda", type=float, default=0.04)
+    ap.add_argument("--seed", type=int, default=0)
+    # cross-process wiring
+    ap.add_argument("--port", type=int, default=0, help="server listen port")
+    ap.add_argument("--bind", default="0.0.0.0", help="server listen address")
+    ap.add_argument("--server", default=None,
+                    help="worker: host:port (or env PS_ASYNC_SERVER_URI)")
+    ap.add_argument("--worker-id", type=int, default=0)
+    args = ap.parse_args()
+    params, loss_fn = build(args.seed)
+
+    if args.role == "worker":
+        uri = args.server or os.environ.get("PS_ASYNC_SERVER_URI")
+        if not uri:
+            raise SystemExit("worker needs --server host:port "
+                             "(or PS_ASYNC_SERVER_URI)")
+        w = ps.connect_async(uri, args.worker_id, params)
+        run = w.make_async_step(loss_fn)
+        log = StepLogger(every=10)
+        # shard the stream by the JOB's worker count (the server's truth)
+        stream = mnist_batches(args.batch_size, seed=args.seed,
+                               worker=args.worker_id,
+                               num_workers=w.num_workers)
+        for step in range(args.steps):
+            loss = run(next(stream))
+            if log.wants(step):
+                log.log(step, loss=float(loss), version=w.version)
+        print(f"worker {args.worker_id}: done at server version {w.version}")
+        w.close()
+        return
+
+    ps.init(backend="tpu", mode="async", num_workers=args.num_workers,
+            dc_lambda=args.dc_lambda)
+    store = ps.KVStore(optimizer="sgd", learning_rate=args.lr, mode="async")
+    store.init(params)
+
+    if args.role == "server":
+        import time
+
+        svc = ps.serve_async(store, port=args.port, bind=args.bind)
+        print(f"async PS server on port {svc.port} "
+              f"({args.num_workers} workers expected)")
+        while len(svc.apply_log) < args.steps:
+            time.sleep(0.1)
+        hist = dict(store._engine.staleness_hist)
+        print(f"served {len(svc.apply_log)} pushes, "
+              f"final version {store._engine.version}, "
+              f"staleness histogram {dict(sorted(hist.items()))}")
+        svc.stop()
+        ps.shutdown()
+        return
+
+    # single process: drive workers round-robin (staleness accrues because
+    # each worker re-pulls only on its own turn)
+    run = store.make_async_step(loss_fn)
+    log = StepLogger(every=10)
+    streams = [
+        mnist_batches(args.batch_size, seed=args.seed, worker=w,
+                      num_workers=args.num_workers)
+        for w in range(args.num_workers)
+    ]
+    for step in range(args.steps):
+        w = step % args.num_workers
+        loss = run(next(streams[w]), worker=w)
+        if log.wants(step):
+            log.log(step, loss=float(loss), worker=w,
+                    staleness=store._engine.staleness(w))
+    hist = dict(store._engine.staleness_hist)
+    print(f"done: version {store._engine.version}, "
+          f"staleness histogram {dict(sorted(hist.items()))}")
+    ps.shutdown()
+
+
+if __name__ == "__main__":
+    main()

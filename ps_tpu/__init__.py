@@ -34,6 +34,7 @@ from ps_tpu.api import init, shutdown, is_initialized, current_context
 from ps_tpu.kv.store import KVStore
 from ps_tpu.kv.sparse import SparseEmbedding
 from ps_tpu.train import make_composite_step
+from ps_tpu.backends.remote_async import serve_async, connect_async
 from ps_tpu import checkpoint
 from ps_tpu import optim
 
@@ -48,6 +49,8 @@ __all__ = [
     "KVStore",
     "SparseEmbedding",
     "make_composite_step",
+    "serve_async",
+    "connect_async",
     "checkpoint",
     "optim",
     "__version__",

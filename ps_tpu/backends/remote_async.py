@@ -51,6 +51,7 @@ class AsyncPSService:
         self._listener = tv.Listener(port=port, bind=bind)
         self._stop = threading.Event()
         self._conns: List[threading.Thread] = []
+        self._channels: List[tv.Channel] = []  # live conns, for stop()
         self._log_lock = threading.Lock()
         self.apply_log: List[int] = []  # worker id per committed tree, in order
         # full ordered (op, worker) history — "pull" records matter because
@@ -73,6 +74,7 @@ class AsyncPSService:
             ch = self._listener.accept(timeout_ms=200)
             if ch is None:
                 continue
+            self._channels.append(ch)
             t = threading.Thread(target=self._serve, args=(ch,), daemon=True)
             t.start()
             self._conns.append(t)
@@ -151,14 +153,46 @@ class AsyncPSService:
                                       extra={"error": repr(e)}))
         finally:
             ch.close()
+            try:
+                self._channels.remove(ch)
+            except ValueError:
+                pass  # stop() may already be iterating a snapshot
 
     def stop(self) -> None:
+        """Drain: no new connections, sever live ones (serve threads blocked
+        in recv wake with EOF and exit — no push is applied after this
+        returns), then free the listener."""
         self._stop.set()
+        for ch in list(self._channels):
+            ch.shutdown()  # non-freeing sever; each serve thread closes own
+        for t in list(self._conns):
+            t.join(timeout=5)
         # join BEFORE closing: the accept thread may be inside tv_accept on
         # the listener handle (its 200ms timeout bounds the wait); closing
         # first would hand it a freed pointer
         self._accept_thread.join(timeout=5)
         self._listener.close()
+
+
+def serve_async(store, port: int = 0, bind: str = "0.0.0.0") -> "AsyncPSService":
+    """Expose an initialized async KVStore to remote worker processes.
+
+    The top-level entry of the cross-process async deployment: the server
+    process calls this after ``store.init(params)``; workers connect with
+    :func:`connect_async`. Returns the running service (``.port`` for
+    ephemeral binds, ``.stop()`` to drain)."""
+    return AsyncPSService(store, port=port, bind=bind)
+
+
+def connect_async(uri: str, worker: int, params_like) -> "RemoteAsyncWorker":
+    """Join a cross-process async job as worker ``worker``.
+
+    ``uri`` is ``host:port`` of the :func:`serve_async` process (also the
+    form trainers read from ``PS_ASYNC_SERVER_URI``); ``params_like`` is a
+    pytree with the model's parameter structure (used to validate the tree
+    against the server and to rebuild pulled params)."""
+    host, port = uri.rsplit(":", 1)
+    return RemoteAsyncWorker(host, int(port), worker, params_like)
 
 
 class RemoteAsyncWorker:
@@ -182,6 +216,14 @@ class RemoteAsyncWorker:
                 "server tree does not match this worker's params structure"
             )
         self.version = int(extra["version"])
+        # the JOB's worker count (data-sharding denominator) is the server's
+        # truth, not a local guess
+        self.num_workers = int(extra["num_workers"])
+        if not (0 <= worker < self.num_workers):
+            raise ValueError(
+                f"worker id {worker} out of range for a "
+                f"{self.num_workers}-worker job"
+            )
         self._params = None
 
     # -- protocol -------------------------------------------------------------

@@ -59,6 +59,7 @@ def _lib():
     lib.tv_recv_into.restype = ctypes.c_int
     lib.tv_recv_into.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
                                  ctypes.c_uint64]
+    lib.tv_shutdown.argtypes = [ctypes.c_void_p]
     lib.tv_close.argtypes = [ctypes.c_void_p]
     return lib
 
@@ -110,11 +111,15 @@ class VanError(ConnectionError):
 
 
 class Channel:
-    """One framed TCP connection (blocking; one driving thread at a time)."""
+    """One framed TCP connection (blocking; one driving thread at a time —
+    except :meth:`shutdown`/:meth:`close`, which are cross-thread safe)."""
 
     def __init__(self, handle, lib):
+        import threading
+
         self._h = handle
         self._lib = lib
+        self._hlock = threading.Lock()  # guards the handle's lifecycle
 
     @classmethod
     def connect(cls, host: str, port: int, timeout_ms: int = 10_000,
@@ -133,13 +138,19 @@ class Channel:
         raise VanError(f"could not connect to {host}:{port} "
                        f"after {retries} attempts")
 
+    def _require(self):
+        h = self._h
+        if not h:
+            raise VanError("channel is closed")
+        return h
+
     def send(self, payload: bytes) -> None:
-        if not self._lib.tv_send(self._h, payload, len(payload)):
+        if not self._lib.tv_send(self._require(), payload, len(payload)):
             self.close()  # half-sent frame: the stream is unusable
             raise VanError("send failed: peer closed")
 
     def recv(self) -> memoryview:
-        n = self._lib.tv_recv_size(self._h)
+        n = self._lib.tv_recv_size(self._require())
         if n < 0:
             # EOF, or an insane length word — either way the framing is
             # gone; poison the channel so a caught error can't silently
@@ -158,10 +169,19 @@ class Channel:
         self.send(payload)
         return self.recv()
 
+    def shutdown(self) -> None:
+        """Sever the connection without freeing: a thread blocked in
+        :meth:`recv` on this channel wakes with EOF and runs its own
+        :meth:`close`. Safe to call from any thread."""
+        with self._hlock:
+            if self._h:
+                self._lib.tv_shutdown(self._h)
+
     def close(self) -> None:
-        if self._h:
-            self._lib.tv_close(self._h)
-            self._h = None
+        with self._hlock:
+            if self._h:
+                self._lib.tv_close(self._h)
+                self._h = None
 
     def __enter__(self):
         return self

@@ -316,6 +316,12 @@ void* tv_accept(void* h, int timeout_ms) {
   }
   int fd = accept(l->fd, nullptr, nullptr);
   if (fd < 0) return nullptr;
+  // the accepted fd INHERITS the listener's SO_RCVTIMEO (the accept-poll
+  // cadence) on Linux — clear it, or any >timeout idle gap between client
+  // requests would surface as a spurious EAGAIN "peer closed"
+  timeval off{0, 0};
+  setsockopt(fd, SOL_SOCKET, SO_RCVTIMEO, &off, sizeof(off));
+  setsockopt(fd, SOL_SOCKET, SO_SNDTIMEO, &off, sizeof(off));
   int one = 1;
   setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
   auto* c = new Conn();
@@ -386,6 +392,16 @@ int tv_recv_into(void* h, void* buf, uint64_t n) {
   if (!read_exact(c->fd, buf, n)) return 0;
   c->pending -= n;
   return 1;
+}
+
+// Sever the connection WITHOUT freeing the handle: any thread blocked in
+// tv_recv_size/tv_recv_into on this conn wakes with EOF and can run its own
+// tv_close. This is how a server interrupts serve threads that block
+// indefinitely on idle clients (the fd outlives the shutdown; only tv_close
+// frees).
+void tv_shutdown(void* h) {
+  auto* c = static_cast<Conn*>(h);
+  shutdown(c->fd, SHUT_RDWR);
 }
 
 void tv_close(void* h) {

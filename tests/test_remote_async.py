@@ -111,3 +111,35 @@ def test_replay_through_threaded_engine_is_bit_identical(mp_run):
     hist = {int(t): n for t, n in info["staleness_hist"].items()}
     assert dict(eng.staleness_hist) == hist
     ps.shutdown()
+
+
+def test_idle_client_survives_slow_cadence():
+    """Regression (r3): the accepted fd inherited the listener's 200ms
+    accept-poll SO_RCVTIMEO on Linux, so any client thinking for longer
+    than that (a jit compile, a slow batch) was cut off as 'peer closed'.
+    A worker that idles >1s between requests must keep its connection."""
+    import time
+
+    import jax.numpy as jnp
+
+    from ps_tpu.backends.remote_async import AsyncPSService, RemoteAsyncWorker
+
+    params = {"w": jnp.zeros((64, 64))}
+    ps.init(backend="tpu", mode="async", num_workers=1)
+    store = ps.KVStore(optimizer="sgd", learning_rate=0.1, mode="async")
+    store.init(params)
+    svc = AsyncPSService(store, bind="127.0.0.1")
+    w = RemoteAsyncWorker("127.0.0.1", svc.port, 0, params)
+    w.pull_all()
+    for i in range(2):
+        time.sleep(1.1)  # well past any accept-poll cadence
+        w.push_pull({"w": jnp.ones((64, 64))})
+    assert w.version == 2
+    # drain contract: stop() severs live connections; a push after stop is
+    # REFUSED (never silently applied post-drain) and the version is frozen
+    svc.stop()
+    with pytest.raises(Exception):
+        w.push_pull({"w": jnp.ones((64, 64))})
+    assert store._engine.version == 2
+    w.close()
+    ps.shutdown()
