@@ -79,13 +79,14 @@ class AsyncTpuServer(PeekMixin, AsyncStagingMixin, CheckpointMixin):
 
     def __init__(self, optimizer: optax.GradientTransformation, mesh,
                  num_workers: int, placement: str = "replicated",
-                 dc_lambda: float = 0.04):
+                 dc_lambda: float = 0.04, partition_rules=None):
         import collections
         import threading
 
         self._opt = optimizer
         self.mesh = mesh
         self.placement = placement
+        self.partition_rules = partition_rules
         self.num_workers = num_workers
         self.dc_lambda = dc_lambda
         self._params: Dict[str, jax.Array] = {}
@@ -111,14 +112,17 @@ class AsyncTpuServer(PeekMixin, AsyncStagingMixin, CheckpointMixin):
         if self._params:
             raise RuntimeError("server already holds a registered tree")
         shardings = {
-            k: param_sharding(self.mesh, v, self.placement) for k, v in kv.items()
+            k: param_sharding(self.mesh, v, self.placement, key=k,
+                              rules=self.partition_rules)
+            for k, v in kv.items()
         }
         self._params = {
             k: jax.device_put(np.asarray(v), shardings[k]) for k, v in kv.items()
         }
         for k, v in self._params.items():
             self._state[k] = sharded_opt_init(
-                self._opt.init, v, self.mesh, self.placement
+                self._opt.init, v, self.mesh, self.placement,
+                key=k, rules=self.partition_rules,
             )
             self.apply_count[k] = 0
         from ps_tpu.kv import keys as keymod
@@ -252,13 +256,14 @@ class TpuServer(PeekMixin, CheckpointMixin):
 
     def __init__(self, optimizer: optax.GradientTransformation, mesh,
                  placement: str = "replicated", aggregate: str = "mean",
-                 mode: str = "sync"):
+                 mode: str = "sync", partition_rules=None):
         assert mode == "sync", "async mode is handled by AsyncTpuServer"
         if aggregate not in ("mean", "sum"):
             raise ValueError("aggregate must be 'mean' or 'sum'")
         self._opt = optimizer
         self.mesh = mesh
         self.placement = placement
+        self.partition_rules = partition_rules
         self.aggregate = aggregate
         self.mode = mode
         self.num_workers = mesh.shape[DATA_AXIS]
@@ -277,7 +282,9 @@ class TpuServer(PeekMixin, CheckpointMixin):
         if self._params:
             raise RuntimeError("server already holds a registered tree")
         self._shardings = {
-            k: param_sharding(self.mesh, v, self.placement) for k, v in kv.items()
+            k: param_sharding(self.mesh, v, self.placement, key=k,
+                              rules=self.partition_rules)
+            for k, v in kv.items()
         }
         # np.asarray forces a fresh device buffer: device_put of an array that
         # already matches the sharding would alias the caller's buffer, and
@@ -290,7 +297,8 @@ class TpuServer(PeekMixin, CheckpointMixin):
         # next to (ZeRO-1: moment tensors shard with their param, scalars
         # replicate) — explicit so checkpoint restore lands identically
         self._state = sharded_opt_init(
-            self._opt.init, self._params, self.mesh, self.placement
+            self._opt.init, self._params, self.mesh, self.placement,
+            rules=self.partition_rules,
         )
 
         # No donation here: this apply backs the per-key/push_pull
@@ -532,7 +540,8 @@ class TpuBackend:
             self.failure_detector.check()
 
     def create_server(self, optimizer, mode: Optional[str] = None,
-                      aggregate: str = "mean", placement: str = "replicated"):
+                      aggregate: str = "mean", placement: str = "replicated",
+                      partition_rules=None):
         mode = mode or self.config.mode
         if mode == "async":
             return AsyncTpuServer(
@@ -541,6 +550,7 @@ class TpuBackend:
                 num_workers=self.config.num_workers,
                 placement=placement,
                 dc_lambda=self.config.dc_lambda,
+                partition_rules=partition_rules,
             )
         return TpuServer(
             optimizer,
@@ -548,6 +558,7 @@ class TpuBackend:
             placement=placement,
             aggregate=aggregate,
             mode=mode,
+            partition_rules=partition_rules,
         )
 
     def batch_sharding(self):

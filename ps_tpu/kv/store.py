@@ -52,6 +52,7 @@ class KVStore:
         mode: Optional[str] = None,
         aggregate: str = "mean",
         placement: str = "replicated",
+        partition_rules=None,
         **opt_kwargs,
     ):
         ctx = current_context()
@@ -60,11 +61,19 @@ class KVStore:
         if placement not in ("replicated", "sharded"):
             raise ValueError("placement must be 'replicated' or 'sharded'")
         self.placement = placement
+        if partition_rules is not None:
+            # strings and pre-compiled regexes both pass through untouched
+            partition_rules = [(p, tuple(s)) for p, s in partition_rules]
         if ctx.config.backend == "local":
+            if partition_rules:
+                raise ValueError(
+                    "partition_rules need the mesh backend (backend='tpu')"
+                )
             self._engine = ctx.backend.create_server(self._opt, mode=mode, aggregate=aggregate)
         else:
             self._engine = ctx.backend.create_server(
-                self._opt, mode=mode, aggregate=aggregate, placement=placement
+                self._opt, mode=mode, aggregate=aggregate, placement=placement,
+                partition_rules=partition_rules,
             )
         self._treedef = None
         self._key_order: List[str] = []

@@ -1,2 +1,12 @@
 """Mesh, sharding, and collective utilities — the TPU replacement for the
-reference's NCCL reduce + ZMQ transport (SURVEY.md §3 rows 8-9)."""
+reference's NCCL reduce + ZMQ transport (SURVEY.md §3 rows 8-9), plus
+sequence/context parallelism (ring + Ulysses attention) for long-context
+models on a 'seq' mesh axis."""
+
+from ps_tpu.parallel.ring_attention import (
+    ring_attention,
+    sequence_sharding,
+    ulysses_attention,
+)
+
+__all__ = ["ring_attention", "ulysses_attention", "sequence_sharding"]
