@@ -62,8 +62,21 @@ class KVStore:
             raise ValueError("placement must be 'replicated' or 'sharded'")
         self.placement = placement
         if partition_rules is not None:
-            # strings and pre-compiled regexes both pass through untouched
-            partition_rules = [(p, tuple(s)) for p, s in partition_rules]
+            # patterns: strings or pre-compiled regexes. Specs must be
+            # SEQUENCES of per-dim entries — a bare string like "model"
+            # would tuple() into per-character junk and silently never
+            # match any rank ("explicit placement fails loudly")
+            checked = []
+            for p, s in partition_rules:
+                if isinstance(s, str) or not all(
+                        e is None or isinstance(e, str) for e in s):
+                    raise ValueError(
+                        f"partition rule {p!r}: spec must be a tuple of "
+                        f"axis names / None per dim, e.g. (None, 'model') "
+                        f"— got {s!r}"
+                    )
+                checked.append((p, tuple(s)))
+            partition_rules = checked
         if ctx.config.backend == "local":
             if partition_rules:
                 raise ValueError(

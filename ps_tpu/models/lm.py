@@ -1,0 +1,149 @@
+"""Minimal causal transformer LM — the long-context workload.
+
+Written TPU-first as pure functions over a flat-friendly param dict (the
+same tree the PS store shards by key), so the Megatron partition rules in
+:func:`lm_partition_rules` apply verbatim and the attention op is pluggable:
+``attn='full'`` for single-device/small contexts, ``'ring'`` or ``'ulysses'``
+(ps_tpu/parallel/ring_attention.py) when activations are sharded over a
+'seq' mesh axis. Pre-norm blocks, learned positions, weight-tied readout —
+small on purpose: the model is the vehicle for the parallelism, the PS
+protocol around it is identical to every other workload.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def init_params(rng: np.random.Generator, *, vocab: int, d_model: int,
+                n_heads: int, n_layers: int, d_ff: Optional[int] = None,
+                max_len: int = 2048) -> Dict:
+    """He/scaled-normal init of the full parameter tree."""
+    d_ff = d_ff or 4 * d_model
+
+    def t(*shape, scale=None):
+        scale = scale if scale is not None else (1.0 / math.sqrt(shape[0]))
+        return jnp.asarray(rng.normal(0, scale, shape).astype(np.float32))
+
+    params: Dict = {
+        "embed": {"tokens": t(vocab, d_model, scale=0.02),
+                  "positions": t(max_len, d_model, scale=0.02)},
+        "final_norm": {"scale": jnp.ones((d_model,))},
+    }
+    for i in range(n_layers):
+        params[f"layer{i}"] = {
+            "ln1": {"scale": jnp.ones((d_model,))},
+            "attn": {
+                "qkv": {"kernel": t(d_model, 3 * d_model)},
+                "out": {"kernel": t(d_model, d_model)},
+            },
+            "ln2": {"scale": jnp.ones((d_model,))},
+            "mlp": {
+                "in": {"kernel": t(d_model, d_ff)},
+                "out": {"kernel": t(d_ff, d_model)},
+            },
+        }
+    return params
+
+
+def lm_partition_rules():
+    """Megatron placement for every layer (regexes match all layer indices):
+    in-projections column-parallel, out-projections row-parallel, embeddings
+    vocab/position-sharded by the default heuristic (left unruled)."""
+    return [
+        (r"attn/qkv/kernel$", (None, "model")),
+        (r"attn/out/kernel$", ("model", None)),
+        (r"mlp/in/kernel$", (None, "model")),
+        (r"mlp/out/kernel$", ("model", None)),
+    ]
+
+
+def _rmsnorm(x, scale):
+    return x * jax.lax.rsqrt(jnp.mean(x * x, -1, keepdims=True) + 1e-6) * scale
+
+
+def _full_attention(q, k, v, causal=True, **_):
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * (q.shape[-1] ** -0.5)
+    if causal:
+        t = q.shape[1]
+        s = jnp.where(jnp.tril(jnp.ones((t, t), bool))[None, None], s, -1e30)
+    return jnp.einsum("bhqk,bkhd->bqhd", jax.nn.softmax(s, -1), v)
+
+
+def make_attn_fn(attn: str = "full", mesh=None, **kw) -> Callable:
+    """'full' | 'ring' | 'ulysses' — the latter two need a 'seq' mesh axis
+    and activations sharded P(batch, 'seq')."""
+    if attn == "full":
+        return _full_attention
+    from ps_tpu.parallel import ring_attention, ulysses_attention
+
+    op = {"ring": ring_attention, "ulysses": ulysses_attention}[attn]
+
+    def fn(q, k, v, causal=True):
+        return op(q, k, v, mesh, causal=causal, **kw)
+
+    return fn
+
+
+def apply(params: Dict, tokens: jax.Array, *, n_heads: int,
+          attn_fn: Callable = _full_attention) -> jax.Array:
+    """tokens [B, T] int32 -> logits [B, T, vocab]."""
+    b, t = tokens.shape
+    d_model = params["embed"]["tokens"].shape[1]
+    dh = d_model // n_heads
+    x = (jnp.take(params["embed"]["tokens"], tokens, axis=0)
+         + params["embed"]["positions"][:t][None])
+    i = 0
+    while f"layer{i}" in params:
+        lp = params[f"layer{i}"]
+        h = _rmsnorm(x, lp["ln1"]["scale"])
+        qkv = (h @ lp["attn"]["qkv"]["kernel"]).reshape(b, t, 3 * n_heads, dh)
+        q, k, v = jnp.split(qkv, 3, axis=2)
+        a = attn_fn(q, k, v, causal=True).reshape(b, t, d_model)
+        x = x + a @ lp["attn"]["out"]["kernel"]
+        h = _rmsnorm(x, lp["ln2"]["scale"])
+        h = jax.nn.gelu(h @ lp["mlp"]["in"]["kernel"])
+        x = x + h @ lp["mlp"]["out"]["kernel"]
+        i += 1
+    x = _rmsnorm(x, params["final_norm"]["scale"])
+    return x @ params["embed"]["tokens"].T  # tied readout
+
+
+def make_loss_fn(*, n_heads: int, attn_fn: Callable = _full_attention):
+    """Next-token cross entropy, meaned over the global batch. The batch
+    carries pre-shifted ``inputs``/``targets`` [B, T] (T divisible by the
+    'seq' axis, so both shard cleanly — see :func:`lm_batches`)."""
+
+    def loss_fn(params, batch):
+        logits = apply(params, batch["inputs"], n_heads=n_heads,
+                       attn_fn=attn_fn)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
+        ll = jnp.take_along_axis(logp, batch["targets"][..., None], -1)[..., 0]
+        return -jnp.mean(ll)
+
+    return loss_fn
+
+
+def lm_batches(batch_size: int, seq_len: int, *, vocab: int = 256,
+               seed: int = 0, steps: Optional[int] = None):
+    """Deterministic synthetic token streams with LEARNABLE structure:
+    next token = (3·start + 7·position) mod vocab, plus noise tokens — a
+    causal model's loss decreases fast, random guessing doesn't. Yields
+    pre-shifted ``{"inputs": [B, T], "targets": [B, T]}``.
+    """
+    rng = np.random.default_rng(seed)
+    i = 0
+    while steps is None or i < steps:
+        start = rng.integers(0, vocab, size=(batch_size, 1))
+        ramp = np.arange(seq_len + 1)[None, :]
+        toks = (start * 3 + ramp * 7) % vocab
+        noise = rng.random((batch_size, seq_len + 1)) < 0.05
+        toks = np.where(noise, rng.integers(0, vocab, toks.shape), toks)
+        toks = toks.astype(np.int32)
+        yield {"inputs": toks[:, :-1], "targets": toks[:, 1:]}
+        i += 1

@@ -59,8 +59,11 @@ def _ring_attention_block(q, k, v, *, axis: str, causal: bool, scale: float):
 
     del b, h
     # the carry must be device-varying over the SAME manual axes as the loop
-    # outputs (shard_map tracks variance; a literal zeros() is invariant) —
-    # deriving the accumulators from q inherits exactly q's variance
+    # outputs (shard_map tracks variance; a literal jnp.zeros((shape)) is
+    # axis-invariant and fails the fori_loop carry type check). Anything
+    # DERIVED from q inherits q's variance: zeros_like(q) for the
+    # q-shaped numerator, a sliced-and-scaled q for the [B, H, T]-shaped
+    # max/denominator accumulators (no q-shaped zeros_like fits those).
     zero_bht = q[..., 0].transpose(0, 2, 1) * 0             # [B, H, T_local]
     m0 = zero_bht + _NEG                                    # running max
     l0 = zero_bht                                           # denominator

@@ -175,3 +175,13 @@ def test_bad_rules_fail_loudly():
                          [(re.compile(r"kernel$"), (None, "model"))])
     assert got.spec == P(None, "model")
     ps.shutdown()
+
+
+def test_bare_string_spec_rejected():
+    """A spec like \"model\" (instead of (\"model\",)) must fail loudly at
+    construction — tuple('model') would silently become per-char junk."""
+    ps.init(backend="tpu", mesh_shape={"data": 4, "model": 2})
+    with pytest.raises(ValueError, match="tuple of"):
+        ps.KVStore(optimizer="sgd", learning_rate=0.1,
+                   partition_rules=[(r"kernel$", "model")])
+    ps.shutdown()
