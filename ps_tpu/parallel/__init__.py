@@ -3,10 +3,24 @@ reference's NCCL reduce + ZMQ transport (SURVEY.md §3 rows 8-9), plus
 sequence/context parallelism (ring + Ulysses attention) for long-context
 models on a 'seq' mesh axis."""
 
+from ps_tpu.parallel.pipeline import (
+    make_pipeline_fn,
+    microbatch,
+    pipeline_partition_rules,
+    stack_stage_params,
+)
 from ps_tpu.parallel.ring_attention import (
     ring_attention,
     sequence_sharding,
     ulysses_attention,
 )
 
-__all__ = ["ring_attention", "ulysses_attention", "sequence_sharding"]
+__all__ = [
+    "ring_attention",
+    "ulysses_attention",
+    "sequence_sharding",
+    "make_pipeline_fn",
+    "microbatch",
+    "pipeline_partition_rules",
+    "stack_stage_params",
+]
