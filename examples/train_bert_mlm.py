@@ -15,14 +15,21 @@ Run (CPU: XLA_FLAGS=--xla_force_host_platform_device_count=8):
 from __future__ import annotations
 
 import argparse
+import os
 
 import jax
+
+if os.environ.get("JAX_PLATFORMS"):
+    # some images preload jax with a pinned platform; the env var wins here
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
 import jax.numpy as jnp
 import numpy as np
 
 import ps_tpu as ps
 from ps_tpu.data.synthetic import mlm_batches
-from ps_tpu.models.bert import BertConfig, BertMLM, make_mlm_loss_fn
+from ps_tpu.models.bert import (BertConfig, BertMLM,
+                                bert_partition_rules, make_mlm_loss_fn)
 from ps_tpu.utils import StepLogger, TrainMetrics, trace
 
 
@@ -35,6 +42,9 @@ def main():
     ap.add_argument("--weight-decay", type=float, default=0.01)
     ap.add_argument("--size", default="base", choices=["base", "tiny"])
     ap.add_argument("--placement", default="sharded", choices=["replicated", "sharded"])
+    ap.add_argument("--model-axis", type=int, default=1,
+                    help="tensor-parallel width: Megatron placement via "
+                         "bert_partition_rules over a 'model' mesh axis")
     ap.add_argument("--dtype", default="bfloat16", choices=["bfloat16", "float32"])
     ap.add_argument("--seed", type=int, default=0)
     ap.add_argument("--jsonl", default=None)
@@ -43,10 +53,19 @@ def main():
 
     if args.steps < 2:
         raise SystemExit("--steps must be >= 2 (step 0 is compile/warmup)")
-    ps.init(backend="tpu")
-    ndev = len(jax.devices())
+    ndev_all = len(jax.devices())
+    tp = args.model_axis
+    if tp > 1:
+        if ndev_all % tp:
+            raise SystemExit(f"--model-axis {tp} must divide the device "
+                             f"count ({ndev_all})")
+        ps.init(backend="tpu",
+                mesh_shape={"data": ndev_all // tp, "model": tp})
+    else:
+        ps.init(backend="tpu")
+    ndev = ndev_all // tp if tp > 1 else ndev_all
     if args.batch_size % ndev:
-        raise SystemExit(f"--batch-size must be divisible by the device count ({ndev})")
+        raise SystemExit(f"--batch-size must be divisible by the data-axis size ({ndev})")
 
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
     cfg = BertConfig(dtype=dtype) if args.size == "base" else BertConfig.tiny(dtype=dtype)
@@ -58,7 +77,8 @@ def main():
     )["params"]
 
     store = ps.KVStore(optimizer="lamb", learning_rate=args.lr,
-                       weight_decay=args.weight_decay, placement=args.placement)
+                       weight_decay=args.weight_decay, placement=args.placement,
+                       partition_rules=bert_partition_rules() if tp > 1 else None)
     store.init(params)
     nparams = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(params))
     print(f"BERT-{args.size} MLM: {nparams/1e6:.1f}M params, {ndev} devices, "

@@ -161,3 +161,24 @@ def make_mlm_loss_fn(model):
         return mlm_loss(logits, batch["labels"])
 
     return loss_fn
+
+
+def bert_partition_rules():
+    """Megatron tensor-parallel placement for :class:`BertMLM` params
+    (pass to ``KVStore(partition_rules=...)`` on a mesh with a 'model'
+    axis): Q/K/V shard the HEADS dim (column-parallel with their biases),
+    the attention out-projection and the FFN output are row-parallel
+    (biases replicate — they add after the contraction's psum), the FFN
+    intermediate is column-parallel. Embeddings/LayerNorms are left to the
+    default heuristic. Parity vs pure data parallelism is asserted in
+    tests/test_bert.py."""
+    return [
+        (r"attention/(query|key|value)/kernel$", (None, "model", None)),
+        (r"attention/(query|key|value)/bias$", ("model", None)),
+        (r"attention/out/kernel$", ("model", None, None)),
+        (r"attention/out/bias$", (None,)),
+        (r"/intermediate/kernel$", (None, "model")),
+        (r"/intermediate/bias$", ("model",)),
+        (r"/output/kernel$", ("model", None)),
+        (r"/output/bias$", (None,)),
+    ]

@@ -94,12 +94,18 @@ def main() -> int:
                 # clean-leave mode: a goodbye is a membership change, not a
                 # death — stop stepping (the global mesh lost a process's
                 # devices; elastic restore picks up from a checkpoint), but
-                # never raise
-                time.sleep(0.8)
+                # never raise. POLL until the goodbye lands: stepping into
+                # the next collective would hang on the departed peer, and
+                # under load the goodbye can take seconds to arrive.
                 det = ps.current_context().backend.failure_detector
-                left_seen = det.left()
-                if left_seen:
-                    break
+                deadline = time.monotonic() + 30
+                while not left_seen and time.monotonic() < deadline:
+                    det.check()  # a DEATH would still raise typed
+                    left_seen = det.left()
+                    time.sleep(0.05)
+                if not left_seen:
+                    raise TimeoutError("leaver's goodbye never arrived")
+                break
             images, labels = next(stream)
             batch = store.shard_batch(
                 (images[pid * rows:(pid + 1) * rows],

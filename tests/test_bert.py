@@ -106,3 +106,52 @@ def test_bert_lamb_training_decreases_loss():
         loss, _ = run(store.shard_batch({k: jnp.asarray(v) for k, v in batch.items()}))
         losses.append(float(loss))
     assert np.mean(losses[-3:]) < np.mean(losses[:3]) - 0.2, losses
+
+
+def test_bert_tensor_parallel_lamb_matches_pure_dp():
+    """dp×tp with bert_partition_rules == pure dp, step for step — the LAMB
+    trust-ratio norms reduce over BOTH the ZeRO shards and the model-axis
+    shards (the tensor-parallel version of SURVEY §8 hard part (b))."""
+    from jax.sharding import PartitionSpec as P
+
+    from ps_tpu.models.bert import bert_partition_rules
+
+    model, params, batch = _tiny_model_and_batch()
+    loss_fn = make_mlm_loss_fn(model)
+
+    def train(mesh_shape, rules):
+        ps.init(backend="tpu", mesh_shape=mesh_shape)
+        store = ps.KVStore(optimizer="lamb", learning_rate=1e-3,
+                           weight_decay=0.01, placement="sharded",
+                           partition_rules=rules)
+        store.init(params)
+        run = store.make_step(loss_fn)
+        losses = []
+        for _ in range(3):
+            loss, out = run(store.shard_batch(batch))
+            losses.append(float(loss))
+        out = jax.tree_util.tree_map(np.asarray, out)
+        ps.shutdown()
+        return losses, out
+
+    dp_losses, dp_out = train({"data": 8}, None)
+    tp_losses, tp_out = train({"data": 4, "model": 2}, bert_partition_rules())
+    np.testing.assert_allclose(tp_losses, dp_losses, rtol=2e-5, atol=1e-6)
+    jax.tree_util.tree_map(
+        lambda a, b: np.testing.assert_allclose(a, b, rtol=2e-4, atol=2e-5),
+        dp_out, tp_out,
+    )
+
+    # and the rules really placed the attention/FFN projections
+    ps.init(backend="tpu", mesh_shape={"data": 4, "model": 2})
+    store = ps.KVStore(optimizer="lamb", learning_rate=1e-3,
+                       placement="replicated",
+                       partition_rules=bert_partition_rules())
+    store.init(params)
+    spec = {k: v.sharding.spec for k, v in store._engine._params.items()}
+    assert spec["layer_0/attention/query/kernel"] == P(None, "model", None)
+    assert spec["layer_0/attention/out/kernel"] == P("model", None, None)
+    assert spec["layer_0/intermediate/kernel"] == P(None, "model")
+    assert spec["layer_0/output/kernel"] == P("model", None)
+    assert spec["layer_0/output/bias"] == P()
+    ps.shutdown()
