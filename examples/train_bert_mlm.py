@@ -63,9 +63,9 @@ def main():
                 mesh_shape={"data": ndev_all // tp, "model": tp})
     else:
         ps.init(backend="tpu")
-    ndev = ndev_all // tp if tp > 1 else ndev_all
-    if args.batch_size % ndev:
-        raise SystemExit(f"--batch-size must be divisible by the data-axis size ({ndev})")
+    dp = ndev_all // tp if tp > 1 else ndev_all  # data-axis size
+    if args.batch_size % dp:
+        raise SystemExit(f"--batch-size must be divisible by the data-axis size ({dp})")
 
     dtype = jnp.bfloat16 if args.dtype == "bfloat16" else jnp.float32
     cfg = BertConfig(dtype=dtype) if args.size == "base" else BertConfig.tiny(dtype=dtype)
@@ -81,7 +81,8 @@ def main():
                        partition_rules=bert_partition_rules() if tp > 1 else None)
     store.init(params)
     nparams = sum(int(np.prod(x.shape)) for x in jax.tree_util.tree_leaves(params))
-    print(f"BERT-{args.size} MLM: {nparams/1e6:.1f}M params, {ndev} devices, "
+    print(f"BERT-{args.size} MLM: {nparams/1e6:.1f}M params, {ndev_all} "
+          f"devices (data={dp}, model={tp}), "
           f"global batch {args.batch_size} x seq {args.seq_len}, "
           f"LAMB placement={args.placement}")
 
@@ -90,7 +91,10 @@ def main():
                          vocab_size=cfg.vocab_size, seed=args.seed,
                          steps=args.steps)
 
-    metrics = TrainMetrics(store, batch_size=args.batch_size, num_chips=ndev)
+    # all chips participate in every step (dp AND tp): per-chip
+    # rates divide by the full device count, not the data-axis size
+    metrics = TrainMetrics(store, batch_size=args.batch_size,
+                           num_chips=ndev_all)
     log = StepLogger(every=10, jsonl=args.jsonl)
     with trace(args.profile_dir):
         for step, batch in enumerate(stream):

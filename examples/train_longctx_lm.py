@@ -29,15 +29,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 import ps_tpu as ps
 from ps_tpu.models import lm
+from ps_tpu.parallel.mesh import parse_mesh
 from ps_tpu.utils import StepLogger, TrainMetrics
-
-
-def parse_mesh(s: str):
-    out = {}
-    for part in s.split(","):
-        k, v = part.split("=")
-        out[k.strip()] = int(v)
-    return out
 
 
 def main():

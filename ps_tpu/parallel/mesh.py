@@ -50,3 +50,16 @@ def make_mesh(mesh_shape: Optional[Dict[str, int]] = None,
     else:
         arr = np.asarray(devices).reshape(shape)
     return Mesh(arr, names)
+
+
+def parse_mesh(spec: str) -> Dict[str, int]:
+    """Parse a CLI mesh string like ``"data=2,model=2,seq=2"`` into the
+    ``{axis: size}`` dict :func:`make_mesh` takes — ONE spelling shared by
+    every trainer that exposes a mesh flag."""
+    out: Dict[str, int] = {}
+    for part in spec.split(","):
+        if "=" not in part:
+            raise ValueError(f"bad mesh component {part!r}; want axis=size")
+        k, v = part.split("=", 1)
+        out[k.strip()] = int(v)
+    return out
