@@ -68,10 +68,10 @@ def test_a2a_lossless_matches_gather():
     np.testing.assert_allclose(got_g, got_a, rtol=1e-6)
 
 
-def test_a2a_capacity_overflow_drops_rows():
-    # all 8 ids hit shard 0 (rows 0..11); capacity_factor=1 -> each source
-    # bucket holds ceil(1/8*1)=1 row, which happens to fit; shrink instead:
-    # 16 ids from 2 ids/device all to shard 0 with capacity 1 -> 8 kept
+def test_a2a_duplicates_merge_before_routing():
+    """Pre-exchange dedupe (the zipf-skew fix, BASELINE.md): duplicate ids
+    collapse into one routed row per worker shard, so a hot row no longer
+    overflows its bucket — this push is LOSSLESS even at capacity 1."""
     ps.init(backend="tpu")
     emb = SparseEmbedding(V, D, optimizer="sgd", learning_rate=1.0,
                           exchange="a2a", capacity_factor=1.0)
@@ -80,10 +80,27 @@ def test_a2a_capacity_overflow_drops_rows():
     assert emb.dropped_rows == 0
     emb.push(ids, np.ones((16, D), np.float32))
     got = np.asarray(emb.table)[:V]
-    dropped_updates = _table0()[0] - got[0]
-    # lossless would subtract 16; capacity 1/bucket keeps 8
-    np.testing.assert_allclose(dropped_updates, np.full(D, 8.0), rtol=1e-6)
-    # the overflow is OBSERVABLE (VERDICT r2 item 5): 8 of 16 rows dropped
+    np.testing.assert_allclose(_table0()[0] - got[0], np.full(D, 16.0),
+                               rtol=1e-6)
+    assert emb.dropped_rows == 0  # merged, not dropped
+    ps.shutdown()
+
+
+def test_a2a_capacity_overflow_drops_distinct_rows():
+    # DISTINCT ids can still overflow: each device pushes rows {0, 1} (both
+    # owned by shard 0) with bucket capacity 1 -> one row per device drops,
+    # and the drop is OBSERVABLE (VERDICT r2 item 5)
+    ps.init(backend="tpu")
+    emb = SparseEmbedding(V, D, optimizer="sgd", learning_rate=1.0,
+                          exchange="a2a", capacity_factor=1.0)
+    emb.init(_table0())
+    ids = np.asarray([0, 1] * 8, np.int32)  # 2 distinct ids per device
+    emb.push(ids, np.ones((16, D), np.float32))
+    got = np.asarray(emb.table)[:V]
+    # sorted-order bucketing keeps id 0, drops id 1, on every device
+    np.testing.assert_allclose(_table0()[0] - got[0], np.full(D, 8.0),
+                               rtol=1e-6)
+    np.testing.assert_allclose(_table0()[1], got[1], rtol=1e-6)
     assert emb.dropped_rows == 8
     assert emb.rows_pushed == 16
     assert abs(emb.dropped_fraction - 0.5) < 1e-9
@@ -197,3 +214,24 @@ def test_widedeep_composite_shard_parity():
         lambda a, b: np.testing.assert_allclose(a, b, rtol=1e-4, atol=1e-6),
         results[1][2], results[8][2],
     )
+
+
+def test_a2a_dropped_counts_raw_updates():
+    """Overflow accounting keeps rows_pushed units AFTER the dedupe: a
+    merged row that overflows reports every raw update it carried
+    (code-review r3 finding)."""
+    ps.init(backend="tpu")
+    emb = SparseEmbedding(V, D, optimizer="sgd", learning_rate=1.0,
+                          exchange="a2a", capacity_factor=1.0)
+    emb.init(_table0())
+    # per device: id 0 once, id 1 three times -> uniques {0 x1, 1 x3};
+    # capacity 1 keeps id 0 and drops the merged id-1 row = 3 raw updates
+    ids = np.asarray([0, 1, 1, 1] * 8, np.int32)
+    emb.push(ids, np.ones((32, D), np.float32))
+    assert emb.dropped_rows == 3 * 8
+    assert emb.rows_pushed == 32
+    got = np.asarray(emb.table)[:V]
+    np.testing.assert_allclose(_table0()[0] - got[0], np.full(D, 8.0),
+                               rtol=1e-6)
+    np.testing.assert_allclose(_table0()[1], got[1], rtol=1e-6)
+    ps.shutdown()
