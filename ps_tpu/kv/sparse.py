@@ -105,7 +105,7 @@ class SparseEmbedding:
         self._dropped_pending: list = []
 
     def record_dropped(self, dropped) -> None:
-        """Accumulate a (possibly device-resident) dropped-row count without
+        """Accumulate a (possibly device-resident) dropped-update count without
         forcing a host sync on the hot path. Pending counts fold into one
         device scalar periodically so a long run that never reads
         :attr:`dropped_rows` holds O(1) buffers, not one per step."""
@@ -118,9 +118,12 @@ class SparseEmbedding:
 
     @property
     def dropped_rows(self) -> int:
-        """Total real rows lost to a2a bucket overflow (0 under gather).
-        Tune ``capacity_factor`` until the rate is acceptable; reading this
-        syncs any pending device counts."""
+        """Total RAW pushed updates lost to a2a bucket overflow (0 under
+        gather) — same units as :attr:`rows_pushed`: a dropped merged row
+        reports every duplicate it carried. Tune ``capacity_factor`` until
+        the rate is acceptable; reading this syncs any pending device
+        counts. (Checkpoints from before the r3 dedupe stored the count in
+        routed-row units; counts resumed from them mix units.)"""
         if self._dropped_pending:
             pending, self._dropped_pending = self._dropped_pending, []
             self._dropped_base += sum(int(x) for x in pending)
