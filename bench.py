@@ -78,6 +78,8 @@ def main(argv=None):
         per_chip_batch, image_size, steps = 8, 64, 4
     batch_size = per_chip_batch * ndev
 
+    if ps.is_initialized():  # retry path: reset the runtime
+        ps.shutdown()
     ctx = ps.init(backend="tpu")
     model = ResNet50(dtype=jnp.bfloat16 if on_tpu else jnp.float32)
     variables = model.init(
@@ -193,4 +195,16 @@ def main(argv=None):
 
 
 if __name__ == "__main__":
-    sys.exit(main())
+    try:
+        sys.exit(main())
+    except SystemExit:
+        raise
+    except Exception:
+        # the remote-chip transport occasionally drops a run mid-flight
+        # (observed under concurrent host load); one clean retry beats
+        # recording a transient tunnel error as the round's benchmark
+        import traceback
+
+        traceback.print_exc()
+        print("transient failure; retrying once", file=sys.stderr)
+        sys.exit(main())
