@@ -59,7 +59,7 @@ def _flops_per_step(run, batch, extra, batch_size: int, image_size: int):
     return None, None
 
 
-def main(argv=None):
+def main(argv=None, retried: bool = False):
     ap = argparse.ArgumentParser()
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--per-chip-batch", type=int, default=256)
@@ -136,6 +136,7 @@ def main(argv=None):
     # first repetition — the extra timing rep below must not skew them
     summary = metrics.summary()
     final_loss = round(float(loss), 4)
+    rep_times = [round(dt, 4)]
 
     if not args.streaming:
         # second timed repetition, keep the better: the remote-chip
@@ -147,7 +148,8 @@ def main(argv=None):
                                        model_state)
         loss.block_until_ready()
         jax.block_until_ready(store.params())
-        dt = min(dt, max(time.time() - t1, 1e-9))
+        rep_times.append(round(max(time.time() - t1, 1e-9), 4))
+        dt = min(rep_times)
 
     imgs_per_sec_per_chip = steps * batch_size / dt / ndev
 
@@ -174,6 +176,9 @@ def main(argv=None):
             "global_batch": batch_size,
             "image_size": image_size,
             "timed_steps": steps,
+            "rep_seconds": rep_times,  # best-of is the headline policy
+            "timing_policy": "best_of_reps",
+            "retried": retried,
             "input": "streaming_prefetch" if args.streaming else "preplaced",
             "loss": final_loss,
             "tflops_per_chip_sustained": round(tflops, 1) if tflops else None,
@@ -194,17 +199,37 @@ def main(argv=None):
     }))
 
 
+def _is_transport_error(e: BaseException) -> bool:
+    """Only the remote-chip tunnel failures observed in r3 qualify for the
+    retry: XLA runtime/transport errors and OS-level socket errors. A real
+    framework bug (TypeError, shape error, ...) must NOT be retried away."""
+    import socket
+
+    if isinstance(e, (ConnectionError, socket.timeout)):
+        return True
+    name = type(e).__name__
+    if name in ("XlaRuntimeError", "JaxRuntimeError"):
+        return True
+    text = repr(e)
+    return any(s in text for s in
+               ("UNAVAILABLE", "DEADLINE_EXCEEDED", "transport", "socket"))
+
+
 if __name__ == "__main__":
     try:
         sys.exit(main())
     except SystemExit:
         raise
-    except Exception:
+    except Exception as e:
         # the remote-chip transport occasionally drops a run mid-flight
         # (observed under concurrent host load); one clean retry beats
-        # recording a transient tunnel error as the round's benchmark
+        # recording a transient tunnel error as the round's benchmark —
+        # but only for transport-shaped errors, and the emitted JSON says
+        # the run was a retry (detail.retried)
         import traceback
 
         traceback.print_exc()
-        print("transient failure; retrying once", file=sys.stderr)
-        sys.exit(main())
+        if not _is_transport_error(e):
+            raise
+        print("transient transport failure; retrying once", file=sys.stderr)
+        sys.exit(main(retried=True))

@@ -64,7 +64,10 @@ def main():
     ap.add_argument("--seed", type=int, default=0)
     # cross-process wiring
     ap.add_argument("--port", type=int, default=0, help="server listen port")
-    ap.add_argument("--bind", default="0.0.0.0", help="server listen address")
+    ap.add_argument("--bind", default="127.0.0.1",
+                    help="server listen address (pass 0.0.0.0 explicitly "
+                         "for a multi-host job; the endpoint is "
+                         "unauthenticated)")
     ap.add_argument("--server", default=None,
                     help="worker: host:port (or env PS_ASYNC_SERVER_URI)")
     ap.add_argument("--worker-id", type=int, default=0)

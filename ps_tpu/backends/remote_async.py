@@ -38,10 +38,12 @@ class AsyncPSService:
     Args:
       store: an initialized async-mode KVStore (the server engine).
       port: TCP port (0 = ephemeral; read :attr:`port`).
-      bind: listen address ("0.0.0.0" pod-wide, "127.0.0.1" tests).
+      bind: listen address. Defaults to loopback — the endpoint is
+        unauthenticated, so exposing it pod-wide ("0.0.0.0") is an explicit
+        opt-in, mirroring ``Config.resolved_heartbeat_bind``.
     """
 
-    def __init__(self, store, port: int = 0, bind: str = "0.0.0.0"):
+    def __init__(self, store, port: int = 0, bind: str = "127.0.0.1"):
         engine = store._engine
         if getattr(engine, "mode", "sync") != "async":
             raise ValueError("AsyncPSService requires an async-mode KVStore")
@@ -50,6 +52,11 @@ class AsyncPSService:
         self._key_order = list(store._key_order)
         self._listener = tv.Listener(port=port, bind=bind)
         self._stop = threading.Event()
+        # set under the engine lock by stop(); checked under the same lock by
+        # the push path, so "no push is applied after stop() returns" holds
+        # even if a serve thread outlives the join (e.g. blocked in a jit
+        # compile inside the engine apply)
+        self._draining = False
         self._conns: List[threading.Thread] = []
         self._channels: List[tv.Channel] = []  # live conns, for stop()
         self._log_lock = threading.Lock()
@@ -97,6 +104,8 @@ class AsyncPSService:
         # this frame's lifetime
         grads = {k: np.array(v) for k, v in grads.items()}
         with self._engine._lock:
+            if self._draining:
+                raise RuntimeError("server is draining; push refused")
             self._engine.push_tree(grads, worker=worker)
             with self._log_lock:
                 self.apply_log.append(worker)
@@ -161,12 +170,28 @@ class AsyncPSService:
     def stop(self) -> None:
         """Drain: no new connections, sever live ones (serve threads blocked
         in recv wake with EOF and exit — no push is applied after this
-        returns), then free the listener."""
+        returns), then free the listener.
+
+        The guarantee has two legs: acquiring the engine lock below waits
+        out any apply already in flight, and ``_draining`` (checked under
+        that same lock) refuses every later commit — so even a serve thread
+        that survives the bounded join (e.g. stuck in a minutes-long jit
+        compile) can never land a push after this method returns."""
         self._stop.set()
+        with self._engine._lock:
+            self._draining = True
         for ch in list(self._channels):
             ch.shutdown()  # non-freeing sever; each serve thread closes own
         for t in list(self._conns):
             t.join(timeout=5)
+        stragglers = [t for t in self._conns if t.is_alive()]
+        if stragglers:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "%d serve thread(s) outlived the drain join; their pushes "
+                "are refused by the draining flag", len(stragglers)
+            )
         # join BEFORE closing: the accept thread may be inside tv_accept on
         # the listener handle (its 200ms timeout bounds the wait); closing
         # first would hand it a freed pointer
@@ -174,13 +199,16 @@ class AsyncPSService:
         self._listener.close()
 
 
-def serve_async(store, port: int = 0, bind: str = "0.0.0.0") -> "AsyncPSService":
+def serve_async(store, port: int = 0,
+                bind: str = "127.0.0.1") -> "AsyncPSService":
     """Expose an initialized async KVStore to remote worker processes.
 
     The top-level entry of the cross-process async deployment: the server
     process calls this after ``store.init(params)``; workers connect with
     :func:`connect_async`. Returns the running service (``.port`` for
-    ephemeral binds, ``.stop()`` to drain)."""
+    ephemeral binds, ``.stop()`` to drain). ``bind`` defaults to loopback;
+    pass "0.0.0.0" explicitly for a multi-host job (the endpoint is
+    unauthenticated)."""
     return AsyncPSService(store, port=port, bind=bind)
 
 

@@ -452,11 +452,24 @@ def _coordination_client_options():
     survivors our failure detector is trying to hand a typed error), and the
     distributed shutdown barrier no longer blocks on dead peers. Dropping
     the client handle is barrier-free, which is what ``shutdown(abort=True)``
-    relies on. Wraps a private jax seam; if the factory ever stops accepting
-    the kwarg, initialization falls back to jax's defaults."""
-    from jax._src import distributed as _dist
+    relies on. Wraps a private jax seam; if the seam moves or the factory
+    stops accepting the kwargs, initialization falls back to jax's defaults
+    with a warning (tests/test_failure.py pins the seam so the degradation
+    is a loud CI signal, not only a runtime warning)."""
+    try:
+        from jax._src import distributed as _dist
 
-    orig = _dist._jax.get_distributed_runtime_client
+        orig = _dist._jax.get_distributed_runtime_client
+    except (ImportError, AttributeError) as e:
+        import warnings
+
+        warnings.warn(
+            "jax private coordination seam moved "
+            f"({e!r}); shutdown(abort=True) loses its barrier-free "
+            "recoverable semantics and peer death may LOG(FATAL) survivors"
+        )
+        yield
+        return
 
     def patched(*args, **kwargs):
         kwargs["recoverable"] = True
@@ -464,6 +477,13 @@ def _coordination_client_options():
         try:
             return orig(*args, **kwargs)
         except TypeError:
+            import warnings
+
+            warnings.warn(
+                "jax coordination client no longer accepts recoverable/"
+                "shutdown_on_destruction; clean aborts will degrade to "
+                "jax defaults (LOG(FATAL) on peer death)"
+            )
             kwargs.pop("recoverable", None)
             kwargs.pop("shutdown_on_destruction", None)
             return orig(*args, **kwargs)

@@ -19,6 +19,7 @@ threads (the server serves each connection from its own thread).
 
 from __future__ import annotations
 
+import contextlib
 import ctypes
 import json
 import struct
@@ -112,7 +113,12 @@ class VanError(ConnectionError):
 
 class Channel:
     """One framed TCP connection (blocking; one driving thread at a time —
-    except :meth:`shutdown`/:meth:`close`, which are cross-thread safe)."""
+    except :meth:`shutdown`/:meth:`close`, which are cross-thread safe).
+
+    Cross-thread close is made safe by refcounting native access: close()
+    severs the socket immediately (waking any thread blocked in recv) but
+    defers the ``tv_close`` free until the last thread inside a native call
+    exits, so no peer thread can dereference a freed Conn."""
 
     def __init__(self, handle, lib):
         import threading
@@ -120,6 +126,8 @@ class Channel:
         self._h = handle
         self._lib = lib
         self._hlock = threading.Lock()  # guards the handle's lifecycle
+        self._users = 0       # threads currently inside a native call
+        self._closed = False  # close() requested; free deferred to last user
 
     @classmethod
     def connect(cls, host: str, port: int, timeout_ms: int = 10_000,
@@ -138,19 +146,38 @@ class Channel:
         raise VanError(f"could not connect to {host}:{port} "
                        f"after {retries} attempts")
 
-    def _require(self):
-        h = self._h
-        if not h:
-            raise VanError("channel is closed")
-        return h
+    @contextlib.contextmanager
+    def _native(self):
+        """Pin the handle for a native call; the last user performs a
+        deferred free if close() ran meanwhile."""
+        with self._hlock:
+            if self._closed or not self._h:
+                raise VanError("channel is closed")
+            self._users += 1
+            h = self._h
+        try:
+            yield h
+        finally:
+            with self._hlock:
+                self._users -= 1
+                if self._closed and self._users == 0 and self._h:
+                    self._lib.tv_close(self._h)
+                    self._h = None
 
     def send(self, payload: bytes) -> None:
-        if not self._lib.tv_send(self._require(), payload, len(payload)):
+        with self._native() as h:
+            ok = self._lib.tv_send(h, payload, len(payload))
+        if not ok:
             self.close()  # half-sent frame: the stream is unusable
             raise VanError("send failed: peer closed")
 
     def recv(self) -> memoryview:
-        n = self._lib.tv_recv_size(self._require())
+        with self._native() as h:
+            n = self._lib.tv_recv_size(h)
+            if n >= 0:
+                buf = bytearray(n)
+                ok = (not n) or self._lib.tv_recv_into(
+                    h, (ctypes.c_char * n).from_buffer(buf), n)
         if n < 0:
             # EOF, or an insane length word — either way the framing is
             # gone; poison the channel so a caught error can't silently
@@ -158,9 +185,7 @@ class Channel:
             self.close()
             raise VanError("recv failed: peer closed" if n == -1
                            else "recv failed: oversized frame")
-        buf = bytearray(n)
-        if n and not self._lib.tv_recv_into(
-                self._h, (ctypes.c_char * n).from_buffer(buf), n):
+        if not ok:
             self.close()
             raise VanError("recv failed mid-frame: peer closed")
         return memoryview(buf)
@@ -174,12 +199,20 @@ class Channel:
         :meth:`recv` on this channel wakes with EOF and runs its own
         :meth:`close`. Safe to call from any thread."""
         with self._hlock:
-            if self._h:
+            if self._h and not self._closed:
                 self._lib.tv_shutdown(self._h)
 
     def close(self) -> None:
+        """Sever and free. Safe from any thread: if another thread is inside
+        a native call, the socket is shut down now (unblocking it) and the
+        free happens when that thread exits :meth:`_native`."""
         with self._hlock:
-            if self._h:
+            if self._closed or not self._h:
+                self._closed = True
+                return
+            self._closed = True
+            self._lib.tv_shutdown(self._h)  # wake any blocked native call
+            if self._users == 0:
                 self._lib.tv_close(self._h)
                 self._h = None
 
