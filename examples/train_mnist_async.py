@@ -17,6 +17,16 @@ Cross-process (one terminal per node; server first):
         --worker-id 0 --steps 30
     python examples/train_mnist_async.py --role worker --server localhost:7077 \
         --worker-id 1 --steps 30
+
+Multi-server key partition (the reference's N-server topology, SURVEY.md §3
+row 4 — each server owns the key range shard_for_key assigns it; workers
+route per-subtree pushes/pulls to the owners):
+    python examples/train_mnist_async.py --role server --port 7077 \
+        --shard 0 --num-shards 2 --num-workers 2 --steps 60
+    python examples/train_mnist_async.py --role server --port 7078 \
+        --shard 1 --num-shards 2 --num-workers 2 --steps 60
+    python examples/train_mnist_async.py --role worker \
+        --server localhost:7077,localhost:7078 --worker-id 0 --steps 30
 """
 
 from __future__ import annotations
@@ -69,8 +79,14 @@ def main():
                          "for a multi-host job; the endpoint is "
                          "unauthenticated)")
     ap.add_argument("--server", default=None,
-                    help="worker: host:port (or env PS_ASYNC_SERVER_URI)")
+                    help="worker: host:port, comma-separated for an "
+                         "N-server partition (or env PS_ASYNC_SERVER_URI)")
     ap.add_argument("--worker-id", type=int, default=0)
+    ap.add_argument("--shard", type=int, default=None,
+                    help="server: this server's index in an N-server key "
+                         "partition")
+    ap.add_argument("--num-shards", type=int, default=None,
+                    help="server: total servers in the key partition")
     args = ap.parse_args()
     params, loss_fn = build(args.seed)
 
@@ -97,14 +113,21 @@ def main():
     ps.init(backend="tpu", mode="async", num_workers=args.num_workers,
             dc_lambda=args.dc_lambda)
     store = ps.KVStore(optimizer="sgd", learning_rate=args.lr, mode="async")
-    store.init(params)
+    if args.role == "server" and args.num_shards is not None:
+        # own only this server's key range of the partition
+        store.init(ps.shard_tree(params, args.shard, args.num_shards))
+    else:
+        store.init(params)
 
     if args.role == "server":
         import time
 
-        svc = ps.serve_async(store, port=args.port, bind=args.bind)
+        svc = ps.serve_async(store, port=args.port, bind=args.bind,
+                             shard=args.shard, num_shards=args.num_shards)
+        shard_note = ("" if args.num_shards is None else
+                      f", shard {args.shard}/{args.num_shards}")
         print(f"async PS server on port {svc.port} "
-              f"({args.num_workers} workers expected)")
+              f"({args.num_workers} workers expected{shard_note})")
         while len(svc.apply_log) < args.steps:
             time.sleep(0.1)
         hist = dict(store._engine.staleness_hist)

@@ -34,7 +34,12 @@ from ps_tpu.api import init, shutdown, is_initialized, current_context
 from ps_tpu.kv.store import KVStore
 from ps_tpu.kv.sparse import SparseEmbedding
 from ps_tpu.train import make_composite_step
-from ps_tpu.backends.remote_async import serve_async, connect_async
+from ps_tpu.backends.remote_async import (
+    ServerFailureError,
+    connect_async,
+    serve_async,
+    shard_tree,
+)
 from ps_tpu import checkpoint
 from ps_tpu import optim
 
@@ -51,6 +56,8 @@ __all__ = [
     "make_composite_step",
     "serve_async",
     "connect_async",
+    "shard_tree",
+    "ServerFailureError",
     "checkpoint",
     "optim",
     "__version__",

@@ -1,35 +1,61 @@
-"""Cross-process async PS: server state in ONE process, workers elsewhere.
+"""Cross-process async PS: N server processes, workers elsewhere.
 
 This is the reference's actual async deployment shape (SURVEY.md §4d: the
 server applies each worker's stale gradient immediately; workers are
 separate, unsynchronized NODES — not host threads). The sync path collapses
 into SPMD collectives; async cannot, by design, so it runs host-side:
 
-- the SERVER process owns an async ``KVStore`` (``AsyncTpuServer`` engine —
+- each SERVER process owns the key range :func:`ps_tpu.kv.keys.shard_for_key`
+  assigns it (SURVEY.md §3 row 4: "range/hash partition of parameter keys
+  across N servers") as an async ``KVStore`` (``AsyncTpuServer`` engine —
   params + per-key state on ITS mesh, DC-ASGD applies, tree-granularity
-  version vector) and serves it over the native van's TCP layer
-  (:class:`AsyncPSService`);
-- each WORKER process runs :class:`RemoteAsyncWorker`: pull params, compute
-  gradients on its OWN jax devices, push — one ``PUSH_PULL`` round trip per
-  cycle. Staleness is real cross-process staleness: whatever other workers
-  committed between this worker's pull and its push.
+  version vector over ITS subtree) and serves it over the native van's TCP
+  layer (:class:`AsyncPSService`). :func:`shard_tree` carves the owned
+  subtree out of the full model;
+- each WORKER process runs :class:`RemoteAsyncWorker`: pull params from
+  every owner, compute gradients on its OWN jax devices, push each owner its
+  subtree — one concurrent ``PUSH_PULL`` round per cycle (one round trip per
+  server, in flight simultaneously). Staleness is real cross-process
+  staleness, tracked PER SERVER: each server's version counts whole-subtree
+  applies to its own range, and the DC correction at server s uses the τ
+  between this worker's last pull from s and its push to s. A dead server
+  surfaces as a typed :class:`ServerFailureError` at the worker.
 
-Parity contract (tests/test_remote_async.py, tests/mp_async_worker.py): the
-server records its apply order; replaying that exact (worker, grads)
-sequence through a threaded ``AsyncTpuServer`` yields bit-identical
-parameters — the wire changes nothing about the math.
+Parity contract (tests/test_remote_async.py, tests/test_multiserver_async.py):
+each server records its apply order; replaying that exact (worker, grads)
+sequence through in-process ``AsyncTpuServer`` engines — one per key range —
+yields bit-identical parameters — the wire and the partition change nothing
+about the math.
 """
 
 from __future__ import annotations
 
 import json
 import threading
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
 from ps_tpu.control import tensor_van as tv
 from ps_tpu.kv import keys as keymod
+
+
+class ServerFailureError(RuntimeError):
+    """A remote async PS server died mid-job (its connection failed)."""
+
+
+def shard_tree(params_like, shard: int, num_shards: int) -> Dict[str, Any]:
+    """The flat ``{key: leaf}`` subtree that server ``shard`` of
+    ``num_shards`` owns under the :func:`~ps_tpu.kv.keys.shard_for_key` hash
+    partition.
+
+    A flat dict of slash-joined key strings is itself a valid pytree whose
+    flatten reproduces the same keys, so a server process can pass the
+    returned dict straight to ``KVStore.init`` and own exactly its range.
+    """
+    kv, _ = keymod.flatten_with_keys(params_like)
+    return {k: v for k, v in kv.items()
+            if keymod.shard_for_key(k, num_shards) == shard}
 
 
 class AsyncPSService:
@@ -41,15 +67,34 @@ class AsyncPSService:
       bind: listen address. Defaults to loopback — the endpoint is
         unauthenticated, so exposing it pod-wide ("0.0.0.0") is an explicit
         opt-in, mirroring ``Config.resolved_heartbeat_bind``.
+      shard/num_shards: this server's position in an N-server key partition
+        (None = the classic single-server topology). When set, the store's
+        keys are validated against the ``shard_for_key`` assignment at
+        construction and advertised to workers in the HELLO reply so a
+        misconfigured topology fails loudly at connect time.
     """
 
-    def __init__(self, store, port: int = 0, bind: str = "127.0.0.1"):
+    def __init__(self, store, port: int = 0, bind: str = "127.0.0.1",
+                 shard: Optional[int] = None,
+                 num_shards: Optional[int] = None):
         engine = store._engine
         if getattr(engine, "mode", "sync") != "async":
             raise ValueError("AsyncPSService requires an async-mode KVStore")
+        if (shard is None) != (num_shards is None):
+            raise ValueError("pass shard and num_shards together")
+        self.shard, self.num_shards = shard, num_shards
         self._store = store
         self._engine = engine
         self._key_order = list(store._key_order)
+        if num_shards is not None:
+            misplaced = [k for k in self._key_order
+                         if keymod.shard_for_key(k, num_shards) != shard]
+            if misplaced:
+                raise ValueError(
+                    f"store holds keys not owned by shard {shard}/"
+                    f"{num_shards}: {misplaced[:3]} — init the server's "
+                    f"store with shard_tree(params, shard, num_shards)"
+                )
         self._listener = tv.Listener(port=port, bind=bind)
         self._stop = threading.Event()
         # set under the engine lock by stop(); checked under the same lock by
@@ -125,6 +170,8 @@ class AsyncPSService:
                             "keys": self._key_order,
                             "version": self._engine.version,
                             "num_workers": self._engine.num_workers,
+                            "shard": self.shard,
+                            "num_shards": self.num_shards,
                         }))
                     elif kind == tv.PULL:
                         ch.send(self._params_payload(worker))
@@ -199,28 +246,40 @@ class AsyncPSService:
         self._listener.close()
 
 
-def serve_async(store, port: int = 0,
-                bind: str = "127.0.0.1") -> "AsyncPSService":
+def serve_async(store, port: int = 0, bind: str = "127.0.0.1",
+                shard: Optional[int] = None,
+                num_shards: Optional[int] = None) -> "AsyncPSService":
     """Expose an initialized async KVStore to remote worker processes.
 
-    The top-level entry of the cross-process async deployment: the server
-    process calls this after ``store.init(params)``; workers connect with
+    The top-level entry of the cross-process async deployment: each server
+    process calls this after ``store.init(...)``; workers connect with
     :func:`connect_async`. Returns the running service (``.port`` for
     ephemeral binds, ``.stop()`` to drain). ``bind`` defaults to loopback;
     pass "0.0.0.0" explicitly for a multi-host job (the endpoint is
-    unauthenticated)."""
-    return AsyncPSService(store, port=port, bind=bind)
+    unauthenticated).
+
+    Single-server mode: ``store.init(params)`` with the full tree, no shard
+    args. Multi-server mode (the reference's N-server topology): server
+    ``s`` of ``N`` runs ``store.init(shard_tree(params, s, N))`` and
+    ``serve_async(store, ..., shard=s, num_shards=N)``."""
+    return AsyncPSService(store, port=port, bind=bind,
+                          shard=shard, num_shards=num_shards)
 
 
 def connect_async(uri: str, worker: int, params_like) -> "RemoteAsyncWorker":
     """Join a cross-process async job as worker ``worker``.
 
-    ``uri`` is ``host:port`` of the :func:`serve_async` process (also the
-    form trainers read from ``PS_ASYNC_SERVER_URI``); ``params_like`` is a
-    pytree with the model's parameter structure (used to validate the tree
-    against the server and to rebuild pulled params)."""
-    host, port = uri.rsplit(":", 1)
-    return RemoteAsyncWorker(host, int(port), worker, params_like)
+    ``uri`` is ``host:port`` of the :func:`serve_async` process, or a
+    comma-separated list ``h0:p0,h1:p1,...`` naming every server of an
+    N-server partition (also the form trainers read from
+    ``PS_ASYNC_SERVER_URI``); ``params_like`` is a pytree with the model's
+    parameter structure (used to validate the key partition against the
+    servers and to rebuild pulled params)."""
+    addrs = []
+    for part in uri.split(","):
+        host, port = part.strip().rsplit(":", 1)
+        addrs.append((host, int(port)))
+    return RemoteAsyncWorker.connect_many(addrs, worker, params_like)
 
 
 class RemoteAsyncWorker:
@@ -228,76 +287,211 @@ class RemoteAsyncWorker:
 
     Computes gradients on this process's own jax devices against the params
     it last pulled (stale by whatever other workers pushed since), and
-    exchanges them with the server over one TCP round trip per cycle.
+    exchanges per-owner subtrees with every server in one concurrent round
+    per cycle. ``version`` sums the per-server subtree versions (each server
+    counts whole-subtree applies to its own key range); per-server values
+    are in ``versions``. A failed server connection raises
+    :class:`ServerFailureError` naming the server.
     """
 
     def __init__(self, host: str, port: int, worker: int, params_like):
+        self._init_multi([(host, int(port))], worker, params_like)
+
+    @classmethod
+    def connect_many(cls, addrs: Sequence[Tuple[str, int]], worker: int,
+                     params_like) -> "RemoteAsyncWorker":
+        self = cls.__new__(cls)
+        self._init_multi(list(addrs), worker, params_like)
+        return self
+
+    def _init_multi(self, addrs: List[Tuple[str, int]], worker: int,
+                    params_like) -> None:
         self.worker = worker
         kv, self._treedef = keymod.flatten_with_keys(params_like)
         self._key_order = sorted(kv)
-        self._ch = tv.Channel.connect(host, port)
-        _, _, _, extra = tv.decode(
-            self._ch.request(tv.encode(tv.HELLO, worker, None))
-        )
-        if sorted(extra["keys"]) != self._key_order:
-            raise ValueError(
-                "server tree does not match this worker's params structure"
+        self._addrs = addrs
+        n = len(addrs)
+        self._chs: List[tv.Channel] = []
+        self._owner: Dict[str, int] = {}  # key -> index into addrs
+        self.versions: List[int] = [0] * n
+        self.num_workers: Optional[int] = None
+        try:
+            self._connect_and_validate(addrs, worker, kv)
+        except Exception:
+            # a failed constructor can't be close()d: don't leak the
+            # channels (and server serve threads) connected so far
+            for ch in self._chs:
+                ch.close()
+            raise
+        self._params = None
+        # servers that own at least one key — the only ones worth a round trip
+        self._active = sorted(set(self._owner.values()))
+        self._pool = None
+        if len(self._active) > 1:
+            import concurrent.futures
+
+            self._pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=len(self._active)
             )
-        self.version = int(extra["version"])
-        # the JOB's worker count (data-sharding denominator) is the server's
-        # truth, not a local guess
-        self.num_workers = int(extra["num_workers"])
+
+    def _connect_and_validate(self, addrs, worker, kv) -> None:
+        n = len(addrs)
+        for i, (host, port) in enumerate(addrs):
+            ch = tv.Channel.connect(host, port)
+            self._chs.append(ch)
+            _, _, _, extra = tv.decode(
+                ch.request(tv.encode(tv.HELLO, worker, None))
+            )
+            skeys = sorted(extra["keys"])
+            ns = extra.get("num_shards")
+            if ns is not None:
+                # the server knows its place in a partition: hold it to it
+                if int(ns) != n:
+                    raise ValueError(
+                        f"server {i} ({host}:{port}) is shard "
+                        f"{extra['shard']}/{ns} but this worker dialed "
+                        f"{n} server(s)"
+                    )
+                expected = sorted(
+                    k for k in self._key_order
+                    if keymod.shard_for_key(k, n) == int(extra["shard"])
+                )
+                if skeys != expected:
+                    raise ValueError(
+                        f"server {i} key range does not match the "
+                        f"shard_for_key assignment for shard {extra['shard']}"
+                    )
+            for k in skeys:
+                if k not in kv:
+                    raise ValueError(
+                        f"server {i} owns key {k!r} absent from this "
+                        f"worker's params structure"
+                    )
+                if k in self._owner:
+                    raise ValueError(
+                        f"key {k!r} claimed by servers "
+                        f"{self._owner[k]} and {i}"
+                    )
+                self._owner[k] = i
+            self.versions[i] = int(extra["version"])
+            # the JOB's worker count (data-sharding denominator) is the
+            # servers' truth, not a local guess — and must agree across them
+            nw = int(extra["num_workers"])
+            if self.num_workers is None:
+                self.num_workers = nw
+            elif nw != self.num_workers:
+                raise ValueError(
+                    f"servers disagree on num_workers ({self.num_workers} "
+                    f"vs {nw} at server {i})"
+                )
+        missing = [k for k in self._key_order if k not in self._owner]
+        if missing:
+            raise ValueError(f"no server owns keys {missing[:3]}"
+                             f"{'...' if len(missing) > 3 else ''}")
         if not (0 <= worker < self.num_workers):
             raise ValueError(
                 f"worker id {worker} out of range for a "
                 f"{self.num_workers}-worker job"
             )
-        self._params = None
+
+    @property
+    def version(self) -> int:
+        """Total whole-subtree applies across all servers (single-server:
+        exactly the server's version)."""
+        return sum(self.versions)
 
     # -- protocol -------------------------------------------------------------
 
-    def _unpack_params(self, msg) -> Any:
-        kind, _, tensors, extra = tv.decode(msg)
-        if kind != tv.OK:
-            raise RuntimeError(f"server error: {extra.get('error')}")
+    def _request(self, i: int, payload: bytes):
+        try:
+            return self._chs[i].request(payload)
+        except tv.VanError as e:
+            host, port = self._addrs[i]
+            raise ServerFailureError(
+                f"async PS server {i} ({host}:{port}) failed mid-job: {e}"
+            ) from e
+
+    def _fanout(self, payloads: Dict[int, bytes]) -> Dict[int, memoryview]:
+        """One concurrent round: each server its request, all in flight
+        together (the point of the partition — N servers apply in parallel).
+
+        Every future is waited before any error propagates — abandoning a
+        still-running request would leave a pool thread driving a channel
+        that a later call (stats/close/retry) drives again from this thread,
+        tearing the framed stream."""
+        if self._pool is None or len(payloads) == 1:
+            return {i: self._request(i, p) for i, p in payloads.items()}
+        import concurrent.futures
+
+        futs = {i: self._pool.submit(self._request, i, p)
+                for i, p in payloads.items()}
+        concurrent.futures.wait(futs.values())
+        return {i: f.result() for i, f in futs.items()}
+
+    def _merge_params(self, msgs: Dict[int, memoryview]) -> Any:
         import jax.numpy as jnp
 
-        self.version = int(extra["version"])
-        kv = {k: jnp.asarray(np.array(v)) for k, v in tensors.items()}
+        kv = {}
+        for i, msg in msgs.items():
+            kind, _, tensors, extra = tv.decode(msg)
+            if kind != tv.OK:
+                raise RuntimeError(f"server {i} error: {extra.get('error')}")
+            self.versions[i] = int(extra["version"])
+            for k, v in tensors.items():
+                kv[k] = jnp.asarray(np.array(v))
         self._params = keymod.unflatten(self._treedef, kv, self._key_order)
         return self._params
 
+    def _split_by_owner(self, grads) -> Dict[int, Dict[str, np.ndarray]]:
+        kv, _ = keymod.flatten_with_keys(grads)
+        out: Dict[int, Dict[str, np.ndarray]] = {i: {} for i in self._active}
+        for k, v in kv.items():
+            out[self._owner[k]][k] = np.asarray(v)
+        return out
+
     def pull_all(self) -> Any:
-        """Fetch current params (server records this worker's snapshot)."""
-        return self._unpack_params(
-            self._ch.request(tv.encode(tv.PULL, self.worker, None))
-        )
+        """Fetch current params (each server records this worker's snapshot
+        of its subtree)."""
+        return self._merge_params(self._fanout({
+            i: tv.encode(tv.PULL, self.worker, None) for i in self._active
+        }))
 
     def push_all(self, grads) -> None:
-        """Push a gradient tree; the server applies it immediately with the
-        DC-ASGD correction against this worker's last pull."""
-        kv, _ = keymod.flatten_with_keys(grads)
-        msg = self._ch.request(tv.encode(
-            tv.PUSH, self.worker, {k: np.asarray(v) for k, v in kv.items()}
-        ))
-        kind, _, _, extra = tv.decode(msg)
-        if kind != tv.OK:
-            raise RuntimeError(f"server error: {extra.get('error')}")
-        self.version = int(extra["version"])
+        """Push a gradient tree; each owner applies its subtree immediately
+        with the DC-ASGD correction against this worker's last pull from it."""
+        msgs = self._fanout({
+            i: tv.encode(tv.PUSH, self.worker, sub)
+            for i, sub in self._split_by_owner(grads).items()
+        })
+        for i, msg in msgs.items():
+            kind, _, _, extra = tv.decode(msg)
+            if kind != tv.OK:
+                raise RuntimeError(f"server {i} error: {extra.get('error')}")
+            self.versions[i] = int(extra["version"])
 
     def push_pull(self, grads) -> Any:
-        """push_all + pull_all in ONE round trip (the async cycle)."""
-        kv, _ = keymod.flatten_with_keys(grads)
-        return self._unpack_params(self._ch.request(tv.encode(
-            tv.PUSH_PULL, self.worker,
-            {k: np.asarray(v) for k, v in kv.items()}
-        )))
+        """push_all + pull_all in ONE round trip per server (the async
+        cycle), all servers in flight concurrently."""
+        return self._merge_params(self._fanout({
+            i: tv.encode(tv.PUSH_PULL, self.worker, sub)
+            for i, sub in self._split_by_owner(grads).items()
+        }))
 
     def stats(self) -> dict:
-        _, _, _, extra = tv.decode(
-            self._ch.request(tv.encode(tv.STATS, self.worker, None))
-        )
-        return extra
+        """Single-server: that server's stats dict (back-compat shape).
+        Multi-server: ``{"servers": [per-server stats], "version": total}``."""
+        msgs = self._fanout({
+            i: tv.encode(tv.STATS, self.worker, None) for i in self._active
+        })
+        extras = {}
+        for i, msg in msgs.items():
+            _, _, _, extra = tv.decode(msg)
+            extras[i] = extra
+        if len(self._chs) == 1:
+            return extras[self._active[0]]
+        return {"servers": [extras.get(i) for i in range(len(self._chs))],
+                "version": sum(int(e.get("version", 0))
+                               for e in extras.values())}
 
     def make_async_step(self, loss_fn, has_aux: bool = False):
         """``run(batch, *extra) -> loss`` — grad against the last-pulled
@@ -319,11 +513,14 @@ class RemoteAsyncWorker:
         return run
 
     def close(self) -> None:
-        try:
-            self._ch.request(tv.encode(tv.SHUTDOWN, self.worker, None))
-        except tv.VanError:
-            pass
-        self._ch.close()
+        for ch in self._chs:
+            try:
+                ch.request(tv.encode(tv.SHUTDOWN, self.worker, None))
+            except tv.VanError:
+                pass
+            ch.close()
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
 
     def __enter__(self):
         return self

@@ -2,16 +2,20 @@
 (tests/test_remote_async.py).
 
 Roles (argv[1]):
-  server <port> <out_dir> <nworkers> <cycles>
+  server <port> <out_dir> <nworkers> <cycles> [<shard> <nshards>]
       owns the async KVStore + AsyncPSService; waits until every worker's
       pushes arrived, then dumps final params (exact bytes), the apply/pull
-      event log, and the staleness histogram.
-  worker <port> <out_dir> <worker_id> <cycles>
+      event log, and the staleness histogram. With the optional shard args
+      it owns only its shard_for_key range (multi-server partition,
+      tests/test_multiserver_async.py) and suffixes its output files with
+      the shard index.
+  worker <ports> <out_dir> <worker_id> <cycles>
       a separate async NODE: pull -> local grad (deterministic fn of
       (worker, cycle)) -> push, with jitter so pushes interleave across
-      processes and real cross-process staleness accrues.
+      processes and real cross-process staleness accrues. <ports> may be a
+      comma-separated list naming every server of a partition.
 
-The parity contract: replaying the server's event log through a threaded
+The parity contract: replaying each server's event log through a threaded
 AsyncTpuServer in the parent reproduces the final params bit-for-bit.
 """
 
@@ -47,20 +51,25 @@ def make_grads(params, worker: int, cycle: int):
     )
 
 
-def run_server(port: int, out_dir: str, nworkers: int, cycles: int) -> int:
+def run_server(port: int, out_dir: str, nworkers: int, cycles: int,
+               shard=None, nshards=None) -> int:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
     import numpy as np
 
     import ps_tpu as ps
-    from ps_tpu.backends.remote_async import AsyncPSService
+    from ps_tpu.backends.remote_async import AsyncPSService, shard_tree
 
     params = _model_params()
+    suffix = "" if shard is None else str(shard)
+    if nshards is not None:
+        params = shard_tree(params, shard, nshards)
     ps.init(backend="tpu", mode="async", num_workers=nworkers, dc_lambda=0.04)
     store = ps.KVStore(optimizer="sgd", learning_rate=0.05, mode="async")
     store.init(params)
-    svc = AsyncPSService(store, port=port, bind="127.0.0.1")
+    svc = AsyncPSService(store, port=port, bind="127.0.0.1",
+                         shard=shard, num_shards=nshards)
     target = nworkers * cycles
     deadline = time.monotonic() + 120
     while len(svc.apply_log) < target:
@@ -71,11 +80,12 @@ def run_server(port: int, out_dir: str, nworkers: int, cycles: int) -> int:
         time.sleep(0.02)
     final = {k: np.asarray(v)
              for k, v in store._engine.pull_tree(worker=0).items()}
-    np.savez(os.path.join(out_dir, "server_params.npz"), **final)
-    with open(os.path.join(out_dir, "server.json"), "w") as f:
+    np.savez(os.path.join(out_dir, f"server_params{suffix}.npz"), **final)
+    with open(os.path.join(out_dir, f"server{suffix}.json"), "w") as f:
         json.dump({
             "event_log": svc.event_log,
             "apply_log": svc.apply_log,
+            "keys": svc._key_order,
             "staleness_hist": {
                 str(t): n for t, n in store._engine.staleness_hist.items()
             },
@@ -86,16 +96,16 @@ def run_server(port: int, out_dir: str, nworkers: int, cycles: int) -> int:
     return 0
 
 
-def run_worker(port: int, out_dir: str, worker: int, cycles: int) -> int:
+def run_worker(ports: str, out_dir: str, worker: int, cycles: int) -> int:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
 
-    from ps_tpu.backends.remote_async import RemoteAsyncWorker
+    from ps_tpu.backends.remote_async import connect_async
 
     params = _model_params()
-    w = RemoteAsyncWorker("127.0.0.1", port, worker=worker,
-                          params_like=params)
+    uri = ",".join(f"127.0.0.1:{p}" for p in ports.split(","))
+    w = connect_async(uri, worker, params)
     versions = []
     w.pull_all()
     for c in range(cycles):
@@ -104,19 +114,22 @@ def run_worker(port: int, out_dir: str, worker: int, cycles: int) -> int:
         w.push_pull(make_grads(params, worker, c))
         versions.append(w.version)
     with open(os.path.join(out_dir, f"worker{worker}.json"), "w") as f:
-        json.dump({"worker": worker, "versions": versions}, f)
+        json.dump({"worker": worker, "versions": versions,
+                   "per_server_versions": w.versions}, f)
     w.close()
     return 0
 
 
 def main() -> int:
     role = sys.argv[1]
-    port, out_dir = int(sys.argv[2]), sys.argv[3]
+    out_dir = sys.argv[3]
     a, b = int(sys.argv[4]), int(sys.argv[5])
     os.environ["JAX_PLATFORMS"] = "cpu"
     if role == "server":
-        return run_server(port, out_dir, a, b)
-    return run_worker(port, out_dir, a, b)
+        shard = int(sys.argv[6]) if len(sys.argv) > 6 else None
+        nshards = int(sys.argv[7]) if len(sys.argv) > 7 else None
+        return run_server(int(sys.argv[2]), out_dir, a, b, shard, nshards)
+    return run_worker(sys.argv[2], out_dir, a, b)
 
 
 if __name__ == "__main__":
