@@ -40,6 +40,11 @@ from ps_tpu.backends.remote_async import (
     serve_async,
     shard_tree,
 )
+from ps_tpu.backends.remote_sparse import (
+    connect_sparse,
+    row_range,
+    serve_sparse,
+)
 from ps_tpu import checkpoint
 from ps_tpu import optim
 
@@ -57,6 +62,9 @@ __all__ = [
     "serve_async",
     "connect_async",
     "shard_tree",
+    "serve_sparse",
+    "connect_sparse",
+    "row_range",
     "ServerFailureError",
     "checkpoint",
     "optim",

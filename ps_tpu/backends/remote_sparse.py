@@ -1,0 +1,565 @@
+"""Cross-process sparse PS: embedding tables served over the van.
+
+The reference's classic async deployment is Wide&Deep: workers push
+(row_ids, row_grads) to the sparse servers owning those rows and pull the
+rows they need next (SURVEY.md §4c composed with §4d — range-sharded
+tables, per-row optimizer state server-side, workers hold only gathered
+rows). The in-process :class:`~ps_tpu.kv.sparse.SparseEmbedding` maps this
+to mesh shards + ``all_to_all``; THIS module is the cross-process form —
+separate, unsynchronized OS processes exchanging framed row messages over
+the native van's TCP layer:
+
+- each SERVER process owns a contiguous row range of each table
+  (:func:`row_range` — the reference's "range-sharded rows") as a local
+  :class:`SparseEmbedding` (its own devices, its own per-row optimizer
+  state) and serves ROW_PULL / ROW_PUSH / ROW_PUSH_PULL frames
+  (:class:`SparsePSService`). One service can own several named tables
+  (Wide&Deep: "deep" [V,D] + "wide" [V,1]) so a worker cycle is one round
+  trip per server, not per table;
+- each WORKER process runs :class:`RemoteSparseWorker`: route global ids to
+  owners by range, fan the per-server requests out concurrently, scatter the
+  pulled rows back into id order. Pushes apply immediately server-side
+  (async semantics; a per-table version counts applies). A dead server
+  surfaces as a typed :class:`ServerFailureError`.
+
+Parity contract (tests/test_remote_sparse.py): each server records its
+apply order; replaying that exact (worker, cycle) push sequence — routed by
+the same range split — through an in-process ``SparseEmbedding`` of the
+server's local size yields a bit-identical table: the wire and the range
+partition change nothing about the math.
+"""
+
+from __future__ import annotations
+
+import math
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ps_tpu.backends.remote_async import ServerFailureError
+from ps_tpu.control import tensor_van as tv
+
+
+def row_range(shard: int, num_shards: int, total_rows: int) -> Tuple[int, int]:
+    """The contiguous global row range ``[lo, hi)`` server ``shard`` of
+    ``num_shards`` owns in a ``total_rows``-row table (even ceil split; the
+    last shard takes the remainder — the reference's range partition)."""
+    if not (0 <= shard < num_shards):
+        raise ValueError(f"shard {shard} out of range for {num_shards}")
+    per = math.ceil(total_rows / num_shards)
+    lo = min(shard * per, total_rows)
+    return lo, min(lo + per, total_rows)
+
+
+def dedupe_rows_np(ids: np.ndarray, grads: np.ndarray
+                   ) -> Tuple[np.ndarray, np.ndarray]:
+    """Worker-side pre-push merge (SURVEY.md §4c: "dedupe/sum duplicate
+    rows"): sum duplicate ids' grads so each unique row travels once.
+    Host-side (numpy) twin of the in-process ``_dedupe_rows``; summation in
+    f32, rounded once back to the wire dtype. Deterministic."""
+    if ids.size == 0:
+        return ids, grads
+    uniq, inv = np.unique(ids, return_inverse=True)
+    summed = np.zeros((uniq.size, grads.shape[1]), np.float32)
+    np.add.at(summed, inv, grads.astype(np.float32))
+    return uniq.astype(ids.dtype), summed.astype(grads.dtype)
+
+
+class SparsePSService:
+    """Serve named :class:`SparseEmbedding` tables to remote workers.
+
+    Args:
+      tables: ``{name: initialized SparseEmbedding}`` — in sharded mode each
+        holds only this server's row range (``row_range`` rows of the
+        table's global size).
+      port/bind: as :class:`~ps_tpu.backends.remote_async.AsyncPSService`
+        (loopback by default; the endpoint is unauthenticated).
+      shard/num_shards: position in an N-server row partition (None = one
+        server owns every row).
+      total_rows: sharded mode only — ``{name: global table rows}``; each
+        local table's ``num_rows`` is validated against its
+        :func:`row_range` slice so a mis-sliced topology fails loudly at
+        construction, and the worker validates coverage at connect time.
+    """
+
+    def __init__(self, tables: Dict[str, Any], port: int = 0,
+                 bind: str = "127.0.0.1", shard: Optional[int] = None,
+                 num_shards: Optional[int] = None,
+                 total_rows: Optional[Dict[str, int]] = None):
+        if not tables:
+            raise ValueError("no tables to serve")
+        if (shard is None) != (num_shards is None):
+            raise ValueError("pass shard and num_shards together")
+        self.shard, self.num_shards = shard, num_shards
+        self._tables = dict(tables)
+        self._meta: Dict[str, dict] = {}
+        for name, emb in self._tables.items():
+            if num_shards is None:
+                lo, hi = 0, emb.num_rows
+                total = emb.num_rows
+            else:
+                if total_rows is None or name not in total_rows:
+                    raise ValueError(
+                        f"sharded mode needs total_rows[{name!r}]"
+                    )
+                total = int(total_rows[name])
+                lo, hi = row_range(shard, num_shards, total)
+                if emb.num_rows != hi - lo:
+                    raise ValueError(
+                        f"table {name!r} holds {emb.num_rows} rows but "
+                        f"shard {shard}/{num_shards} of {total} owns "
+                        f"[{lo}, {hi}) = {hi - lo} rows — init it with "
+                        f"row_range(shard, num_shards, total)"
+                    )
+            self._meta[name] = {
+                "total_rows": total, "lo": lo, "hi": hi, "dim": emb.dim,
+                "dtype": np.dtype(emb.dtype).str,
+            }
+        # one lock: a multi-table push applies atomically, and pulls never
+        # observe a half-swapped (table, state) pair
+        self._lock = threading.Lock()
+        self._draining = False
+        self.versions: Dict[str, int] = {n: 0 for n in self._tables}
+        self.rows_applied: Dict[str, int] = {n: 0 for n in self._tables}
+        self._log_lock = threading.Lock()
+        self.apply_log: List[int] = []  # worker id per applied push message
+        self._listener = tv.Listener(port=port, bind=bind)
+        self._stop = threading.Event()
+        self._conns: List[threading.Thread] = []
+        self._channels: List[tv.Channel] = []
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True
+        )
+        self._accept_thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._listener.port
+
+    # -- server internals -----------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            ch = self._listener.accept(timeout_ms=200)
+            if ch is None:
+                continue
+            self._channels.append(ch)
+            t = threading.Thread(target=self._serve, args=(ch,), daemon=True)
+            t.start()
+            self._conns.append(t)
+
+    def _hello_extra(self) -> dict:
+        return {
+            "tables": self._meta,
+            "shard": self.shard,
+            "num_shards": self.num_shards,
+            "versions": dict(self.versions),
+        }
+
+    def _split(self, tensors: Dict[str, np.ndarray]
+               ) -> Dict[str, Dict[str, np.ndarray]]:
+        """``{"deep/ids": x}`` frames -> ``{"deep": {"ids": x}}``."""
+        out: Dict[str, Dict[str, np.ndarray]] = {}
+        for k, v in tensors.items():
+            name, _, field = k.partition("/")
+            if name not in self._tables:
+                raise KeyError(f"unknown table {name!r}")
+            out.setdefault(name, {})[field] = v
+        return out
+
+    def _localize(self, name: str, ids: np.ndarray) -> np.ndarray:
+        m = self._meta[name]
+        ids = np.asarray(ids, np.int32)
+        if ids.size and (ids.min() < m["lo"] or ids.max() >= m["hi"]):
+            raise IndexError(
+                f"ids outside this server's {name!r} range "
+                f"[{m['lo']}, {m['hi']})"
+            )
+        return ids - m["lo"]
+
+    def _apply_push(self, worker: int,
+                    per_table: Dict[str, Dict[str, np.ndarray]]) -> None:
+        # copy out of the recv buffer: the engine keeps references beyond
+        # this frame's lifetime
+        todo = []
+        for name, t in per_table.items():
+            if "ids" not in t or "grads" not in t:
+                raise KeyError(f"push for {name!r} needs ids + grads")
+            todo.append((name, self._localize(name, np.array(t["ids"])),
+                         np.array(t["grads"])))
+        if not todo:
+            return  # push_pull with no rows for this server: nothing applied
+        with self._lock:
+            if self._draining:
+                raise RuntimeError("server is draining; push refused")
+            for name, ids, grads in todo:
+                self._tables[name].push(ids, grads)
+                self.versions[name] += 1
+                self.rows_applied[name] += int(ids.size)
+            with self._log_lock:
+                self.apply_log.append(worker)
+
+    def _rows_payload(self, worker: int,
+                      per_table: Dict[str, Dict[str, np.ndarray]]) -> bytes:
+        out = {}
+        with self._lock:
+            for name, t in per_table.items():
+                ids = self._localize(name, t["ids"])
+                out[f"{name}/rows"] = np.asarray(self._tables[name].pull(ids))
+            versions = dict(self.versions)
+        return tv.encode(tv.OK, worker, out, extra={"versions": versions})
+
+    def _serve(self, ch: tv.Channel) -> None:
+        try:
+            while not self._stop.is_set():
+                try:
+                    msg = ch.recv()
+                except tv.VanError:
+                    return  # worker hung up
+                kind, worker, tensors, extra = tv.decode(msg)
+                try:
+                    if kind == tv.HELLO:
+                        ch.send(tv.encode(tv.OK, worker, None,
+                                          extra=self._hello_extra()))
+                    elif kind == tv.ROW_PULL:
+                        ch.send(self._rows_payload(worker,
+                                                   self._split(tensors)))
+                    elif kind == tv.ROW_PUSH:
+                        self._apply_push(worker, self._split(tensors))
+                        ch.send(tv.encode(tv.OK, worker, None, extra={
+                            "versions": dict(self.versions),
+                        }))
+                    elif kind == tv.ROW_PUSH_PULL:
+                        per = self._split(tensors)
+                        push = {n: t for n, t in per.items() if "grads" in t}
+                        pull = {n: {"ids": t["pull_ids"]}
+                                for n, t in per.items() if "pull_ids" in t}
+                        self._apply_push(worker, push)
+                        ch.send(self._rows_payload(worker, pull))
+                    elif kind == tv.STATS:
+                        with self._log_lock:
+                            log = list(self.apply_log)
+                        ch.send(tv.encode(tv.OK, worker, None, extra={
+                            "versions": dict(self.versions),
+                            "rows_applied": dict(self.rows_applied),
+                            "apply_log": log,
+                        }))
+                    elif kind == tv.SHUTDOWN:
+                        ch.send(tv.encode(tv.OK, worker, None))
+                        return
+                    else:
+                        ch.send(tv.encode(tv.ERR, worker, None,
+                                          extra={"error": f"bad kind {kind}"}))
+                except Exception as e:  # surface server-side errors to worker
+                    ch.send(tv.encode(tv.ERR, worker, None,
+                                      extra={"error": repr(e)}))
+        finally:
+            ch.close()
+            try:
+                self._channels.remove(ch)
+            except ValueError:
+                pass  # stop() may already be iterating a snapshot
+
+    def stop(self) -> None:
+        """Drain exactly like ``AsyncPSService.stop``: no push lands after
+        this returns (the draining flag is checked under the apply lock)."""
+        self._stop.set()
+        with self._lock:
+            self._draining = True
+        for ch in list(self._channels):
+            ch.shutdown()
+        for t in list(self._conns):
+            t.join(timeout=5)
+        stragglers = [t for t in self._conns if t.is_alive()]
+        if stragglers:
+            import logging
+
+            logging.getLogger(__name__).warning(
+                "%d serve thread(s) outlived the drain join; their pushes "
+                "are refused by the draining flag", len(stragglers)
+            )
+        self._accept_thread.join(timeout=5)
+        self._listener.close()
+
+
+def serve_sparse(tables: Dict[str, Any], port: int = 0,
+                 bind: str = "127.0.0.1", shard: Optional[int] = None,
+                 num_shards: Optional[int] = None,
+                 total_rows: Optional[Dict[str, int]] = None
+                 ) -> "SparsePSService":
+    """Expose initialized sparse tables to remote worker processes.
+
+    Single-server: each table holds its full row space, no shard args.
+    Multi-server (the reference's range-sharded topology): server ``s`` of
+    ``N`` inits each table with ``hi - lo`` rows for
+    ``lo, hi = row_range(s, N, total)`` and passes
+    ``total_rows={name: total}``. Workers connect with
+    :func:`connect_sparse`."""
+    return SparsePSService(tables, port=port, bind=bind, shard=shard,
+                           num_shards=num_shards, total_rows=total_rows)
+
+
+def connect_sparse(uri: str, worker: int,
+                   tables: Dict[str, Tuple[int, int]]
+                   ) -> "RemoteSparseWorker":
+    """Join a cross-process sparse PS as worker ``worker``.
+
+    ``uri`` is ``host:port`` or a comma-separated list naming every server
+    of the row partition; ``tables`` is ``{name: (total_rows, dim)}`` — the
+    worker-side expectation validated against what the servers advertise
+    (coverage must be exact and disjoint)."""
+    addrs = []
+    for part in uri.split(","):
+        host, port = part.strip().rsplit(":", 1)
+        addrs.append((host, int(port)))
+    return RemoteSparseWorker(addrs, worker, tables)
+
+
+class RemoteSparseWorker:
+    """A worker NODE of the cross-process sparse PS.
+
+    Routes global row ids to owner servers by range, fans per-server
+    requests out concurrently (one round trip per server per cycle), and
+    reassembles pulled rows in id order. ``versions[name]`` sums the
+    per-server apply counters for the table."""
+
+    def __init__(self, addrs: Sequence[Tuple[str, int]], worker: int,
+                 tables: Dict[str, Tuple[int, int]]):
+        self.worker = worker
+        self._addrs = list(addrs)
+        self._spec = {n: (int(v), int(d)) for n, (v, d) in tables.items()}
+        n = len(self._addrs)
+        self._chs: List[tv.Channel] = []
+        # per table: sorted [(lo, hi, server_index)]
+        self._ranges: Dict[str, List[Tuple[int, int, int]]] = {
+            name: [] for name in self._spec
+        }
+        self._dtype: Dict[str, np.dtype] = {}
+        self._versions: Dict[str, List[int]] = {
+            name: [0] * n for name in self._spec
+        }
+        try:
+            self._connect_and_validate(worker)
+        except Exception:
+            for ch in self._chs:
+                ch.close()
+            raise
+        self._pool = None
+        if n > 1:
+            import concurrent.futures
+
+            self._pool = concurrent.futures.ThreadPoolExecutor(max_workers=n)
+
+    def _connect_and_validate(self, worker: int) -> None:
+        n = len(self._addrs)
+        for i, (host, port) in enumerate(self._addrs):
+            ch = tv.Channel.connect(host, port)
+            self._chs.append(ch)
+            _, _, _, extra = tv.decode(
+                ch.request(tv.encode(tv.HELLO, worker, None))
+            )
+            ns = extra.get("num_shards")
+            if ns is not None and int(ns) != n:
+                raise ValueError(
+                    f"server {i} ({host}:{port}) is shard {extra['shard']}/"
+                    f"{ns} but this worker dialed {n} server(s)"
+                )
+            meta = extra["tables"]
+            if sorted(meta) != sorted(self._spec):
+                raise ValueError(
+                    f"server {i} serves tables {sorted(meta)}, worker "
+                    f"expects {sorted(self._spec)}"
+                )
+            for name, m in meta.items():
+                total, dim = self._spec[name]
+                if int(m["total_rows"]) != total or int(m["dim"]) != dim:
+                    raise ValueError(
+                        f"table {name!r}: server {i} says "
+                        f"({m['total_rows']}, {m['dim']}), worker expects "
+                        f"({total}, {dim})"
+                    )
+                dt = np.dtype(m["dtype"])
+                if self._dtype.setdefault(name, dt) != dt:
+                    raise ValueError(f"table {name!r}: servers disagree "
+                                     f"on dtype")
+                self._ranges[name].append((int(m["lo"]), int(m["hi"]), i))
+        for name, ranges in self._ranges.items():
+            ranges.sort()
+            total = self._spec[name][0]
+            pos = 0
+            for lo, hi, i in ranges:
+                if lo != pos:
+                    raise ValueError(
+                        f"table {name!r}: rows [{pos}, {lo}) owned by no "
+                        f"server (partition has a hole)"
+                    )
+                if hi <= lo:
+                    continue
+                pos = hi
+            if pos != total:
+                raise ValueError(
+                    f"table {name!r}: rows [{pos}, {total}) owned by no "
+                    f"server"
+                )
+
+    def versions(self) -> Dict[str, int]:
+        """Per-table total applies across all servers."""
+        return {n: sum(v) for n, v in self._versions.items()}
+
+    # -- protocol -------------------------------------------------------------
+
+    def _request(self, i: int, payload: bytes):
+        try:
+            return self._chs[i].request(payload)
+        except tv.VanError as e:
+            host, port = self._addrs[i]
+            raise ServerFailureError(
+                f"sparse PS server {i} ({host}:{port}) failed mid-job: {e}"
+            ) from e
+
+    def _fanout(self, payloads: Dict[int, bytes]) -> Dict[int, memoryview]:
+        """One concurrent round (same wait-all discipline as the dense
+        worker: never abandon an in-flight request on a shared channel)."""
+        if self._pool is None or len(payloads) == 1:
+            return {i: self._request(i, p) for i, p in payloads.items()}
+        import concurrent.futures
+
+        futs = {i: self._pool.submit(self._request, i, p)
+                for i, p in payloads.items()}
+        concurrent.futures.wait(futs.values())
+        return {i: f.result() for i, f in futs.items()}
+
+    def _route(self, name: str, ids: np.ndarray
+               ) -> Dict[int, np.ndarray]:
+        """``{server: positions into ids}`` for the table's range split."""
+        ids = np.asarray(ids)
+        out: Dict[int, np.ndarray] = {}
+        for lo, hi, i in self._ranges[name]:
+            pos = np.nonzero((ids >= lo) & (ids < hi))[0]
+            if pos.size:
+                out[i] = pos
+        covered = sum(p.size for p in out.values())
+        if covered != ids.size:
+            bad = ids[(ids < 0) | (ids >= self._spec[name][0])]
+            raise IndexError(
+                f"table {name!r}: ids out of range, e.g. {bad[:3]}"
+            )
+        return out
+
+    def _check(self, i: int, msg: memoryview):
+        kind, _, tensors, extra = tv.decode(msg)
+        if kind != tv.OK:
+            raise RuntimeError(f"server {i} error: {extra.get('error')}")
+        for name, v in extra.get("versions", {}).items():
+            self._versions[name][i] = int(v)
+        return tensors
+
+    def pull(self, requests: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        """``{table: global ids [N]} -> {table: rows [N, dim]}`` — one
+        concurrent round over the owners, rows reassembled in id order."""
+        reqs, routes = self._build_pull(requests)
+        msgs = self._fanout({
+            i: tv.encode(tv.ROW_PULL, self.worker, t) for i, t in reqs.items()
+        })
+        return self._merge_rows(requests, routes, msgs)
+
+    def _build_pull(self, requests):
+        reqs: Dict[int, Dict[str, np.ndarray]] = {}
+        routes: Dict[str, Dict[int, np.ndarray]] = {}
+        for name, ids in requests.items():
+            ids = np.asarray(ids, np.int32).reshape(-1)
+            routes[name] = self._route(name, ids)
+            for i, pos in routes[name].items():
+                reqs.setdefault(i, {})[f"{name}/ids"] = ids[pos]
+        return reqs, routes
+
+    def _merge_rows(self, requests, routes, msgs) -> Dict[str, np.ndarray]:
+        tensors = {i: self._check(i, m) for i, m in msgs.items()}
+        out: Dict[str, np.ndarray] = {}
+        for name, per_server in routes.items():
+            n = int(np.asarray(requests[name]).reshape(-1).shape[0])
+            rows = np.zeros((n, self._spec[name][1]), self._dtype[name])
+            for i, pos in per_server.items():
+                rows[pos] = np.asarray(tensors[i][f"{name}/rows"])
+            out[name] = rows
+        return out
+
+    def push(self, pushes: Dict[str, Tuple[Any, Any]],
+             dedupe: bool = True) -> None:
+        """``{table: (global ids [N], row_grads [N, dim])}`` — owners
+        scatter-apply immediately (async semantics). ``dedupe`` merges
+        duplicate rows worker-side first (SURVEY.md §4c), shrinking the
+        wire payload; the server segment-sums either way."""
+        reqs: Dict[int, Dict[str, np.ndarray]] = {}
+        for name, (ids, grads) in pushes.items():
+            ids = np.asarray(ids, np.int32).reshape(-1)
+            grads = np.asarray(grads).reshape(ids.shape[0],
+                                             self._spec[name][1])
+            if dedupe:
+                ids, grads = dedupe_rows_np(ids, grads)
+            for i, pos in self._route(name, ids).items():
+                reqs.setdefault(i, {})[f"{name}/ids"] = ids[pos]
+                reqs[i][f"{name}/grads"] = grads[pos]
+        msgs = self._fanout({
+            i: tv.encode(tv.ROW_PUSH, self.worker, t)
+            for i, t in reqs.items()
+        })
+        for i, m in msgs.items():
+            self._check(i, m)
+
+    def push_pull(self, pushes: Dict[str, Tuple[Any, Any]],
+                  requests: Dict[str, Any],
+                  dedupe: bool = True) -> Dict[str, np.ndarray]:
+        """Push this cycle's row grads and pull the next cycle's rows in ONE
+        round trip per server (the sparse async cycle)."""
+        reqs: Dict[int, Dict[str, np.ndarray]] = {}
+        for name, (ids, grads) in pushes.items():
+            ids = np.asarray(ids, np.int32).reshape(-1)
+            grads = np.asarray(grads).reshape(ids.shape[0],
+                                             self._spec[name][1])
+            if dedupe:
+                ids, grads = dedupe_rows_np(ids, grads)
+            for i, pos in self._route(name, ids).items():
+                reqs.setdefault(i, {})[f"{name}/ids"] = ids[pos]
+                reqs[i][f"{name}/grads"] = grads[pos]
+        pull_reqs, routes = self._build_pull(requests)
+        for i, t in pull_reqs.items():
+            for name_ids, v in t.items():
+                name = name_ids.split("/")[0]
+                reqs.setdefault(i, {})[f"{name}/pull_ids"] = v
+        msgs = self._fanout({
+            i: tv.encode(tv.ROW_PUSH_PULL, self.worker, t)
+            for i, t in reqs.items()
+        })
+        return self._merge_rows(requests, routes, msgs)
+
+    def stats(self) -> dict:
+        msgs = self._fanout({
+            i: tv.encode(tv.STATS, self.worker, None)
+            for i in range(len(self._chs))
+        })
+        extras = {}
+        for i, m in msgs.items():
+            _, _, _, extra = tv.decode(m)
+            extras[i] = extra
+        if len(self._chs) == 1:
+            return extras[0]
+        return {"servers": [extras.get(i) for i in range(len(self._chs))],
+                "versions": self.versions()}
+
+    def close(self) -> None:
+        for ch in self._chs:
+            try:
+                ch.request(tv.encode(tv.SHUTDOWN, self.worker, None))
+            except tv.VanError:
+                pass
+            ch.close()
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

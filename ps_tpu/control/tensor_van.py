@@ -38,6 +38,11 @@ STATS = 4       # -> json: version, staleness_hist, apply_log
 SHUTDOWN = 5    # server drains and stops serving this connection
 OK = 6
 ERR = 7
+# sparse-table kinds (SURVEY.md §4c over §4d: workers exchange
+# (row_ids, row_grads) with the servers owning those row ranges)
+ROW_PULL = 8       # {"<table>/ids"} -> {"<table>/rows"} + versions
+ROW_PUSH = 9       # {"<table>/ids", "<table>/grads"} -> ack + versions
+ROW_PUSH_PULL = 10  # push + pull in one round trip per server
 
 _HDR = struct.Struct("<BIQ")  # kind, worker_id, meta_len
 

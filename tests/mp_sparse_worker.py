@@ -1,0 +1,176 @@
+"""Subprocess entries for the cross-process sparse PS test
+(tests/test_remote_sparse.py) — SURVEY.md §4c over §4d: workers exchange
+(row_ids, row_grads) with the servers owning those row ranges, as real OS
+processes over the van.
+
+Roles (argv[1]):
+  server <port> <out_dir> <nworkers> <cycles> [<shard> <nshards>]
+      owns the row range of BOTH Wide&Deep-shaped tables ("deep" [V,8],
+      "wide" [V,1]) and serves it; waits until every deterministic push that
+      routes to this range arrived, then dumps the exact table bytes, the
+      apply log, and the per-table version counters.
+  worker <ports> <out_dir> <worker_id> <cycles>
+      routes deterministic (ids, grads) pushes to the owners; alternates
+      pull+push with the fused push_pull so all three row kinds are
+      exercised. Jitter interleaves the workers' pushes across processes.
+
+The parity contract: replaying each server's apply log through an
+in-process SparseEmbedding of the same local size (same deterministic
+payloads, same dedupe + range split) reproduces the table bit-for-bit.
+"""
+
+import json
+import os
+import sys
+import time
+
+import numpy as np
+
+# the two Wide&Deep-shaped tables: name -> (global rows, dim, rng seed)
+TABLES = {"deep": (96, 8, 11), "wide": (96, 1, 13)}
+IDS_PER_CYCLE = 24
+
+
+def table_spec():
+    """The worker-side {name: (total_rows, dim)} expectation."""
+    return {n: (v, d) for n, (v, d, _) in TABLES.items()}
+
+
+def make_table(name: str) -> np.ndarray:
+    """The full deterministic global table (servers slice their range)."""
+    v, d, seed = TABLES[name]
+    rng = np.random.default_rng(seed)
+    return rng.normal(0, 0.01, (v, d)).astype(np.float32)
+
+
+def make_push(worker: int, cycle: int, name: str):
+    """Deterministic (global ids, row grads) for one worker cycle — the
+    replay in the parent regenerates the same values."""
+    v, d, seed = TABLES[name]
+    rng = np.random.default_rng([worker, cycle, seed])
+    ids = rng.integers(0, v, IDS_PER_CYCLE).astype(np.int32)
+    grads = rng.normal(0, 0.1, (IDS_PER_CYCLE, d)).astype(np.float32)
+    return ids, grads
+
+
+def routed_pushes(worker: int, shard: int, nshards: int, cycles: int):
+    """The LOCAL (ids, grads) per table that ``worker``'s cycles route to
+    ``shard`` — exactly the worker's wire payloads (dedupe then range
+    split, order preserved). Yields one dict per push message; cycles whose
+    ids all miss the range send no message and are skipped, mirroring the
+    worker's routing."""
+    from ps_tpu.backends.remote_sparse import dedupe_rows_np, row_range
+
+    for c in range(cycles):
+        per = {}
+        for name, (v, d, _) in TABLES.items():
+            lo, hi = row_range(shard, nshards, v)
+            ids, grads = make_push(worker, c, name)
+            ids, grads = dedupe_rows_np(ids, grads)
+            keep = (ids >= lo) & (ids < hi)
+            if keep.any():
+                per[name] = (ids[keep] - lo, grads[keep])
+        if per:
+            yield per
+
+
+def expected_pushes(shard: int, nshards: int, nworkers: int,
+                    cycles: int) -> int:
+    """How many push messages land on this server (deterministic)."""
+    return sum(
+        len(list(routed_pushes(w, shard, nshards, cycles)))
+        for w in range(nworkers)
+    )
+
+
+def _make_local_tables(shard, nshards, mesh=None):
+    from ps_tpu.backends.remote_sparse import row_range
+    from ps_tpu.kv.sparse import SparseEmbedding
+
+    tables = {}
+    for name, (v, d, _) in TABLES.items():
+        lo, hi = row_range(shard, nshards, v)
+        emb = SparseEmbedding(hi - lo, d, optimizer="adagrad",
+                              learning_rate=0.1, mesh=mesh)
+        emb.init(make_table(name)[lo:hi])
+        tables[name] = emb
+    return tables
+
+
+def run_server(port: int, out_dir: str, nworkers: int, cycles: int,
+               shard: int, nshards: int) -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import ps_tpu as ps
+    from ps_tpu.backends.remote_sparse import SparsePSService
+
+    ps.init(backend="tpu")
+    tables = _make_local_tables(shard, nshards)
+    svc = SparsePSService(
+        tables, port=port, bind="127.0.0.1", shard=shard, num_shards=nshards,
+        total_rows={n: v for n, (v, _, _) in TABLES.items()},
+    )
+    target = expected_pushes(shard, nshards, nworkers, cycles)
+    deadline = time.monotonic() + 120
+    while len(svc.apply_log) < target:
+        if time.monotonic() > deadline:
+            raise TimeoutError(
+                f"only {len(svc.apply_log)}/{target} pushes arrived"
+            )
+        time.sleep(0.02)
+    np.savez(os.path.join(out_dir, f"sparse_tables{shard}.npz"),
+             **{n: np.asarray(t.table) for n, t in tables.items()})
+    with open(os.path.join(out_dir, f"sparse_server{shard}.json"), "w") as f:
+        json.dump({
+            "apply_log": svc.apply_log,
+            "versions": svc.versions,
+            "rows_applied": svc.rows_applied,
+            "meta": svc._meta,
+        }, f)
+    svc.stop()
+    ps.shutdown()
+    return 0
+
+
+def run_worker(ports: str, out_dir: str, worker: int, cycles: int) -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from ps_tpu.backends.remote_sparse import connect_sparse
+
+    uri = ",".join(f"127.0.0.1:{p}" for p in ports.split(","))
+    w = connect_sparse(uri, worker, table_spec())
+    for c in range(cycles):
+        time.sleep(0.003 * ((worker * 7 + c * 3) % 5))
+        pushes = {n: make_push(worker, c, n) for n in TABLES}
+        ids = {n: pushes[n][0] for n in TABLES}
+        if c % 2 == 0:
+            rows = w.pull(ids)
+            w.push(pushes)
+        else:  # fused cycle: push + pull in one round trip per server
+            rows = w.push_pull(pushes, ids)
+        for n, (v, d, _) in TABLES.items():
+            assert rows[n].shape == (IDS_PER_CYCLE, d), rows[n].shape
+            assert np.isfinite(rows[n]).all()
+    with open(os.path.join(out_dir, f"sparse_worker{worker}.json"), "w") as f:
+        json.dump({"worker": worker, "versions": w.versions()}, f)
+    w.close()
+    return 0
+
+
+def main() -> int:
+    role = sys.argv[1]
+    out_dir = sys.argv[3]
+    a, b = int(sys.argv[4]), int(sys.argv[5])
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if role == "server":
+        return run_server(int(sys.argv[2]), out_dir, a, b,
+                          int(sys.argv[6]), int(sys.argv[7]))
+    return run_worker(sys.argv[2], out_dir, a, b)
+
+
+if __name__ == "__main__":
+    sys.exit(main())
