@@ -30,12 +30,12 @@ about the math.
 
 from __future__ import annotations
 
-import json
 import threading
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ps_tpu.backends.van_service import VanService
 from ps_tpu.control import tensor_van as tv
 from ps_tpu.kv import keys as keymod
 
@@ -58,8 +58,12 @@ def shard_tree(params_like, shard: int, num_shards: int) -> Dict[str, Any]:
             if keymod.shard_for_key(k, num_shards) == shard}
 
 
-class AsyncPSService:
+class AsyncPSService(VanService):
     """Serve an async KVStore to remote workers over the tensor van.
+
+    Accept/serve/drain machinery (and the stop() guarantees) live in
+    :class:`~ps_tpu.backends.van_service.VanService`; this class is the
+    protocol: HELLO/PULL/PUSH/PUSH_PULL/STATS over the async engine.
 
     Args:
       store: an initialized async-mode KVStore (the server engine).
@@ -95,45 +99,29 @@ class AsyncPSService:
                     f"{num_shards}: {misplaced[:3]} — init the server's "
                     f"store with shard_tree(params, shard, num_shards)"
                 )
-        self._listener = tv.Listener(port=port, bind=bind)
-        self._stop = threading.Event()
-        # set under the engine lock by stop(); checked under the same lock by
-        # the push path, so "no push is applied after stop() returns" holds
-        # even if a serve thread outlives the join (e.g. blocked in a jit
-        # compile inside the engine apply)
+        # set under the engine lock by _set_draining(); checked under the
+        # same lock by the push path, so "no push is applied after stop()
+        # returns" holds even if a serve thread outlives the join (e.g.
+        # blocked in a jit compile inside the engine apply)
         self._draining = False
-        self._conns: List[threading.Thread] = []
-        self._channels: List[tv.Channel] = []  # live conns, for stop()
         self._log_lock = threading.Lock()
         self.apply_log: List[int] = []  # worker id per committed tree, in order
         # full ordered (op, worker) history — "pull" records matter because
         # the DC apply depends on WHAT each worker last pulled; replaying
         # this log through a threaded engine reproduces params bit-for-bit
         self.event_log: List[List] = []
-        self._accept_thread = threading.Thread(
-            target=self._accept_loop, daemon=True
-        )
-        self._accept_thread.start()
-
-    @property
-    def port(self) -> int:
-        return self._listener.port
+        super().__init__(port=port, bind=bind)  # starts accepting: state ready
 
     # -- server internals -----------------------------------------------------
 
-    def _accept_loop(self) -> None:
-        while not self._stop.is_set():
-            ch = self._listener.accept(timeout_ms=200)
-            if ch is None:
-                continue
-            self._channels.append(ch)
-            t = threading.Thread(target=self._serve, args=(ch,), daemon=True)
-            t.start()
-            self._conns.append(t)
-
     def _params_payload(self, worker: int) -> bytes:
         # engine lock makes snapshot+version+log-append atomic (torn-read
-        # hazard, and the event log must mirror true engine order)
+        # hazard, and the event log must mirror true engine order). Only the
+        # REFERENCE snapshot happens under the lock: jax arrays are
+        # immutable and the engine replaces (never mutates) them on apply,
+        # so the host conversion + frame encode — the expensive part at
+        # BERT-size trees, measured in tools/bench_van.py — runs outside,
+        # letting other workers' applies/pulls proceed concurrently.
         with self._engine._lock:
             kv = self._engine.pull_tree(worker=worker)
             version = self._engine.version
@@ -156,94 +144,46 @@ class AsyncPSService:
                 self.apply_log.append(worker)
                 self.event_log.append(["push", worker])
 
-    def _serve(self, ch: tv.Channel) -> None:
-        try:
-            while not self._stop.is_set():
-                try:
-                    msg = ch.recv()
-                except tv.VanError:
-                    return  # worker hung up
-                kind, worker, tensors, extra = tv.decode(msg)
-                try:
-                    if kind == tv.HELLO:
-                        ch.send(tv.encode(tv.OK, worker, None, extra={
-                            "keys": self._key_order,
-                            "version": self._engine.version,
-                            "num_workers": self._engine.num_workers,
-                            "shard": self.shard,
-                            "num_shards": self.num_shards,
-                        }))
-                    elif kind == tv.PULL:
-                        ch.send(self._params_payload(worker))
-                    elif kind == tv.PUSH:
-                        self._apply_push(worker, tensors)
-                        ch.send(tv.encode(tv.OK, worker, None, extra={
-                            "version": self._engine.version,
-                        }))
-                    elif kind == tv.PUSH_PULL:
-                        self._apply_push(worker, tensors)
-                        ch.send(self._params_payload(worker))
-                    elif kind == tv.STATS:
-                        with self._log_lock:
-                            log = list(self.apply_log)
-                        ch.send(tv.encode(tv.OK, worker, None, extra={
-                            "version": self._engine.version,
-                            "staleness_hist": {
-                                str(t): n for t, n in
-                                self._engine.staleness_hist.items()
-                            },
-                            "apply_log": log,
-                            "worker_version": {
-                                str(w): v for w, v in
-                                self._engine._worker_version.items()
-                            },
-                        }))
-                    elif kind == tv.SHUTDOWN:
-                        ch.send(tv.encode(tv.OK, worker, None))
-                        return
-                    else:
-                        ch.send(tv.encode(tv.ERR, worker, None,
-                                          extra={"error": f"bad kind {kind}"}))
-                except Exception as e:  # surface server-side errors to worker
-                    ch.send(tv.encode(tv.ERR, worker, None,
-                                      extra={"error": repr(e)}))
-        finally:
-            ch.close()
-            try:
-                self._channels.remove(ch)
-            except ValueError:
-                pass  # stop() may already be iterating a snapshot
+    def _handle(self, kind: int, worker: int, tensors, extra) -> bytes:
+        if kind == tv.HELLO:
+            return tv.encode(tv.OK, worker, None, extra={
+                "keys": self._key_order,
+                "version": self._engine.version,
+                "num_workers": self._engine.num_workers,
+                "shard": self.shard,
+                "num_shards": self.num_shards,
+            })
+        elif kind == tv.PULL:
+            return self._params_payload(worker)
+        elif kind == tv.PUSH:
+            self._apply_push(worker, tensors)
+            return tv.encode(tv.OK, worker, None, extra={
+                "version": self._engine.version,
+            })
+        elif kind == tv.PUSH_PULL:
+            self._apply_push(worker, tensors)
+            return self._params_payload(worker)
+        elif kind == tv.STATS:
+            with self._log_lock:
+                log = list(self.apply_log)
+            return tv.encode(tv.OK, worker, None, extra={
+                "version": self._engine.version,
+                "staleness_hist": {
+                    str(t): n for t, n in
+                    self._engine.staleness_hist.items()
+                },
+                "apply_log": log,
+                "worker_version": {
+                    str(w): v for w, v in
+                    self._engine._worker_version.items()
+                },
+            })
+        return tv.encode(tv.ERR, worker, None,
+                         extra={"error": f"bad kind {kind}"})
 
-    def stop(self) -> None:
-        """Drain: no new connections, sever live ones (serve threads blocked
-        in recv wake with EOF and exit — no push is applied after this
-        returns), then free the listener.
-
-        The guarantee has two legs: acquiring the engine lock below waits
-        out any apply already in flight, and ``_draining`` (checked under
-        that same lock) refuses every later commit — so even a serve thread
-        that survives the bounded join (e.g. stuck in a minutes-long jit
-        compile) can never land a push after this method returns."""
-        self._stop.set()
+    def _set_draining(self) -> None:
         with self._engine._lock:
             self._draining = True
-        for ch in list(self._channels):
-            ch.shutdown()  # non-freeing sever; each serve thread closes own
-        for t in list(self._conns):
-            t.join(timeout=5)
-        stragglers = [t for t in self._conns if t.is_alive()]
-        if stragglers:
-            import logging
-
-            logging.getLogger(__name__).warning(
-                "%d serve thread(s) outlived the drain join; their pushes "
-                "are refused by the draining flag", len(stragglers)
-            )
-        # join BEFORE closing: the accept thread may be inside tv_accept on
-        # the listener handle (its 200ms timeout bounds the wait); closing
-        # first would hand it a freed pointer
-        self._accept_thread.join(timeout=5)
-        self._listener.close()
 
 
 def serve_async(store, port: int = 0, bind: str = "127.0.0.1",
@@ -315,6 +255,14 @@ class RemoteAsyncWorker:
         self._owner: Dict[str, int] = {}  # key -> index into addrs
         self.versions: List[int] = [0] * n
         self.num_workers: Optional[int] = None
+        # REAL wire bytes (request payloads out / reply frames in) — the one
+        # deployment where "push/pull GB/s" is physical bytes on a socket,
+        # not collective algebra. Same counter surface as KVStore so
+        # TrainMetrics reports it unchanged (VERDICT r4 item 6).
+        self.bytes_pushed = 0   # request bytes sent (grads + protocol)
+        self.bytes_pulled = 0   # reply bytes received (params + protocol)
+        self.collective_bytes = 0  # no ICI on the van path, by definition
+        self._bytes_lock = threading.Lock()  # _fanout drives _request concurrently
         try:
             self._connect_and_validate(addrs, worker, kv)
         except Exception:
@@ -404,12 +352,16 @@ class RemoteAsyncWorker:
 
     def _request(self, i: int, payload: bytes):
         try:
-            return self._chs[i].request(payload)
+            reply = self._chs[i].request(payload)
         except tv.VanError as e:
             host, port = self._addrs[i]
             raise ServerFailureError(
                 f"async PS server {i} ({host}:{port}) failed mid-job: {e}"
             ) from e
+        with self._bytes_lock:
+            self.bytes_pushed += len(payload)
+            self.bytes_pulled += len(reply)
+        return reply
 
     def _fanout(self, payloads: Dict[int, bytes]) -> Dict[int, memoryview]:
         """One concurrent round: each server its request, all in flight

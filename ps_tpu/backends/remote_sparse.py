@@ -38,13 +38,15 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ps_tpu.backends.remote_async import ServerFailureError
+from ps_tpu.backends.van_service import VanService
 from ps_tpu.control import tensor_van as tv
 
 
 def row_range(shard: int, num_shards: int, total_rows: int) -> Tuple[int, int]:
     """The contiguous global row range ``[lo, hi)`` server ``shard`` of
     ``num_shards`` owns in a ``total_rows``-row table (even ceil split; the
-    last shard takes the remainder — the reference's range partition)."""
+    last shard takes whatever remains — possibly fewer rows than the
+    others, or none — the reference's range partition)."""
     if not (0 <= shard < num_shards):
         raise ValueError(f"shard {shard} out of range for {num_shards}")
     per = math.ceil(total_rows / num_shards)
@@ -66,8 +68,12 @@ def dedupe_rows_np(ids: np.ndarray, grads: np.ndarray
     return uniq.astype(ids.dtype), summed.astype(grads.dtype)
 
 
-class SparsePSService:
+class SparsePSService(VanService):
     """Serve named :class:`SparseEmbedding` tables to remote workers.
+
+    Accept/serve/drain machinery (and the stop() guarantees) live in
+    :class:`~ps_tpu.backends.van_service.VanService`; this class is the
+    protocol: HELLO/ROW_PULL/ROW_PUSH/ROW_PUSH_PULL/STATS over the tables.
 
     Args:
       tables: ``{name: initialized SparseEmbedding}`` — in sharded mode each
@@ -124,30 +130,9 @@ class SparsePSService:
         self.rows_applied: Dict[str, int] = {n: 0 for n in self._tables}
         self._log_lock = threading.Lock()
         self.apply_log: List[int] = []  # worker id per applied push message
-        self._listener = tv.Listener(port=port, bind=bind)
-        self._stop = threading.Event()
-        self._conns: List[threading.Thread] = []
-        self._channels: List[tv.Channel] = []
-        self._accept_thread = threading.Thread(
-            target=self._accept_loop, daemon=True
-        )
-        self._accept_thread.start()
-
-    @property
-    def port(self) -> int:
-        return self._listener.port
+        super().__init__(port=port, bind=bind)  # starts accepting: state ready
 
     # -- server internals -----------------------------------------------------
-
-    def _accept_loop(self) -> None:
-        while not self._stop.is_set():
-            ch = self._listener.accept(timeout_ms=200)
-            if ch is None:
-                continue
-            self._channels.append(ch)
-            t = threading.Thread(target=self._serve, args=(ch,), daemon=True)
-            t.start()
-            self._conns.append(t)
 
     def _hello_extra(self) -> dict:
         return {
@@ -210,77 +195,37 @@ class SparsePSService:
             versions = dict(self.versions)
         return tv.encode(tv.OK, worker, out, extra={"versions": versions})
 
-    def _serve(self, ch: tv.Channel) -> None:
-        try:
-            while not self._stop.is_set():
-                try:
-                    msg = ch.recv()
-                except tv.VanError:
-                    return  # worker hung up
-                kind, worker, tensors, extra = tv.decode(msg)
-                try:
-                    if kind == tv.HELLO:
-                        ch.send(tv.encode(tv.OK, worker, None,
-                                          extra=self._hello_extra()))
-                    elif kind == tv.ROW_PULL:
-                        ch.send(self._rows_payload(worker,
-                                                   self._split(tensors)))
-                    elif kind == tv.ROW_PUSH:
-                        self._apply_push(worker, self._split(tensors))
-                        ch.send(tv.encode(tv.OK, worker, None, extra={
-                            "versions": dict(self.versions),
-                        }))
-                    elif kind == tv.ROW_PUSH_PULL:
-                        per = self._split(tensors)
-                        push = {n: t for n, t in per.items() if "grads" in t}
-                        pull = {n: {"ids": t["pull_ids"]}
-                                for n, t in per.items() if "pull_ids" in t}
-                        self._apply_push(worker, push)
-                        ch.send(self._rows_payload(worker, pull))
-                    elif kind == tv.STATS:
-                        with self._log_lock:
-                            log = list(self.apply_log)
-                        ch.send(tv.encode(tv.OK, worker, None, extra={
-                            "versions": dict(self.versions),
-                            "rows_applied": dict(self.rows_applied),
-                            "apply_log": log,
-                        }))
-                    elif kind == tv.SHUTDOWN:
-                        ch.send(tv.encode(tv.OK, worker, None))
-                        return
-                    else:
-                        ch.send(tv.encode(tv.ERR, worker, None,
-                                          extra={"error": f"bad kind {kind}"}))
-                except Exception as e:  # surface server-side errors to worker
-                    ch.send(tv.encode(tv.ERR, worker, None,
-                                      extra={"error": repr(e)}))
-        finally:
-            ch.close()
-            try:
-                self._channels.remove(ch)
-            except ValueError:
-                pass  # stop() may already be iterating a snapshot
+    def _handle(self, kind: int, worker: int, tensors, extra) -> bytes:
+        if kind == tv.HELLO:
+            return tv.encode(tv.OK, worker, None, extra=self._hello_extra())
+        elif kind == tv.ROW_PULL:
+            return self._rows_payload(worker, self._split(tensors))
+        elif kind == tv.ROW_PUSH:
+            self._apply_push(worker, self._split(tensors))
+            return tv.encode(tv.OK, worker, None, extra={
+                "versions": dict(self.versions),
+            })
+        elif kind == tv.ROW_PUSH_PULL:
+            per = self._split(tensors)
+            push = {n: t for n, t in per.items() if "grads" in t}
+            pull = {n: {"ids": t["pull_ids"]}
+                    for n, t in per.items() if "pull_ids" in t}
+            self._apply_push(worker, push)
+            return self._rows_payload(worker, pull)
+        elif kind == tv.STATS:
+            with self._log_lock:
+                log = list(self.apply_log)
+            return tv.encode(tv.OK, worker, None, extra={
+                "versions": dict(self.versions),
+                "rows_applied": dict(self.rows_applied),
+                "apply_log": log,
+            })
+        return tv.encode(tv.ERR, worker, None,
+                         extra={"error": f"bad kind {kind}"})
 
-    def stop(self) -> None:
-        """Drain exactly like ``AsyncPSService.stop``: no push lands after
-        this returns (the draining flag is checked under the apply lock)."""
-        self._stop.set()
+    def _set_draining(self) -> None:
         with self._lock:
             self._draining = True
-        for ch in list(self._channels):
-            ch.shutdown()
-        for t in list(self._conns):
-            t.join(timeout=5)
-        stragglers = [t for t in self._conns if t.is_alive()]
-        if stragglers:
-            import logging
-
-            logging.getLogger(__name__).warning(
-                "%d serve thread(s) outlived the drain join; their pushes "
-                "are refused by the draining flag", len(stragglers)
-            )
-        self._accept_thread.join(timeout=5)
-        self._listener.close()
 
 
 def serve_sparse(tables: Dict[str, Any], port: int = 0,
@@ -339,6 +284,12 @@ class RemoteSparseWorker:
         self._versions: Dict[str, List[int]] = {
             name: [0] * n for name in self._spec
         }
+        # REAL wire bytes, same counter surface as KVStore / the dense
+        # remote worker so TrainMetrics reports GB/s unchanged
+        self.bytes_pushed = 0
+        self.bytes_pulled = 0
+        self.collective_bytes = 0
+        self._bytes_lock = threading.Lock()
         try:
             self._connect_and_validate(worker)
         except Exception:
@@ -387,16 +338,24 @@ class RemoteSparseWorker:
         for name, ranges in self._ranges.items():
             ranges.sort()
             total = self._spec[name][0]
-            pos = 0
+            pos, prev = 0, None
             for lo, hi, i in ranges:
+                if hi <= lo:
+                    continue
+                if lo < pos:
+                    # overlap (e.g. two unsharded servers, or the same
+                    # server dialed twice) — distinct from a hole
+                    raise ValueError(
+                        f"table {name!r}: rows [{lo}, {min(hi, pos)}) "
+                        f"claimed by both server {prev} and server {i} "
+                        f"(overlapping partition)"
+                    )
                 if lo != pos:
                     raise ValueError(
                         f"table {name!r}: rows [{pos}, {lo}) owned by no "
                         f"server (partition has a hole)"
                     )
-                if hi <= lo:
-                    continue
-                pos = hi
+                pos, prev = hi, i
             if pos != total:
                 raise ValueError(
                     f"table {name!r}: rows [{pos}, {total}) owned by no "
@@ -411,12 +370,16 @@ class RemoteSparseWorker:
 
     def _request(self, i: int, payload: bytes):
         try:
-            return self._chs[i].request(payload)
+            reply = self._chs[i].request(payload)
         except tv.VanError as e:
             host, port = self._addrs[i]
             raise ServerFailureError(
                 f"sparse PS server {i} ({host}:{port}) failed mid-job: {e}"
             ) from e
+        with self._bytes_lock:
+            self.bytes_pushed += len(payload)
+            self.bytes_pulled += len(reply)
+        return reply
 
     def _fanout(self, payloads: Dict[int, bytes]) -> Dict[int, memoryview]:
         """One concurrent round (same wait-all discipline as the dense
@@ -485,12 +448,12 @@ class RemoteSparseWorker:
             out[name] = rows
         return out
 
-    def push(self, pushes: Dict[str, Tuple[Any, Any]],
-             dedupe: bool = True) -> None:
-        """``{table: (global ids [N], row_grads [N, dim])}`` — owners
-        scatter-apply immediately (async semantics). ``dedupe`` merges
-        duplicate rows worker-side first (SURVEY.md §4c), shrinking the
-        wire payload; the server segment-sums either way."""
+    def _build_push(self, pushes: Dict[str, Tuple[Any, Any]], dedupe: bool
+                    ) -> Dict[int, Dict[str, np.ndarray]]:
+        """Per-server ``{"<table>/ids", "<table>/grads"}`` payloads: dedupe
+        (optional worker-side merge of duplicate rows, SURVEY.md §4c — the
+        server segment-sums either way) then range-route to owners. The one
+        assembly both :meth:`push` and :meth:`push_pull` ride."""
         reqs: Dict[int, Dict[str, np.ndarray]] = {}
         for name, (ids, grads) in pushes.items():
             ids = np.asarray(ids, np.int32).reshape(-1)
@@ -501,9 +464,16 @@ class RemoteSparseWorker:
             for i, pos in self._route(name, ids).items():
                 reqs.setdefault(i, {})[f"{name}/ids"] = ids[pos]
                 reqs[i][f"{name}/grads"] = grads[pos]
+        return reqs
+
+    def push(self, pushes: Dict[str, Tuple[Any, Any]],
+             dedupe: bool = True) -> None:
+        """``{table: (global ids [N], row_grads [N, dim])}`` — owners
+        scatter-apply immediately (async semantics). ``dedupe`` merges
+        duplicate rows worker-side first, shrinking the wire payload."""
         msgs = self._fanout({
             i: tv.encode(tv.ROW_PUSH, self.worker, t)
-            for i, t in reqs.items()
+            for i, t in self._build_push(pushes, dedupe).items()
         })
         for i, m in msgs.items():
             self._check(i, m)
@@ -513,16 +483,7 @@ class RemoteSparseWorker:
                   dedupe: bool = True) -> Dict[str, np.ndarray]:
         """Push this cycle's row grads and pull the next cycle's rows in ONE
         round trip per server (the sparse async cycle)."""
-        reqs: Dict[int, Dict[str, np.ndarray]] = {}
-        for name, (ids, grads) in pushes.items():
-            ids = np.asarray(ids, np.int32).reshape(-1)
-            grads = np.asarray(grads).reshape(ids.shape[0],
-                                             self._spec[name][1])
-            if dedupe:
-                ids, grads = dedupe_rows_np(ids, grads)
-            for i, pos in self._route(name, ids).items():
-                reqs.setdefault(i, {})[f"{name}/ids"] = ids[pos]
-                reqs[i][f"{name}/grads"] = grads[pos]
+        reqs = self._build_push(pushes, dedupe)
         pull_reqs, routes = self._build_pull(requests)
         for i, t in pull_reqs.items():
             for name_ids, v in t.items():

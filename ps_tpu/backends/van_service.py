@@ -1,0 +1,224 @@
+"""Shared serve/accept/drain machinery for van-backed PS services.
+
+Both cross-process services (dense-async :class:`AsyncPSService`, sparse
+:class:`SparsePSService`) are the same shape: a TCP listener, one serve
+thread per worker connection, a request→reply loop over framed tensor
+messages, and a stop that must never tear a reply off the wire. This base
+class owns that shape; subclasses provide only the protocol dispatch
+(:meth:`_handle`) and the commit gate (:meth:`_set_draining`).
+
+The drain contract (VERDICT r4 item 1 — the round-4 flake was ``stop()``
+severing a ``PUSH_PULL`` reply mid-send):
+
+1. ``stop()`` first stops admitting connections (accept thread joined,
+   listener closed), so the channel set is frozen;
+2. then waits (bounded by ``grace``) for every IN-FLIGHT request — one
+   whose frame has been received — to finish its reply send;
+3. only then flips the draining flag (refusing any straggler commit under
+   the subclass's apply lock) and severs the remaining channels, which at
+   that point are idle in ``recv``.
+
+A request whose processing has begun (its serve thread is past the
+in-flight mark) therefore completes: its push is applied and its reply
+arrives intact at the worker. A request still RACING ``stop()`` — sent
+concurrently, or whose frame arrived in the microseconds before the sever
+(TCP offers no atomic "refuse from now", so that window cannot be closed,
+only shrunk — the drain wait double-checks stability across a confirm
+delay) — may instead fail at the worker with a typed
+:class:`~ps_tpu.backends.remote_async.ServerFailureError`. Workers that
+need a clean end must quiesce first by sending ``SHUTDOWN``
+(``worker.close()`` does), which is counted in :attr:`goodbyes` so a
+server can :meth:`wait_for_goodbyes` before stopping; after the goodbye
+no request of that worker can race anything.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import List, Optional
+
+from ps_tpu.control import tensor_van as tv
+
+
+class VanService:
+    """One listener + per-connection serve threads over the tensor van.
+
+    Subclass obligations:
+      - call ``VanService.__init__(port, bind)`` LAST in your ``__init__``
+        (it starts accepting immediately — your state must be ready);
+      - implement ``_handle(kind, worker, tensors, extra) -> bytes``
+        returning the encoded reply (raise to send an ERR reply);
+      - implement ``_set_draining()``: under your apply lock, set the flag
+        your commit path checks so no push lands after ``stop()`` returns.
+    """
+
+    def __init__(self, port: int = 0, bind: str = "127.0.0.1"):
+        self._listener = tv.Listener(port=port, bind=bind)
+        self._stop = threading.Event()
+        self._chan_lock = threading.Lock()
+        self._conns: List[threading.Thread] = []
+        self._channels: List[tv.Channel] = []
+        # requests whose frame arrived but whose reply is not yet fully
+        # sent — what stop() waits out before severing anything
+        self._inflight = 0
+        self._inflight_cond = threading.Condition()
+        self.goodbyes = 0  # workers that sent SHUTDOWN (clean departures)
+        self._goodbye_cond = threading.Condition()
+        self._accept_thread = threading.Thread(
+            target=self._accept_loop, daemon=True
+        )
+        self._accept_thread.start()
+
+    @property
+    def port(self) -> int:
+        return self._listener.port
+
+    # -- to be provided by the concrete service -------------------------------
+
+    def _handle(self, kind: int, worker: int, tensors, extra) -> bytes:
+        raise NotImplementedError
+
+    def _set_draining(self) -> None:
+        raise NotImplementedError
+
+    # -- accept / serve --------------------------------------------------------
+
+    def _accept_loop(self) -> None:
+        while not self._stop.is_set():
+            ch = self._listener.accept(timeout_ms=200)
+            if ch is None:
+                continue
+            with self._chan_lock:
+                # prune finished serve threads so a long-lived server with
+                # many reconnects doesn't accumulate dead Thread objects
+                # (ident is None = appended but not yet started — keep: an
+                # un-started thread also reports is_alive() False)
+                self._conns = [t for t in self._conns
+                               if t.ident is None or t.is_alive()]
+                if self._stop.is_set():
+                    ch.close()  # raced stop(): admit nothing new
+                    return
+                self._channels.append(ch)
+                t = threading.Thread(
+                    target=self._serve, args=(ch,), daemon=True
+                )
+                self._conns.append(t)
+            t.start()
+
+    def _serve(self, ch: tv.Channel) -> None:
+        try:
+            while not self._stop.is_set():
+                try:
+                    msg = ch.recv()
+                except tv.VanError:
+                    return  # worker hung up (or stop() severed an idle conn)
+                with self._inflight_cond:
+                    self._inflight += 1
+                try:
+                    kind, worker, tensors, extra = tv.decode(msg)
+                    goodbye = kind == tv.SHUTDOWN
+                    if goodbye:
+                        reply = tv.encode(tv.OK, worker, None)
+                    else:
+                        try:
+                            reply = self._handle(kind, worker, tensors, extra)
+                        except Exception as e:  # surface to the worker
+                            reply = tv.encode(tv.ERR, worker, None,
+                                              extra={"error": repr(e)})
+                    try:
+                        ch.send(reply)
+                    except tv.VanError:
+                        return  # worker vanished mid-reply; nothing to tell it
+                finally:
+                    with self._inflight_cond:
+                        self._inflight -= 1
+                        self._inflight_cond.notify_all()
+                if goodbye:
+                    with self._goodbye_cond:
+                        self.goodbyes += 1
+                        self._goodbye_cond.notify_all()
+                    return
+        finally:
+            ch.close()
+            with self._chan_lock:
+                try:
+                    self._channels.remove(ch)
+                except ValueError:
+                    pass  # stop() snapshot may already hold it
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def wait_for_goodbyes(self, n: int, timeout: Optional[float] = None
+                          ) -> bool:
+        """Block until ``n`` workers have sent SHUTDOWN (clean departure).
+
+        The quiescence signal a server should wait on before ``stop()``:
+        a worker's ``close()`` sends SHUTDOWN only after every one of its
+        pushes has been applied AND replied, so ``goodbyes == num_workers``
+        implies no request is outstanding anywhere. Returns False on
+        timeout."""
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._goodbye_cond:
+            while self.goodbyes < n:
+                left = None if deadline is None \
+                    else deadline - time.monotonic()
+                if left is not None and left <= 0:
+                    return False
+                self._goodbye_cond.wait(left)
+        return True
+
+    def stop(self, grace: float = 10.0) -> None:
+        """Graceful drain, then sever. No push is applied after this
+        returns, and no reply in flight when it was called is torn.
+
+        The guarantee has two legs: the in-flight wait lets every received
+        request finish its reply (bounded by ``grace`` seconds), and the
+        subclass's draining flag — set under its apply lock — refuses every
+        later commit, so even a serve thread that outlives the bounded
+        join (e.g. stuck in a minutes-long jit compile) can never land a
+        push after this method returns."""
+        self._stop.set()
+        # join BEFORE closing: the accept thread may be inside tv_accept on
+        # the listener handle (its 200ms timeout bounds the wait); closing
+        # first would hand it a freed pointer
+        self._accept_thread.join(timeout=5)
+        self._listener.close()
+        deadline = time.monotonic() + grace
+        while True:
+            with self._inflight_cond:
+                while self._inflight > 0 and time.monotonic() < deadline:
+                    self._inflight_cond.wait(deadline - time.monotonic())
+                drained = self._inflight == 0
+            if not drained:
+                logging.getLogger(__name__).warning(
+                    "request(s) still in flight after %.1fs drain grace; "
+                    "severing anyway", grace
+                )
+                break
+            # stability confirm: a serve thread whose recv JUST returned a
+            # frame may not have reached its in-flight mark yet (the window
+            # between recv returning and the increment cannot be closed —
+            # TCP has no atomic refuse). Re-check after a beat; only a
+            # stable zero proceeds to the sever.
+            time.sleep(0.05)
+            with self._inflight_cond:
+                if self._inflight == 0:
+                    break
+            if time.monotonic() >= deadline:
+                break
+        self._set_draining()
+        with self._chan_lock:
+            chans = list(self._channels)
+            conns = list(self._conns)
+        for ch in chans:
+            ch.shutdown()  # non-freeing sever; each serve thread closes own
+        for t in conns:
+            t.join(timeout=5)
+        stragglers = [t for t in conns if t.is_alive()]
+        if stragglers:
+            logging.getLogger(__name__).warning(
+                "%d serve thread(s) outlived the drain join; their pushes "
+                "are refused by the draining flag", len(stragglers)
+            )

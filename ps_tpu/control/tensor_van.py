@@ -59,7 +59,9 @@ def _lib():
     lib.tv_connect.restype = ctypes.c_void_p
     lib.tv_connect.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]
     lib.tv_send.restype = ctypes.c_int
-    lib.tv_send.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint64]
+    # second arg is c_void_p (not c_char_p) so zero-copy bytearray frames
+    # from encode() can be handed over via from_buffer
+    lib.tv_send.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64]
     lib.tv_recv_size.restype = ctypes.c_int64
     lib.tv_recv_size.argtypes = [ctypes.c_void_p]
     lib.tv_recv_into.restype = ctypes.c_int
@@ -74,9 +76,14 @@ def _lib():
 
 
 def encode(kind: int, worker: int, tensors: Optional[Dict[str, np.ndarray]],
-           extra: Optional[dict] = None) -> bytes:
+           extra: Optional[dict] = None) -> bytearray:
     """One message: header + json meta (+ optional 'extra' json fields) +
-    concatenated raw buffers. Keys are encoded in sorted order."""
+    concatenated raw buffers. Keys are encoded in sorted order.
+
+    Exactly ONE copy of each tensor's bytes is made — straight into the
+    preallocated frame (no per-array ``tobytes`` temporaries, no join copy).
+    At BERT-size trees (~0.4 GB/frame) the removed copies were a measurable
+    slice of serve latency (tools/bench_van.py)."""
     names = sorted(tensors) if tensors else []
     arrays = [np.ascontiguousarray(np.asarray(tensors[n])) for n in names]
     meta = {
@@ -87,9 +94,16 @@ def encode(kind: int, worker: int, tensors: Optional[Dict[str, np.ndarray]],
         "extra": extra or {},
     }
     mj = json.dumps(meta).encode()
-    parts = [_HDR.pack(kind, worker, len(mj)), mj]
-    parts += [a.tobytes() for a in arrays]
-    return b"".join(parts)
+    buf = bytearray(_HDR.size + len(mj) + sum(a.nbytes for a in arrays))
+    _HDR.pack_into(buf, 0, kind, worker, len(mj))
+    off = _HDR.size
+    buf[off:off + len(mj)] = mj
+    off += len(mj)
+    for a in arrays:
+        n = a.nbytes
+        buf[off:off + n] = memoryview(a).cast("B")
+        off += n
+    return buf
 
 
 def decode(buf: memoryview) -> Tuple[int, int, Dict[str, np.ndarray], dict]:
@@ -169,9 +183,14 @@ class Channel:
                     self._lib.tv_close(self._h)
                     self._h = None
 
-    def send(self, payload: bytes) -> None:
+    def send(self, payload) -> None:
+        """Send one frame. ``payload`` is bytes or a bytearray (the
+        zero-extra-copy form :func:`encode` returns)."""
+        n = len(payload)
+        if isinstance(payload, bytearray):
+            payload = (ctypes.c_char * n).from_buffer(payload)
         with self._native() as h:
-            ok = self._lib.tv_send(h, payload, len(payload))
+            ok = self._lib.tv_send(h, payload, n)
         if not ok:
             self.close()  # half-sent frame: the stream is unusable
             raise VanError("send failed: peer closed")

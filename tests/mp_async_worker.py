@@ -70,14 +70,17 @@ def run_server(port: int, out_dir: str, nworkers: int, cycles: int,
     store.init(params)
     svc = AsyncPSService(store, port=port, bind="127.0.0.1",
                          shard=shard, num_shards=nshards)
+    # quiesce on worker SHUTDOWNs, not apply counts: a worker says goodbye
+    # only after its final push's reply arrived, so at goodbyes==nworkers
+    # nothing is in flight anywhere and stop() cannot race a reply
     target = nworkers * cycles
-    deadline = time.monotonic() + 120
-    while len(svc.apply_log) < target:
-        if time.monotonic() > deadline:
-            raise TimeoutError(
-                f"only {len(svc.apply_log)}/{target} pushes arrived"
-            )
-        time.sleep(0.02)
+    if not svc.wait_for_goodbyes(nworkers, timeout=120):
+        raise TimeoutError(
+            f"only {svc.goodbyes}/{nworkers} workers said goodbye "
+            f"({len(svc.apply_log)}/{target} pushes arrived)"
+        )
+    assert len(svc.apply_log) == target, \
+        f"{len(svc.apply_log)}/{target} pushes after all goodbyes"
     final = {k: np.asarray(v)
              for k, v in store._engine.pull_tree(worker=0).items()}
     np.savez(os.path.join(out_dir, f"server_params{suffix}.npz"), **final)

@@ -112,14 +112,17 @@ def run_server(port: int, out_dir: str, nworkers: int, cycles: int,
         tables, port=port, bind="127.0.0.1", shard=shard, num_shards=nshards,
         total_rows={n: v for n, (v, _, _) in TABLES.items()},
     )
+    # quiesce on worker SHUTDOWNs, not apply counts: a worker says goodbye
+    # only after its final push's reply arrived, so at goodbyes==nworkers
+    # nothing is in flight anywhere and stop() cannot race a reply
     target = expected_pushes(shard, nshards, nworkers, cycles)
-    deadline = time.monotonic() + 120
-    while len(svc.apply_log) < target:
-        if time.monotonic() > deadline:
-            raise TimeoutError(
-                f"only {len(svc.apply_log)}/{target} pushes arrived"
-            )
-        time.sleep(0.02)
+    if not svc.wait_for_goodbyes(nworkers, timeout=120):
+        raise TimeoutError(
+            f"only {svc.goodbyes}/{nworkers} workers said goodbye "
+            f"({len(svc.apply_log)}/{target} pushes arrived)"
+        )
+    assert len(svc.apply_log) == target, \
+        f"{len(svc.apply_log)}/{target} pushes after all goodbyes"
     np.savez(os.path.join(out_dir, f"sparse_tables{shard}.npz"),
              **{n: np.asarray(t.table) for n, t in tables.items()})
     with open(os.path.join(out_dir, f"sparse_server{shard}.json"), "w") as f:

@@ -113,6 +113,69 @@ def test_replay_through_threaded_engine_is_bit_identical(mp_run):
     ps.shutdown()
 
 
+def test_stop_drains_inflight_reply():
+    """Regression (the r4 flake): ``stop()`` used to sever every channel
+    immediately, tearing the reply of a PUSH_PULL whose apply was still in
+    flight — the worker died with 'recv failed mid-frame: peer closed'.
+    The drain contract (van_service.py): a request RECEIVED before stop()
+    completes — its push applies and its full reply reaches the worker,
+    even when stop() is called mid-apply."""
+    import threading
+    import time
+
+    import jax.numpy as jnp
+
+    from ps_tpu.backends.remote_async import AsyncPSService, RemoteAsyncWorker
+
+    params = {"w": jnp.zeros((256, 256))}
+    ps.init(backend="tpu", mode="async", num_workers=1)
+    store = ps.KVStore(optimizer="sgd", learning_rate=0.1, mode="async")
+    store.init(params)
+    svc = AsyncPSService(store, bind="127.0.0.1")
+    w = RemoteAsyncWorker("127.0.0.1", svc.port, 0, params)
+    w.pull_all()
+
+    eng = store._engine
+    orig_push = eng.push_tree
+    in_apply = threading.Event()
+    release = threading.Event()
+
+    def slow_push(grads, worker=0):
+        in_apply.set()  # request received, apply started …
+        release.wait(timeout=30)  # … and held open while stop() runs
+        return orig_push(grads, worker=worker)
+
+    eng.push_tree = slow_push
+    result = {}
+
+    def do_push_pull():
+        try:
+            result["params"] = w.push_pull({"w": jnp.ones((256, 256))})
+        except Exception as e:  # noqa: BLE001 — recorded for the assert
+            result["error"] = e
+
+    pusher = threading.Thread(target=do_push_pull)
+    pusher.start()
+    assert in_apply.wait(timeout=30)
+    stopper = threading.Thread(target=svc.stop)
+    stopper.start()
+    time.sleep(0.3)  # let stop() reach its in-flight drain wait
+    assert pusher.is_alive(), "reply path torn while the apply was in flight"
+    release.set()
+    pusher.join(timeout=30)
+    stopper.join(timeout=30)
+    assert not pusher.is_alive() and not stopper.is_alive()
+    assert "error" not in result, f"reply torn by stop(): {result.get('error')!r}"
+    # the racing push COMMITTED and the worker saw the post-apply params
+    assert eng.version == 1
+    np.testing.assert_array_equal(
+        np.asarray(result["params"]["w"]),
+        np.asarray(eng.pull_tree(worker=0)["w"]),
+    )
+    w.close()
+    ps.shutdown()
+
+
 def test_idle_client_survives_slow_cadence():
     """Regression (r3): the accepted fd inherited the listener's 200ms
     accept-poll SO_RCVTIMEO on Linux, so any client thinking for longer

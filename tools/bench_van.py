@@ -1,0 +1,117 @@
+"""Measure the van data plane at realistic tree sizes — VERDICT r4 item 6.
+
+Drives :func:`ps_tpu.backends.remote_async.serve_async` with a BERT-base-
+shaped parameter tree (~0.44 GB f32) over loopback TCP and reports wall
+time + GB/s for pulls and push_pull cycles, single- and multi-worker (the
+multi-worker concurrent pull is what the r4 lock-held serialization
+throttled: every worker's pull serialized behind every apply). Numbers go
+to BASELINE.md.
+
+Run:  python tools/bench_van.py [--mb 440] [--cycles 4]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def bert_like_tree(target_mb: float) -> dict:
+    """Flat {key: f32 array} tree shaped like BERT-base: one [30522,768]
+    embedding + uniform ~[768,768]x4-ish blocks until target_mb."""
+    tree = {"embed/word": np.zeros((30522, 768), np.float32)}
+    total = tree["embed/word"].nbytes
+    i = 0
+    while total < target_mb * 1e6:
+        a = np.zeros((768, 3072), np.float32)  # 9.4 MB, FFN-block-sized
+        tree[f"layer{i//4}/block{i%4}"] = a
+        total += a.nbytes
+        i += 1
+    return tree
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mb", type=float, default=440.0)
+    ap.add_argument("--cycles", type=int, default=4)
+    ap.add_argument("--workers", type=int, default=2)
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import ps_tpu as ps
+    from ps_tpu.backends.remote_async import connect_async, serve_async
+
+    params = bert_like_tree(args.mb)
+    nbytes = sum(a.nbytes for a in params.values())
+    print(f"tree: {len(params)} tensors, {nbytes/1e6:.0f} MB", file=sys.stderr)
+
+    ps.init(backend="tpu", mode="async", num_workers=args.workers)
+    store = ps.KVStore(optimizer="sgd", learning_rate=0.01, mode="async")
+    store.init(params)
+    svc = serve_async(store, bind="127.0.0.1")
+    uri = f"127.0.0.1:{svc.port}"
+
+    out = {"tree_mb": round(nbytes / 1e6, 1), "tensors": len(params)}
+
+    # single-worker pull latency/bandwidth
+    w0 = connect_async(uri, 0, params)
+    t0 = time.monotonic()
+    for _ in range(args.cycles):
+        w0.pull_all()
+    dt = time.monotonic() - t0
+    out["pull_s"] = round(dt / args.cycles, 3)
+    out["pull_gbps"] = round(w0.bytes_pulled / dt / 1e9, 3)
+
+    # single-worker push_pull (the async cycle: grads up, params down)
+    grads = {k: np.zeros_like(v) for k, v in params.items()}
+    b0 = w0.bytes_pushed + w0.bytes_pulled
+    t0 = time.monotonic()
+    for _ in range(args.cycles):
+        w0.push_pull(grads)
+    dt = time.monotonic() - t0
+    moved = w0.bytes_pushed + w0.bytes_pulled - b0
+    out["push_pull_s"] = round(dt / args.cycles, 3)
+    out["push_pull_gbps"] = round(moved / dt / 1e9, 3)
+
+    # N workers pulling CONCURRENTLY — the lock-held-serialization probe:
+    # before the r5 fix every pull serialized behind the engine lock, so
+    # aggregate GB/s could not exceed single-worker GB/s.
+    ws = [w0] + [connect_async(uri, w, params)
+                 for w in range(1, args.workers)]
+    for w in ws:
+        w.bytes_pulled = 0
+    t0 = time.monotonic()
+
+    def pull_loop(w):
+        for _ in range(args.cycles):
+            w.pull_all()
+
+    ts = [threading.Thread(target=pull_loop, args=(w,)) for w in ws]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    dt = time.monotonic() - t0
+    total = sum(w.bytes_pulled for w in ws)
+    out[f"concurrent_pull_{args.workers}w_gbps"] = round(total / dt / 1e9, 3)
+
+    for w in ws:
+        w.close()
+    svc.stop()
+    ps.shutdown()
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
