@@ -454,8 +454,11 @@ def _coordination_client_options():
     the client handle is barrier-free, which is what ``shutdown(abort=True)``
     relies on. Wraps a private jax seam; if the seam moves or the factory
     stops accepting the kwargs, initialization falls back to jax's defaults
-    with a warning (tests/test_failure.py pins the seam so the degradation
-    is a loud CI signal, not only a runtime warning)."""
+    with a warning — and
+    ``tests/test_failure.py::test_coordination_seam_accepts_recoverable_kwargs``
+    / ``::test_coordination_client_options_inject_without_degrading``
+    construct a client through this exact path so the degradation is a loud
+    CI failure, not only a runtime warning."""
     try:
         from jax._src import distributed as _dist
 

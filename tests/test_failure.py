@@ -173,6 +173,48 @@ def test_tsan_van_clean():
     assert "TSAN: clean" in proc.stdout
 
 
+# -- the jax coordination seam the clean-abort path rides ---------------------
+
+
+def test_coordination_seam_accepts_recoverable_kwargs():
+    """Pin the private jax API `_coordination_client_options` patches
+    (ps_tpu/backends/tpu.py): `jax._src.distributed._jax.
+    get_distributed_runtime_client` must exist and accept
+    ``recoverable``/``shutdown_on_destruction``. If jax moves the seam or
+    drops the kwargs, the abort path silently degrades to
+    LOG(FATAL)-on-peer-death — this test turns that into a loud CI failure
+    (VERDICT r3 item 9 / r4 item 4)."""
+    from jax._src import distributed as _dist
+
+    factory = _dist._jax.get_distributed_runtime_client  # AttributeError = moved
+    # constructing (without connect()) exercises kwarg acceptance; a
+    # TypeError here is exactly the degradation the runtime warning masks
+    client = factory("127.0.0.1:1", 0, init_timeout=1,
+                     recoverable=True, shutdown_on_destruction=False)
+    assert client is not None
+
+
+def test_coordination_client_options_inject_without_degrading():
+    """The context manager swaps the factory in and restores it, and the
+    patched factory builds a client WITHOUT tripping its TypeError fallback
+    (which would warn and strip the recoverable semantics)."""
+    import warnings
+
+    from jax._src import distributed as _dist
+
+    from ps_tpu.backends.tpu import _coordination_client_options
+
+    orig = _dist._jax.get_distributed_runtime_client
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # any degradation warning = failure
+        with _coordination_client_options():
+            patched = _dist._jax.get_distributed_runtime_client
+            assert patched is not orig
+            client = patched("127.0.0.1:1", 0, init_timeout=1)
+            assert client is not None
+    assert _dist._jax.get_distributed_runtime_client is orig
+
+
 # -- layer 2: kill a process mid-run -----------------------------------------
 
 
