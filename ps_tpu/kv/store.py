@@ -333,7 +333,18 @@ class KVStore:
             params_kv, state = engine.get_tree_and_state()
             return fused.lower(params_kv, state, batch, *extra).cost_analysis()
 
+        def compiled_text(batch, *extra) -> str:
+            """Post-GSPMD optimized HLO of the fused step, as text — the
+            compiled collective pattern (reduce-scatter/all-gather vs
+            all-reduce) that tests/test_hlo_collectives.py pins so a
+            placement regression in ``param_sharding`` is a loud failure,
+            not a silent 8x traffic increase."""
+            params_kv, state = engine.get_tree_and_state()
+            return fused.lower(params_kv, state, batch, *extra)\
+                .compile().as_text()
+
         run.cost_analysis = cost_analysis
+        run.compiled_text = compiled_text
         return run
 
     def make_async_step(self, loss_fn, has_aux: bool = False):
