@@ -39,6 +39,10 @@ def main():
     ap.add_argument("--placement", default="sharded", choices=["replicated", "sharded"])
     ap.add_argument("--dtype", default="bfloat16", choices=["bfloat16", "float32"])
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--data", default=None, metavar="DIR",
+                    help="column-npy dataset directory (fields images, "
+                         "labels — see ps_tpu.data.files.write_dataset); "
+                         "default: synthetic generator")
     ap.add_argument("--jsonl", default=None, help="append per-step records here")
     ap.add_argument("--profile-dir", default=None, help="jax.profiler trace dir")
     args = ap.parse_args()
@@ -72,16 +76,20 @@ def main():
     run = store.make_step(
         make_loss_fn(model, label_smoothing=args.label_smoothing), has_aux=True
     )
-    # input path overlap (VERDICT r2 item 7): generation runs in a producer
-    # thread, placement double-buffers onto the mesh — per-iteration cost is
-    # max(generate, step) instead of generate + place + step
-    stream = device_prefetch(
-        threaded_source(
-            imagenet_batches(args.batch_size, image_size=args.image_size,
-                             seed=args.seed, steps=args.steps)
-        ),
-        place=store.shard_batch,
-    )
+    # input path overlap (VERDICT r2 item 7): generation (or the mmap file
+    # read) runs in a producer thread, placement double-buffers onto the
+    # mesh — per-iteration cost is max(input, step) instead of input + step
+    if args.data:
+        from ps_tpu.data.files import file_batches
+
+        source = file_batches(args.data, args.batch_size, steps=args.steps,
+                              shuffle=True, seed=args.seed,
+                              as_tuple=("images", "labels"))
+    else:
+        source = imagenet_batches(args.batch_size, image_size=args.image_size,
+                                  seed=args.seed, steps=args.steps)
+    stream = device_prefetch(threaded_source(source),
+                             place=store.shard_batch)
 
     metrics = TrainMetrics(store, batch_size=args.batch_size, num_chips=ndev)
     log = StepLogger(every=10, jsonl=args.jsonl)

@@ -42,6 +42,10 @@ def main():
     ap.add_argument("--exchange", default="gather", choices=["gather", "a2a"])
     ap.add_argument("--capacity-factor", type=float, default=2.0)
     ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--data", default=None, metavar="DIR",
+                    help="column-npy dataset directory (fields dense, "
+                         "sparse, label — see ps_tpu.data.files."
+                         "write_dataset); default: synthetic generator")
     ap.add_argument("--jsonl", default=None)
     ap.add_argument("--profile-dir", default=None)
     args = ap.parse_args()
@@ -89,8 +93,16 @@ def main():
 
     metrics = TrainMetrics(dense, batch_size=args.batch_size, num_chips=ndev)
     log = StepLogger(every=10, jsonl=args.jsonl)
-    stream = criteo_batches(args.batch_size, vocab_size=cfg.per_feature_vocab,
-                            seed=args.seed, steps=args.steps)
+    if args.data:
+        from ps_tpu.data.files import file_batches
+
+        stream = file_batches(args.data, args.batch_size, steps=args.steps,
+                              shuffle=True, seed=args.seed,
+                              fields=("dense", "sparse", "label"))
+    else:
+        stream = criteo_batches(args.batch_size,
+                                vocab_size=cfg.per_feature_vocab,
+                                seed=args.seed, steps=args.steps)
     with trace(args.profile_dir):
         for step, batch in enumerate(stream):
             loss, _ = run(dense.shard_batch(
