@@ -141,3 +141,65 @@ def test_moments_follow_pipe_rules(stages):
     assert mu["stack/w"].sharding.spec == P("pipe", None, None)
     assert mu["stack/b"].sharding.spec == P("pipe", None)
     ps.shutdown()
+
+
+# -- heterogeneous stages: the LM under dp x pp (VERDICT r4 item 9) -----------
+
+
+def _lm_setup():
+    from ps_tpu.models import lm
+
+    rng = np.random.default_rng(3)
+    params = lm.init_params(rng, vocab=64, d_model=32, n_heads=2,
+                            n_layers=4, max_len=64)
+    batches = list(lm.lm_batches(8, 16, vocab=64, seed=5, steps=3))
+    batches = [{k: jnp.asarray(v) for k, v in b.items()} for b in batches]
+    return lm, params, batches
+
+
+def test_lm_pipelined_forward_matches_sequential():
+    """Embed (het first stage) -> 4-stage trunk -> readout (het last stage)
+    == the plain non-pipelined apply, same params, same tokens."""
+    lm, params, batches = _lm_setup()
+    ref = float(lm.make_loss_fn(n_heads=2)(params, batches[0]))
+
+    ps.init(backend="tpu", mesh_shape={"data": 2, "pipe": 4})
+    comp = lm.split_pipeline_params(params, num_stages=4)
+    loss_fn = lm.make_pipelined_loss_fn(n_heads=2, num_stages=4,
+                                        microbatches=M)
+    got = float(jax.jit(loss_fn)(comp, batches[0]))
+    np.testing.assert_allclose(got, ref, rtol=2e-5, atol=2e-6)
+    ps.shutdown()
+
+
+def test_lm_trains_under_dp_pp_with_parity():
+    """The full PS step through the dp x pp pipeline: stacked trunk on
+    'pipe', embed/readout data-parallel — losses match non-pipelined
+    training step for step, and the trunk params land one stage per shard."""
+    lm, params, batches = _lm_setup()
+
+    # non-pipelined reference on the default mesh
+    ps.init(backend="tpu")
+    ref_store = ps.KVStore(optimizer="sgd", learning_rate=0.1)
+    ref_store.init(params)
+    ref_run = ref_store.make_step(lm.make_loss_fn(n_heads=2))
+    ref_losses = [float(ref_run(b)[0]) for b in batches]
+    ps.shutdown()
+
+    ps.init(backend="tpu", mesh_shape={"data": 2, "pipe": 4})
+    comp = lm.split_pipeline_params(params, num_stages=4)
+    store = ps.KVStore(optimizer="sgd", learning_rate=0.1,
+                       placement="replicated",
+                       partition_rules=lm.pipeline_lm_partition_rules())
+    store.init(comp)
+    # trunk leaves ride the pipe axis; embed stays a plain dense tensor
+    assert store._engine._params[
+        "stages/attn/qkv/kernel"].sharding.spec[0] == "pipe"
+    assert "pipe" not in (store._engine._params[
+        "embed/tokens"].sharding.spec or ())
+    run = store.make_step(lm.make_pipelined_loss_fn(
+        n_heads=2, num_stages=4, microbatches=M))
+    losses = [float(run(b)[0]) for b in batches]
+    np.testing.assert_allclose(losses, ref_losses, rtol=5e-5, atol=5e-6)
+    assert losses[-1] < losses[0]  # it actually trains
+    ps.shutdown()
