@@ -61,12 +61,15 @@ def build(seed: int):
 
 
 def main():
+    # env-var topology (PS_ROLE / DMLC_ROLE launcher style, config.py) is
+    # the flag default; explicit flags override
+    cfg = ps.Config.from_env()
     ap = argparse.ArgumentParser()
-    ap.add_argument("--role", default="single",
+    ap.add_argument("--role", default=cfg.role or "single",
                     choices=["single", "server", "worker"])
     ap.add_argument("--steps", type=int, default=60,
-                    help="single/worker: this node's cycles; server: total "
-                         "pushes to serve before draining")
+                    help="single/worker: this node's cycles (the server "
+                         "drains after every worker disconnects)")
     ap.add_argument("--batch-size", type=int, default=64)
     ap.add_argument("--num-workers", type=int, default=3)
     ap.add_argument("--lr", type=float, default=0.1)
@@ -78,15 +81,17 @@ def main():
                     help="server listen address (pass 0.0.0.0 explicitly "
                          "for a multi-host job; the endpoint is "
                          "unauthenticated)")
-    ap.add_argument("--server", default=None,
+    ap.add_argument("--server", default=cfg.server_uris,
                     help="worker: host:port, comma-separated for an "
-                         "N-server partition (or env PS_ASYNC_SERVER_URI)")
-    ap.add_argument("--worker-id", type=int, default=0)
-    ap.add_argument("--shard", type=int, default=None,
+                         "N-server partition (or env PS_SERVER_URIS / "
+                         "PS_ASYNC_SERVER_URI)")
+    ap.add_argument("--worker-id", type=int, default=cfg.worker_id)
+    ap.add_argument("--shard", type=int, default=cfg.shard,
                     help="server: this server's index in an N-server key "
-                         "partition")
-    ap.add_argument("--num-shards", type=int, default=None,
-                    help="server: total servers in the key partition")
+                         "partition (or env PS_SHARD)")
+    ap.add_argument("--num-shards", type=int, default=cfg.num_shards,
+                    help="server: total servers in the key partition "
+                         "(or env PS_NUM_SHARDS / DMLC_NUM_SERVER)")
     args = ap.parse_args()
     params, loss_fn = build(args.seed)
 
@@ -95,18 +100,32 @@ def main():
         if not uri:
             raise SystemExit("worker needs --server host:port "
                              "(or PS_ASYNC_SERVER_URI)")
+        from ps_tpu.utils import TrainMetrics
+
         w = ps.connect_async(uri, args.worker_id, params)
         run = w.make_async_step(loss_fn)
         log = StepLogger(every=10)
+        # the remote worker carries the same byte-counter surface as
+        # KVStore, so TrainMetrics reports push/pull GB/s — here those are
+        # REAL wire bytes on the van's TCP sockets, the reference's metric
+        # in its physical form
+        metrics = TrainMetrics(w, batch_size=args.batch_size, num_chips=1)
         # shard the stream by the JOB's worker count (the server's truth)
         stream = mnist_batches(args.batch_size, seed=args.seed,
                                worker=args.worker_id,
                                num_workers=w.num_workers)
         for step in range(args.steps):
             loss = run(next(stream))
+            if step == 0:
+                metrics.mark_compiled()
+            else:
+                metrics.step(loss)
             if log.wants(step):
                 log.log(step, loss=float(loss), version=w.version)
-        print(f"worker {args.worker_id}: done at server version {w.version}")
+        s = metrics.summary()
+        print(f"worker {args.worker_id}: done at server version {w.version}; "
+              f"wire push {s['push_gb']:.4f} GB / pull {s['pull_gb']:.4f} GB "
+              f"({s['push_pull_gbps']:.3f} GB/s)")
         w.close()
         return
 
@@ -120,16 +139,16 @@ def main():
         store.init(params)
 
     if args.role == "server":
-        import time
-
         svc = ps.serve_async(store, port=args.port, bind=args.bind,
                              shard=args.shard, num_shards=args.num_shards)
         shard_note = ("" if args.num_shards is None else
                       f", shard {args.shard}/{args.num_shards}")
         print(f"async PS server on port {svc.port} "
               f"({args.num_workers} workers expected{shard_note})")
-        while len(svc.apply_log) < args.steps:
-            time.sleep(0.1)
+        # quiesce on worker goodbyes, not push counts: a worker SHUTDOWNs
+        # only after its last reply arrived, so stop() cannot race a reply
+        # (the r4 flake — see backends/van_service.py)
+        svc.wait_for_goodbyes(args.num_workers)
         hist = dict(store._engine.staleness_hist)
         print(f"served {len(svc.apply_log)} pushes, "
               f"final version {store._engine.version}, "

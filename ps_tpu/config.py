@@ -13,7 +13,16 @@ Environment variables honored by :meth:`Config.from_env`:
 - ``PS_COORDINATOR_URI``   — multi-host coordinator ``host:port`` (tpu backend)
 - ``PS_NUM_PROCESSES``     — multi-host process count
 - ``PS_PROCESS_ID``        — this process's id
-- ``DMLC_ROLE`` etc. are accepted as aliases where the meaning is knowable.
+- ``PS_ROLE``              — cross-process PS deployments: 'server' or
+  'worker' (unset = the SPMD single-controller topology)
+- ``PS_SERVER_URIS``       — worker side: ``h0:p0,h1:p1,...`` naming every
+  server of the partition (alias: ``PS_ASYNC_SERVER_URI``)
+- ``PS_WORKER_ID``         — this worker's id in the cross-process job
+- ``PS_SHARD`` / ``PS_NUM_SHARDS`` — server side: this server's index in /
+  the size of the key (or row-range) partition
+- ``DMLC_ROLE``, ``DMLC_NUM_WORKER``, ``DMLC_NUM_SERVER``,
+  ``DMLC_PS_ROOT_URI``/``_PORT`` are accepted as aliases where the meaning
+  is knowable, so reference-family launcher scripts keep working.
 """
 
 from __future__ import annotations
@@ -71,6 +80,14 @@ class Config:
     mode: str = "sync"
     dc_lambda: float = 0.04
     seed: int = 0
+    # cross-process PS topology (serve_async/connect_async and the sparse
+    # twins) — the reference family's DMLC_ROLE-style node system. None =
+    # the SPMD single-controller topology (no PS processes).
+    role: Optional[str] = None          # 'server' | 'worker'
+    server_uris: Optional[str] = None   # worker: "h0:p0,h1:p1,..."
+    worker_id: int = 0                  # worker: id within the job
+    shard: Optional[int] = None         # server: index in the partition
+    num_shards: Optional[int] = None    # server: partition size
     heartbeat_base_port: Optional[int] = None
     peer_hosts: Optional[str] = None
     heartbeat_bind: Optional[str] = None
@@ -120,6 +137,25 @@ class Config:
             raise ValueError(f"unknown mode {self.mode!r}; use 'sync' or 'async'")
         if self.num_workers < 1:
             raise ValueError("num_workers must be >= 1")
+        if self.role not in (None, "server", "worker"):
+            if self.role == "scheduler":
+                raise ValueError(
+                    "role 'scheduler' does not exist here: rendezvous is "
+                    "jax.distributed's coordination service — point "
+                    "coordinator_uri (PS_COORDINATOR_URI / "
+                    "DMLC_PS_ROOT_URI+PORT) at the coordinator instead"
+                )
+            raise ValueError(
+                f"unknown role {self.role!r}; use 'server' or 'worker' "
+                "(unset = SPMD single-controller)"
+            )
+        if self.shard is not None and self.num_shards is None:
+            raise ValueError("shard set but num_shards unset")
+        if self.shard is not None and not (
+                0 <= self.shard < self.num_shards):
+            raise ValueError(
+                f"shard {self.shard} out of range for {self.num_shards}"
+            )
 
     @classmethod
     def from_env(cls, **overrides) -> "Config":
@@ -146,6 +182,25 @@ class Config:
             kwargs["mode"] = env["PS_MODE"]
         if "PS_SEED" in env:
             kwargs["seed"] = int(env["PS_SEED"])
+        if "PS_ROLE" in env:
+            kwargs["role"] = env["PS_ROLE"]
+        elif "DMLC_ROLE" in env:
+            kwargs["role"] = env["DMLC_ROLE"]
+        if "PS_SERVER_URIS" in env:
+            kwargs["server_uris"] = env["PS_SERVER_URIS"]
+        elif "PS_ASYNC_SERVER_URI" in env:
+            kwargs["server_uris"] = env["PS_ASYNC_SERVER_URI"]
+        if "PS_WORKER_ID" in env:
+            kwargs["worker_id"] = int(env["PS_WORKER_ID"])
+        if "PS_SHARD" in env:
+            kwargs["shard"] = int(env["PS_SHARD"])
+        if "PS_NUM_SHARDS" in env:
+            kwargs["num_shards"] = int(env["PS_NUM_SHARDS"])
+        elif "DMLC_NUM_SERVER" in env and int(env["DMLC_NUM_SERVER"]) > 1:
+            # the reference's N servers = our N-shard key partition; the
+            # shard index still needs PS_SHARD (DMLC assigns it via the
+            # scheduler, which has no equivalent here)
+            kwargs["num_shards"] = int(env["DMLC_NUM_SERVER"])
         if "PS_HEARTBEAT_BASE_PORT" in env:
             kwargs["heartbeat_base_port"] = int(env["PS_HEARTBEAT_BASE_PORT"])
         if "PS_PEER_HOSTS" in env:
