@@ -78,12 +78,18 @@ def main() -> int:
     store.init(params)
     run = store.make_step(loss_fn)
 
-    global_batch = 4 * total_devices
+    # a fixed PS_TEST_GLOBAL_BATCH makes the loss stream topology-invariant
+    # (the elastic drill compares curves across different device counts)
+    global_batch = int(os.environ.get("PS_TEST_GLOBAL_BATCH",
+                                      4 * total_devices))
     rows = global_batch // nproc  # this process's slice of the global batch
     stream = mnist_batches(global_batch, seed=0)
     ckpt = os.environ.get("PS_TEST_CKPT", "")
-    if ckpt.startswith("restore:"):
-        store.restore(ckpt[len("restore:"):])
+    if ckpt.startswith("restore:") or ckpt.startswith("erestore:"):
+        # erestore = elastic: the checkpoint may come from a DIFFERENT
+        # topology (the drill's pre-crash job); shardings re-derive live
+        store.restore(ckpt.split(":", 1)[1],
+                      elastic=ckpt.startswith("erestore:"))
         for _ in range(store.step):  # resume the stream where the save left it
             next(stream)
     losses = []
@@ -113,6 +119,10 @@ def main() -> int:
             )
             loss, _ = run(batch)
             losses.append(float(loss))
+            if ckpt.startswith("saveevery:"):
+                # the drill's checkpoint cadence: every step commits, so a
+                # crash loses at most the step in flight
+                store.save(ckpt.split(":", 1)[1])
             if leaver == pid and step == 0:
                 # clean unilateral leave: goodbye + sever, no barrier
                 ps.shutdown(abort=True)
@@ -132,7 +142,7 @@ def main() -> int:
         ps.shutdown(abort=True)
         with open(os.path.join(out_dir, f"proc{pid}.json"), "w") as f:
             json.dump({"pid": pid, "failure_detected": e.dead,
-                       "losses": losses}, f)
+                       "losses": losses, "committed_step": store.step}, f)
         return 0
 
     if leaver >= 0:
