@@ -120,4 +120,16 @@ def make_composite_step(
             return loss, params, aux
         return loss, params
 
+    def cost_analysis(batch, *extra):
+        """XLA HLO cost analysis of the whole composite step (lookup +
+        grad + dense apply + row exchange/apply) — no execution; same
+        contract as ``KVStore.make_step``'s hook. Benchmarks turn 'flops'
+        into MFU."""
+        params_kv, state = engine.get_tree_and_state()
+        tables = {n: emb_stores[n].table for n in names}
+        estates = {n: emb_stores[n]._state for n in names}
+        return fused.lower(params_kv, state, tables, estates,
+                           batch, *extra).cost_analysis()
+
+    run.cost_analysis = cost_analysis
     return run

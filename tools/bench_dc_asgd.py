@@ -1,0 +1,119 @@
+"""DC-ASGD efficacy measurement — VERDICT r4 item 3, SURVEY.md §4d.
+
+Does the delay compensation actually help convergence, or is it only
+unit-tested math? Protocol: MNIST-grating MLP, async SGD, W round-robin
+workers (round-robin makes every push stale by exactly τ = W-1), fixed
+total number of server applies, fixed LR — sweep τ ∈ {1, 4, 8} ×
+dc_lambda ∈ {0, 0.04} and record the held-out eval-loss curve per config,
+plus the τ=0 sync-SGD reference (the curve async is trying not to lose).
+Results → BASELINE.md.
+
+Run:  python tools/bench_dc_asgd.py [--applies 240] [--lr 0.1]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--applies", type=int, default=240,
+                    help="total server applies per config (fair budget)")
+    ap.add_argument("--lr", type=float, default=0.1)
+    ap.add_argument("--batch", type=int, default=64)
+    ap.add_argument("--eval-every", type=int, default=24)
+    ap.add_argument("--hidden", type=int, default=64)
+    ap.add_argument("--seed", type=int, default=0)
+    args = ap.parse_args()
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    import ps_tpu as ps
+    from ps_tpu.data.synthetic import mnist_batches
+    from ps_tpu.models.mlp import MLP, cross_entropy_loss
+
+    model = MLP(hidden=args.hidden)
+    init_params = model.init(jax.random.key(args.seed),
+                             jnp.zeros((1, 28, 28, 1)))["params"]
+
+    # held-out eval batch: a seed band the training streams never touch
+    ev_images, ev_labels = next(mnist_batches(512, seed=10_000))
+    ev_images, ev_labels = jnp.asarray(ev_images), jnp.asarray(ev_labels)
+
+    @jax.jit
+    def eval_loss(p):
+        return cross_entropy_loss(
+            model.apply({"params": p}, ev_images), ev_labels
+        )
+
+    def loss_fn(p, batch):
+        images, labels = batch
+        return cross_entropy_loss(model.apply({"params": p}, images), labels)
+
+    def run_async(workers: int, lam: float):
+        """Round-robin async: every push stale by workers-1."""
+        ps.init(backend="tpu", mode="async", num_workers=workers,
+                dc_lambda=lam)
+        store = ps.KVStore(optimizer="sgd", learning_rate=args.lr,
+                           mode="async")
+        store.init(init_params)
+        run = store.make_async_step(loss_fn)
+        streams = [mnist_batches(args.batch, seed=args.seed, worker=w,
+                                 num_workers=workers)
+                   for w in range(workers)]
+        curve = []
+        applies = 0
+        while applies < args.applies:
+            w = applies % workers
+            images, labels = next(streams[w])
+            run((jnp.asarray(images), jnp.asarray(labels)), worker=w)
+            applies += 1
+            if applies % args.eval_every == 0:
+                curve.append(round(float(eval_loss(store.pull_all(worker=0))), 4))
+        hist = dict(store._engine.staleness_hist)
+        ps.shutdown()
+        return curve, {str(t): n for t, n in sorted(hist.items())}
+
+    def run_sync():
+        """τ=0 reference: plain sync SGD, same apply budget, same stream."""
+        ps.init(backend="tpu")
+        store = ps.KVStore(optimizer="sgd", learning_rate=args.lr)
+        store.init(init_params)
+        run = store.make_step(loss_fn)
+        stream = mnist_batches(args.batch, seed=args.seed)
+        curve = []
+        for step in range(args.applies):
+            images, labels = next(stream)
+            run(store.shard_batch((jnp.asarray(images), jnp.asarray(labels))))
+            if (step + 1) % args.eval_every == 0:
+                curve.append(round(float(eval_loss(store.params())), 4))
+        ps.shutdown()
+        return curve
+
+    out = {"applies": args.applies, "lr": args.lr, "batch": args.batch,
+           "eval_every": args.eval_every, "configs": []}
+    out["sync_curve"] = run_sync()
+    print(f"sync: {out['sync_curve']}", file=sys.stderr)
+    for workers in (2, 5, 9):  # τ = 1, 4, 8
+        for lam in (0.0, 0.04):
+            curve, hist = run_async(workers, lam)
+            cfg = {"tau": workers - 1, "dc_lambda": lam,
+                   "curve": curve, "staleness_hist": hist}
+            out["configs"].append(cfg)
+            print(f"tau={workers-1} lam={lam}: {curve}", file=sys.stderr)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
