@@ -104,6 +104,13 @@ class AsyncPSService(VanService):
         # returns" holds even if a serve thread outlives the join (e.g.
         # blocked in a jit compile inside the engine apply)
         self._draining = False
+        # checkpoint pause: while True, pushes BLOCK (not refuse), except
+        # the ones a drain_to round admits — see _checkpoint for the
+        # cross-shard-atomicity protocol these implement
+        self._paused = False
+        self._pause_cond = threading.Condition(engine._lock)
+        self._applied: Dict[int, int] = {}   # per-worker applied pushes
+        self._drain_targets: Dict[int, int] = {}
         self._log_lock = threading.Lock()
         self.apply_log: List[int] = []  # worker id per committed tree, in order
         # full ordered (op, worker) history — "pull" records matter because
@@ -137,12 +144,23 @@ class AsyncPSService(VanService):
         # this frame's lifetime
         grads = {k: np.array(v) for k, v in grads.items()}
         with self._engine._lock:
+            while (self._paused and not self._draining
+                   and not self._admit_while_paused(worker)):
+                self._pause_cond.wait()  # a checkpoint snapshot is in flight
             if self._draining:
                 raise RuntimeError("server is draining; push refused")
             self._engine.push_tree(grads, worker=worker)
+            self._applied[worker] = self._applied.get(worker, 0) + 1
+            self._pause_cond.notify_all()  # a drain_to waiter may be watching
             with self._log_lock:
                 self.apply_log.append(worker)
                 self.event_log.append(["push", worker])
+
+    def _admit_while_paused(self, worker: int) -> bool:
+        """Under pause, admit exactly the pushes a drain_to round asked
+        for: this worker still lags its cross-shard target."""
+        return (self._applied.get(worker, 0)
+                < self._drain_targets.get(worker, 0))
 
     def _handle(self, kind: int, worker: int, tensors, extra) -> bytes:
         if kind == tv.HELLO:
@@ -178,12 +196,84 @@ class AsyncPSService(VanService):
                     self._engine._worker_version.items()
                 },
             })
+        elif kind == tv.CHECKPOINT:
+            return self._checkpoint(worker, extra)
         return tv.encode(tv.ERR, worker, None,
                          extra={"error": f"bad kind {kind}"})
+
+    def _checkpoint(self, worker: int, extra: dict) -> bytes:
+        """Coordinated multi-server checkpoint (SURVEY.md §6: server state
+        survives restarts), driven by :meth:`RemoteAsyncWorker.
+        checkpoint_all` in three phases so the snapshot is CROSS-SHARD
+        atomic: 'pause' blocks new applies on every server, 'save' writes
+        this server's shard to ``<dir>/shard<i>`` (``<dir>`` unsharded),
+        'resume' releases the applies. Pausing first means no worker's
+        push can be applied by one shard after its save and by another
+        before it — the state on disk is a point every shard agrees on.
+        The save holds the engine lock (pulls also mutate engine
+        bookkeeping — the per-worker stale snapshots and version vector —
+        so an unlocked save could tear them), which stalls this server's
+        traffic for the write's duration: the price of an atomic snapshot
+        point, paid once per checkpoint cadence. The endpoint writes paths
+        on the server host and is unauthenticated — another reason
+        ``bind`` defaults to loopback."""
+        import os
+
+        phase = extra.get("phase", "save")
+        if phase == "pause":
+            with self._engine._lock:
+                self._paused = True
+                applied = {str(w): n for w, n in self._applied.items()}
+            return tv.encode(tv.OK, worker, None, extra={
+                "version": self._engine.version, "applied": applied,
+            })
+        if phase == "drain_to":
+            # admit blocked/in-flight pushes until every worker reaches its
+            # cross-shard target, then report back. TCP delivery of an
+            # already-fanned-out push is guaranteed, so the wait terminates;
+            # the deadline guards a worker that died mid-fanout, and a
+            # concurrent stop() aborts the wait (draining refuses pushes,
+            # so the targets can never be reached once it is set).
+            import time as _time
+
+            targets = {int(w): int(n) for w, n in extra["targets"].items()}
+            deadline = _time.monotonic() + float(extra.get("timeout", 30.0))
+            with self._engine._lock:
+                self._drain_targets = targets
+                self._pause_cond.notify_all()
+                while any(self._applied.get(w, 0) < n
+                          for w, n in targets.items()):
+                    left = deadline - _time.monotonic()
+                    if left <= 0 or self._draining:
+                        self._drain_targets = {}
+                        return tv.encode(tv.ERR, worker, None, extra={
+                            "error": ("drain_to aborted: server draining"
+                                      if self._draining else
+                                      "drain_to timed out: a worker's "
+                                      "in-flight push never arrived"),
+                        })
+                    self._pause_cond.wait(left)
+                self._drain_targets = {}
+            return tv.encode(tv.OK, worker, None,
+                             extra={"version": self._engine.version})
+        if phase == "resume":
+            with self._engine._lock:
+                self._paused = False
+                self._pause_cond.notify_all()
+            return tv.encode(tv.OK, worker, None,
+                             extra={"version": self._engine.version})
+        path = (extra["dir"] if self.num_shards is None
+                else os.path.join(extra["dir"], f"shard{self.shard}"))
+        with self._engine._lock:
+            self._store.save(path)
+            version = self._engine.version
+        return tv.encode(tv.OK, worker, None,
+                         extra={"version": version, "path": path})
 
     def _set_draining(self) -> None:
         with self._engine._lock:
             self._draining = True
+            self._pause_cond.notify_all()  # paused pushes wake into refusal
 
 
 def serve_async(store, port: int = 0, bind: str = "127.0.0.1",
@@ -222,7 +312,31 @@ def connect_async(uri: str, worker: int, params_like) -> "RemoteAsyncWorker":
     return RemoteAsyncWorker.connect_many(addrs, worker, params_like)
 
 
-class RemoteAsyncWorker:
+class CheckpointRoundsMixin:
+    """One phase of the coordinated checkpoint protocol, fanned to every
+    server — shared by the dense and sparse workers (both expose
+    ``_fanout``/``_chs``/``worker``). Raises on any non-OK reply, naming
+    the phase and server."""
+
+    def _checkpoint_round(self, payload_extra: dict) -> Dict[int, dict]:
+        msgs = self._fanout({
+            i: tv.encode(tv.CHECKPOINT, self.worker, None,
+                         extra=payload_extra)
+            for i in range(len(self._chs))
+        })
+        out = {}
+        for i, msg in msgs.items():
+            kind, _, _, extra = tv.decode(msg)
+            if kind != tv.OK:
+                raise RuntimeError(
+                    f"server {i} checkpoint {payload_extra.get('phase')} "
+                    f"failed: {extra.get('error')}"
+                )
+            out[i] = extra
+        return out
+
+
+class RemoteAsyncWorker(CheckpointRoundsMixin):
     """A worker NODE of the cross-process async PS.
 
     Computes gradients on this process's own jax devices against the params
@@ -248,6 +362,10 @@ class RemoteAsyncWorker:
                     params_like) -> None:
         self.worker = worker
         kv, self._treedef = keymod.flatten_with_keys(params_like)
+        # placeholders, not the arrays: reconnect() only needs keys +
+        # structure, and pinning a BERT-size initial tree for the worker's
+        # lifetime would double its memory
+        self._kv_like = {k: True for k in kv}
         self._key_order = sorted(kv)
         self._addrs = addrs
         n = len(addrs)
@@ -444,6 +562,69 @@ class RemoteAsyncWorker:
         return {"servers": [extras.get(i) for i in range(len(self._chs))],
                 "version": sum(int(e.get("version", 0))
                                for e in extras.values())}
+
+    def checkpoint_all(self, path: str) -> List[int]:
+        """Trigger a coordinated, CROSS-SHARD-ATOMIC checkpoint.
+
+        Four phases: **pause** (every server blocks new applies and reports
+        its per-worker applied-push counts), **drain_to** (pause alone is
+        not atomic — another worker's push may already be applied on one
+        shard and in flight to the rest, so each server admits exactly the
+        blocked/in-flight pushes needed to reach the cross-shard per-worker
+        maximum; TCP guarantees those arrive), **save** (each server writes
+        its shard under ``path``, ``path/shard<i>`` when partitioned),
+        **resume**. The restored state is therefore a point every shard
+        agrees on: whole pushes, never a push torn across shards —
+        tests/test_remote_async.py hammers this invariant under a
+        concurrent pusher. Returns the per-server snapshot versions.
+
+        Restart story: each restarted server runs ``store.init(
+        shard_tree(params, i, N)); store.restore(path/shard<i>);
+        serve_async(store, shard=i, num_shards=N)`` and workers
+        :meth:`reconnect`."""
+        try:
+            # pause inside the protected region: if ANY round fails, the
+            # surviving servers are still resumed — a fleet must never be
+            # left blocked by a failed checkpoint
+            paused = self._checkpoint_round({"dir": path, "phase": "pause"})
+            targets: Dict[str, int] = {}
+            for extra in paused.values():
+                for w, n in extra.get("applied", {}).items():
+                    targets[w] = max(targets.get(w, 0), int(n))
+            lagging = any(
+                int(extra.get("applied", {}).get(w, 0)) < n
+                for extra in paused.values() for w, n in targets.items()
+            )
+            if lagging:
+                self._checkpoint_round({"dir": path, "phase": "drain_to",
+                                        "targets": targets})
+            saves = self._checkpoint_round({"dir": path, "phase": "save"})
+        except BaseException:
+            # resume the healthy servers, then let the ORIGINAL failure
+            # propagate (the resume round hits the same dead server — its
+            # error would only mask the root cause)
+            try:
+                self._checkpoint_round({"dir": path, "phase": "resume"})
+            except Exception:
+                pass
+            raise
+        self._checkpoint_round({"dir": path, "phase": "resume"})
+        return [int(saves[i]["version"]) for i in range(len(self._chs))]
+
+    def reconnect(self, addrs: Optional[Sequence[Tuple[str, int]]] = None
+                  ) -> None:
+        """Re-dial every server (optionally at new addresses — restarted
+        servers usually come back on new ephemeral ports) and revalidate
+        the partition. The first pull after a reconnect is a fresh
+        snapshot; staleness restarts from the servers' restored version
+        vectors."""
+        for ch in self._chs:
+            ch.close()  # dead or stale; no SHUTDOWN owed
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+        self._init_multi(list(addrs) if addrs is not None else self._addrs,
+                         self.worker, keymod.unflatten(
+                             self._treedef, self._kv_like, self._key_order))
 
     def make_async_step(self, loss_fn, has_aux: bool = False):
         """``run(batch, *extra) -> loss`` — grad against the last-pulled

@@ -37,7 +37,10 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ps_tpu.backends.remote_async import ServerFailureError
+from ps_tpu.backends.remote_async import (
+    CheckpointRoundsMixin,
+    ServerFailureError,
+)
 from ps_tpu.backends.van_service import VanService
 from ps_tpu.control import tensor_van as tv
 
@@ -126,8 +129,19 @@ class SparsePSService(VanService):
         # observe a half-swapped (table, state) pair
         self._lock = threading.Lock()
         self._draining = False
-        self.versions: Dict[str, int] = {n: 0 for n in self._tables}
-        self.rows_applied: Dict[str, int] = {n: 0 for n in self._tables}
+        # checkpoint pause (see AsyncPSService._checkpoint): pushes BLOCK
+        # while a coordinated cross-shard snapshot is in flight
+        self._paused = False
+        self._pause_cond = threading.Condition(self._lock)
+        # seeded from the tables' own (checkpoint-restored) counters, so a
+        # server restarted from SparseEmbedding.restore resumes its version
+        # stream instead of resetting to 0 (coordinated-checkpoint story)
+        self.versions: Dict[str, int] = {
+            n: int(emb.push_count) for n, emb in self._tables.items()
+        }
+        self.rows_applied: Dict[str, int] = {
+            n: int(emb.rows_pushed) for n, emb in self._tables.items()
+        }
         self._log_lock = threading.Lock()
         self.apply_log: List[int] = []  # worker id per applied push message
         super().__init__(port=port, bind=bind)  # starts accepting: state ready
@@ -176,6 +190,8 @@ class SparsePSService(VanService):
         if not todo:
             return  # push_pull with no rows for this server: nothing applied
         with self._lock:
+            while self._paused and not self._draining:
+                self._pause_cond.wait()  # a checkpoint snapshot is in flight
             if self._draining:
                 raise RuntimeError("server is draining; push refused")
             for name, ids, grads in todo:
@@ -220,12 +236,56 @@ class SparsePSService(VanService):
                 "rows_applied": dict(self.rows_applied),
                 "apply_log": log,
             })
+        elif kind == tv.CHECKPOINT:
+            return self._checkpoint(worker, extra)
         return tv.encode(tv.ERR, worker, None,
                          extra={"error": f"bad kind {kind}"})
+
+    def _checkpoint(self, worker: int, extra: dict) -> bytes:
+        """Coordinated multi-server checkpoint, three phases (pause
+        applies everywhere -> save every owned table under
+        ``<dir>[/shard<i>]/<table>`` -> resume). Each shard's save is
+        atomic and the pause stops new cycles from landing mid-save;
+        unlike the dense service there is NO cross-shard drain round — a
+        sparse cycle routes to an arbitrary subset of shards (per the row
+        ranges of its ids), so per-worker counts are not comparable across
+        shards. The resulting semantics: a cycle concurrent with the
+        checkpoint may be captured on some shards and not others, which
+        for row-independent embedding state is exactly "that push partially
+        lost in flight" — tolerated by async training. Quiesce workers for
+        an exact global cut. A restarted server inits its range-sliced
+        tables, ``restore``s each, and the service re-seeds versions from
+        the restored push counts. Triggered by
+        :meth:`RemoteSparseWorker.checkpoint_all`; the endpoint writes
+        server-host paths and is unauthenticated — another reason ``bind``
+        defaults to loopback."""
+        import os
+
+        phase = extra.get("phase", "save")
+        if phase == "pause":
+            with self._lock:
+                self._paused = True
+            return tv.encode(tv.OK, worker, None,
+                             extra={"versions": dict(self.versions)})
+        if phase == "resume":
+            with self._lock:
+                self._paused = False
+                self._pause_cond.notify_all()
+            return tv.encode(tv.OK, worker, None,
+                             extra={"versions": dict(self.versions)})
+        root = (extra["dir"] if self.num_shards is None
+                else os.path.join(extra["dir"], f"shard{self.shard}"))
+        with self._lock:
+            for name, emb in self._tables.items():
+                emb.save(os.path.join(root, name))
+            versions = dict(self.versions)
+        return tv.encode(tv.OK, worker, None,
+                         extra={"versions": versions, "path": root})
 
     def _set_draining(self) -> None:
         with self._lock:
             self._draining = True
+            self._pause_cond.notify_all()  # paused pushes wake into refusal
 
 
 def serve_sparse(tables: Dict[str, Any], port: int = 0,
@@ -261,7 +321,7 @@ def connect_sparse(uri: str, worker: int,
     return RemoteSparseWorker(addrs, worker, tables)
 
 
-class RemoteSparseWorker:
+class RemoteSparseWorker(CheckpointRoundsMixin):
     """A worker NODE of the cross-process sparse PS.
 
     Routes global row ids to owner servers by range, fans per-server
@@ -335,6 +395,10 @@ class RemoteSparseWorker:
                     raise ValueError(f"table {name!r}: servers disagree "
                                      f"on dtype")
                 self._ranges[name].append((int(m["lo"]), int(m["hi"]), i))
+            # seed from the server's advertised counters (nonzero when the
+            # server restarted from a checkpoint), like the dense worker
+            for name, v in extra.get("versions", {}).items():
+                self._versions[name][i] = int(v)
         for name, ranges in self._ranges.items():
             ranges.sort()
             total = self._spec[name][0]
@@ -494,6 +558,48 @@ class RemoteSparseWorker:
             for i, t in reqs.items()
         })
         return self._merge_rows(requests, routes, msgs)
+
+    def checkpoint_all(self, path: str) -> Dict[str, int]:
+        """Trigger a coordinated checkpoint: pause applies on every
+        server, save each server's tables under ``path``
+        (``path/shard<i>/<table>`` in the partitioned topology), resume.
+        Per-shard atomic; a cycle racing the checkpoint may land on a
+        subset of shards (see :meth:`SparsePSService._checkpoint` for why
+        that is the honest semantics for row-independent state — quiesce
+        workers for an exact cut). Returns the per-table total versions at
+        snapshot time. Restart: each server re-inits its range-sliced
+        tables, ``restore``s each from its shard dir, and serves again
+        (versions resume from the restored push counts); workers
+        :meth:`reconnect`."""
+        try:
+            # pause inside the protected region: a failed round must still
+            # resume the surviving servers (never wedge the fleet)
+            self._checkpoint_round({"dir": path, "phase": "pause"})
+            saves = self._checkpoint_round({"dir": path, "phase": "save"})
+        except BaseException:
+            try:
+                self._checkpoint_round({"dir": path, "phase": "resume"})
+            except Exception:
+                pass  # the original failure names the culprit
+            raise
+        self._checkpoint_round({"dir": path, "phase": "resume"})
+        totals: Dict[str, int] = {n: 0 for n in self._spec}
+        for extra in saves.values():
+            for n, v in extra["versions"].items():
+                totals[n] += int(v)
+        return totals
+
+    def reconnect(self, addrs: Optional[Sequence[Tuple[str, int]]] = None
+                  ) -> None:
+        """Re-dial every server (optionally at new addresses) and
+        revalidate the row partition — the worker half of the
+        checkpoint/restart story."""
+        for ch in self._chs:
+            ch.close()  # dead or stale; no SHUTDOWN owed
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+        self.__init__(list(addrs) if addrs is not None else self._addrs,
+                      self.worker, dict(self._spec))
 
     def stats(self) -> dict:
         msgs = self._fanout({

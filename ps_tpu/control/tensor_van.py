@@ -43,6 +43,7 @@ ERR = 7
 ROW_PULL = 8       # {"<table>/ids"} -> {"<table>/rows"} + versions
 ROW_PUSH = 9       # {"<table>/ids", "<table>/grads"} -> ack + versions
 ROW_PUSH_PULL = 10  # push + pull in one round trip per server
+CHECKPOINT = 11    # {"dir"} -> server saves its shard; ack + version(s)
 
 _HDR = struct.Struct("<BIQ")  # kind, worker_id, meta_len
 
