@@ -46,8 +46,13 @@ def main() -> int:
     init_params = model.init(jax.random.key(args.seed),
                              jnp.zeros((1, 28, 28, 1)))["params"]
 
-    # held-out eval batch: a seed band the training streams never touch
-    ev_images, ev_labels = next(mnist_batches(512, seed=10_000))
+    # held-out eval batch: SAME task (the class prototypes are a function
+    # of the seed) but a step index far beyond any config's training
+    # budget, so the draws are disjoint from every training stream
+    ev_stream = mnist_batches(512, seed=args.seed)
+    for _ in range(300):
+        next(ev_stream)
+    ev_images, ev_labels = next(ev_stream)
     ev_images, ev_labels = jnp.asarray(ev_images), jnp.asarray(ev_labels)
 
     @jax.jit
@@ -79,7 +84,11 @@ def main() -> int:
             run((jnp.asarray(images), jnp.asarray(labels)), worker=w)
             applies += 1
             if applies % args.eval_every == 0:
-                curve.append(round(float(eval_loss(store.pull_all(worker=0))), 4))
+                # params() is the side-effect-free read: pull_all would
+                # record a protocol pull for worker 0, resetting its stale
+                # snapshot/version and biasing the very DC correction this
+                # tool measures
+                curve.append(round(float(eval_loss(store.params())), 4))
         hist = dict(store._engine.staleness_hist)
         ps.shutdown()
         return curve, {str(t): n for t, n in sorted(hist.items())}

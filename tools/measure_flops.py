@@ -73,6 +73,27 @@ def measure(model: str, batch_sizes=(8, 16)) -> dict:
             batch = next(criteo_batches(bs, vocab_size=cfg.per_feature_vocab))
             batch = {k: jnp.asarray(v) for k, v in batch.items()}
             ca = run.cost_analysis(batch)
+        elif model == "resnet":
+            # reproduces the r3 derivation behind bench.py's
+            # _FLOPS_RESNET_* constants (BASELINE.md)
+            from ps_tpu.data.synthetic import imagenet_batches
+            from ps_tpu.models.resnet import ResNet50, make_loss_fn
+            from ps_tpu.parallel.sharding import replicated
+
+            ctx = ps.current_context()
+            m = ResNet50(dtype=jnp.bfloat16)
+            v = m.init(jax.random.key(0), jnp.zeros((2, 224, 224, 3)),
+                       train=False)
+            mstate = jax.device_put(v["batch_stats"], replicated(ctx.mesh))
+            store = ps.KVStore(optimizer="momentum", learning_rate=0.1,
+                               momentum=0.9, placement="replicated")
+            store.init(v["params"])
+            run = store.make_step(make_loss_fn(m, label_smoothing=0.1),
+                                  has_aux=True)
+            images, labels = next(imagenet_batches(bs))
+            ca = run.cost_analysis(
+                (jnp.asarray(images), jnp.asarray(labels)), mstate
+            )
         else:
             raise SystemExit(f"unknown model {model}")
         out[bs] = float(ca["flops"])
