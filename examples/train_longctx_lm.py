@@ -44,9 +44,15 @@ def main():
     ap.add_argument("--n-layers", type=int, default=2)
     ap.add_argument("--lr", type=float, default=3e-3)
     ap.add_argument("--mesh", default="data=2,seq=4",
-                    help="e.g. data=2,seq=4 or data=2,model=2,seq=2")
+                    help="e.g. data=2,seq=4, data=2,model=2,seq=2, or "
+                         "data=2,pipe=4 with --microbatches")
     ap.add_argument("--attn", default="ring",
                     choices=["full", "ring", "ulysses"])
+    ap.add_argument("--microbatches", type=int, default=0,
+                    help="> 0 with a 'pipe' mesh axis: GPipe the "
+                         "transformer trunk over it (heterogeneous "
+                         "stages: embed/readout stay data-parallel); "
+                         "n-layers must divide by the pipe size")
     ap.add_argument("--seed", type=int, default=0)
     args = ap.parse_args()
 
@@ -57,10 +63,28 @@ def main():
                          "parallelism")
     ctx = ps.init(backend="tpu", mesh_shape=mesh_shape)
     sp = mesh_shape.get("seq", 1)
+    pp = mesh_shape.get("pipe", 1)
     if args.attn != "full" and sp <= 1:
         raise SystemExit("--attn ring/ulysses needs a seq axis > 1")
     if args.seq_len % max(sp, 1):
         raise SystemExit("--seq-len must be divisible by the seq axis")
+    if (pp > 1) != (args.microbatches > 0):
+        raise SystemExit("pipelining needs BOTH a pipe mesh axis and "
+                         "--microbatches > 0")
+    if pp > 1 and args.attn != "full":
+        raise SystemExit("--microbatches composes with full attention "
+                         "(ring/ulysses shard the sequence axis the "
+                         "pipeline microbatches would re-shard)")
+    if pp > 1 and mesh_shape.get("model", 1) > 1:
+        raise SystemExit("pipe + model axes do not compose yet: the GPipe "
+                         "shard_map replicates stage params over 'model', "
+                         "so TP would be silently dropped — use one or "
+                         "the other")
+    if args.microbatches > 0 and args.batch_size % args.microbatches:
+        raise SystemExit("--batch-size must be divisible by --microbatches")
+    if pp > 1 and args.n_layers % pp:
+        raise SystemExit(f"--n-layers {args.n_layers} must divide into "
+                         f"{pp} pipeline stages")
 
     params = lm.init_params(
         np.random.default_rng(args.seed), vocab=args.vocab,
@@ -72,12 +96,24 @@ def main():
           f"attn={args.attn}, T={args.seq_len}")
 
     rules = lm.lm_partition_rules() if mesh_shape.get("model", 1) > 1 else None
+    attn_fn = lm.make_attn_fn(args.attn, mesh=ctx.mesh)
+    if pp > 1:
+        # heterogeneous dp x pp: blocks stack on 'pipe', embed/readout
+        # stay dense (ps_tpu/models/lm.py) — parity vs non-pipelined is
+        # asserted in tests/test_pipeline.py. (No extra Megatron rules:
+        # model+pipe is rejected above — the stacked trunk leaves could
+        # not match the rank-2 TP rules anyway.)
+        params = lm.split_pipeline_params(params, num_stages=pp)
+        rules = lm.pipeline_lm_partition_rules()
+        loss_fn = lm.make_pipelined_loss_fn(
+            n_heads=args.n_heads, num_stages=pp,
+            microbatches=args.microbatches, attn_fn=attn_fn,
+        )
+    else:
+        loss_fn = lm.make_loss_fn(n_heads=args.n_heads, attn_fn=attn_fn)
     store = ps.KVStore(optimizer="adam", learning_rate=args.lr,
                        placement="sharded", partition_rules=rules)
     store.init(params)
-
-    attn_fn = lm.make_attn_fn(args.attn, mesh=ctx.mesh)
-    loss_fn = lm.make_loss_fn(n_heads=args.n_heads, attn_fn=attn_fn)
     run = store.make_step(loss_fn)
 
     # activations shard batch over 'data' AND sequence over 'seq'

@@ -32,6 +32,11 @@ class BertConfig:
     max_len: int = 512
     type_vocab_size: int = 2
     dtype: Any = jnp.bfloat16
+    # 'full' = explicit einsum attention; 'flash' = the Pallas fused
+    # kernel (ps_tpu/ops/flash_attention.py) — O(S) attention memory, the
+    # seq-512 MFU lever measured in BASELINE.md r5. Sequence length must
+    # be a multiple of 128 for 'flash'.
+    attn: str = "full"
 
     @staticmethod
     def base() -> "BertConfig":
@@ -61,11 +66,18 @@ class SelfAttention(nn.Module):
         q = dense("query")(x)  # [B, S, h, d]
         k = dense("key")(x)
         v = dense("value")(x)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(head_dim)
-        # mask: [B, S] with 1 = attend; softmax in f32
-        bias = jnp.where(mask[:, None, None, :] > 0, 0.0, -1e9)
-        probs = nn.softmax(scores.astype(jnp.float32) + bias).astype(cfg.dtype)
-        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        if cfg.attn == "flash":
+            from ps_tpu.ops import flash_attention
+
+            out = flash_attention(q, k, v, mask=mask)
+        else:
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(head_dim)
+            # mask: [B, S] with 1 = attend; softmax in f32
+            bias = jnp.where(mask[:, None, None, :] > 0, 0.0, -1e9)
+            probs = nn.softmax(
+                scores.astype(jnp.float32) + bias
+            ).astype(cfg.dtype)
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
         return nn.DenseGeneral(
             cfg.hidden_size, axis=(-2, -1), dtype=cfg.dtype,
             param_dtype=jnp.float32, name="out",

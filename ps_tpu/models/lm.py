@@ -76,10 +76,19 @@ def _full_attention(q, k, v, causal=True, **_):
 
 
 def make_attn_fn(attn: str = "full", mesh=None, **kw) -> Callable:
-    """'full' | 'ring' | 'ulysses' — the latter two need a 'seq' mesh axis
-    and activations sharded P(batch, 'seq')."""
+    """'full' | 'flash' | 'ring' | 'ulysses'. 'flash' is the single-device
+    Pallas kernel (O(S) attention memory; seq must be a multiple of 128);
+    'ring'/'ulysses' need a 'seq' mesh axis and activations sharded
+    P(batch, 'seq')."""
     if attn == "full":
         return _full_attention
+    if attn == "flash":
+        from ps_tpu.ops import flash_attention
+
+        def flash_fn(q, k, v, causal=True):
+            return flash_attention(q, k, v, causal=causal, **kw)
+
+        return flash_fn
     from ps_tpu.parallel import ring_attention, ulysses_attention
 
     op = {"ring": ring_attention, "ulysses": ulysses_attention}[attn]

@@ -1,0 +1,6 @@
+"""TPU kernels (Pallas) for the hot ops the XLA default leaves on the
+table. Currently: flash attention (ops/flash_attention.py) — the
+fused-softmax attention that never materializes the [S, S] probability
+matrix in HBM, the lever for long-sequence MFU."""
+
+from ps_tpu.ops.flash_attention import flash_attention  # noqa: F401

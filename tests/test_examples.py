@@ -114,3 +114,13 @@ def test_longctx_lm_cli_ring():
                "--mesh", "data=2,seq=4", "--attn", "ring")
     losses = _losses(out)
     assert "done:" in out and losses and losses[-1] < losses[0] + 0.5
+
+
+@pytest.mark.slow
+def test_longctx_lm_cli_pipelined():
+    """The LM trainer under dp x pp (heterogeneous stages) from the CLI."""
+    out = _run("train_longctx_lm.py", "--steps", "6", "--seq-len", "32",
+               "--mesh", "data=2,pipe=4", "--attn", "full",
+               "--n-layers", "4", "--microbatches", "2")
+    losses = _losses(out)
+    assert "done:" in out and losses and losses[-1] < losses[0] + 0.5
