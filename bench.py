@@ -51,6 +51,10 @@ _FLOPS_RESNET_CONST = 0.154e9   # per-step optimizer/loss constant
 # flops = 85.775e9 * batch + 3.061e9 (6*N*T sanity: 6*110e6*128 = 84.5e9 ✓)
 _FLOPS_BERT_SEQ_128 = 85.775122432e9
 _FLOPS_BERT_CONST = 3.060924416e9
+# same derivation @ bs {4,8}, seq 512 (the attention-quadratic term shows:
+# 4x tokens -> 4.26x flops)
+_FLOPS_BERT_SEQ_512 = 365.325811712e9
+_FLOPS_BERT_512_CONST = 3.045588992e9
 # tools/measure_flops.py widedeep @ bs {8,16}, vocab 100k x 26, dim 16:
 # flops = 909520 * batch + 220.37e6 (const = full-table optimizer scan)
 _FLOPS_WD_EXAMPLE = 909520.0
@@ -279,7 +283,7 @@ def bench_bert(args, retried: bool):
     batch_size = per_chip_batch * ndev
 
     ps.init(backend="tpu")
-    cfg = (BertConfig(dtype=jnp.bfloat16) if on_tpu
+    cfg = (BertConfig(dtype=jnp.bfloat16, attn=args.attn) if on_tpu
            else BertConfig.tiny())
     model = BertMLM(cfg)
     shape = (2, seq_len)
@@ -313,10 +317,13 @@ def bench_bert(args, retried: bool):
     dt = min(rep_times)
 
     if on_tpu:
+        slope, const = {
+            128: (_FLOPS_BERT_SEQ_128, _FLOPS_BERT_CONST),
+            512: (_FLOPS_BERT_SEQ_512, _FLOPS_BERT_512_CONST),
+        }.get(seq_len, (None, None))
         flops, flops_src = _flops_per_step(
-            run, batches[0], (), batch_size,
-            _FLOPS_BERT_SEQ_128, _FLOPS_BERT_CONST,
-            shapes_match=(seq_len == 128),
+            run, batches[0], (), batch_size, slope, const,
+            shapes_match=slope is not None,
         )
     else:
         flops, flops_src = None, None
@@ -329,6 +336,7 @@ def bench_bert(args, retried: bool):
         dt=dt, summary=summary,
         extra_detail={
             "seq_len": seq_len,
+            "attn": args.attn,
             "tokens_per_sec_per_chip": round(
                 steps * batch_size * seq_len / dt / ndev, 1),
         },
@@ -444,6 +452,10 @@ def main(argv=None, retried: bool = False):
     ap.add_argument("--per-chip-batch", type=int, default=None)
     ap.add_argument("--image-size", type=int, default=224)
     ap.add_argument("--seq-len", type=int, default=128)
+    ap.add_argument("--attn", default="full", choices=["full", "flash"],
+                    help="(bert) attention op; 'flash' is the Pallas "
+                         "kernel — the memory regime's choice, see "
+                         "BASELINE.md")
     ap.add_argument("--streaming", action="store_true",
                     help="(resnet) feed steps through the host->device "
                          "prefetch instead of cycling pre-placed batches")
