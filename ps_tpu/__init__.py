@@ -47,6 +47,8 @@ from ps_tpu.backends.remote_sparse import (
 )
 from ps_tpu import checkpoint
 from ps_tpu import optim
+from ps_tpu.data.files import file_batches, write_dataset
+from ps_tpu.ops import flash_attention
 
 __version__ = "0.1.0"
 
@@ -68,5 +70,8 @@ __all__ = [
     "ServerFailureError",
     "checkpoint",
     "optim",
+    "file_batches",
+    "write_dataset",
+    "flash_attention",
     "__version__",
 ]
