@@ -47,14 +47,15 @@ from ps_tpu.utils.metrics import TrainMetrics
 # (the axon TPU plugin) AND the shapes are the TPU defaults below.
 _FLOPS_RESNET_IMAGE_224 = 23.745e9
 _FLOPS_RESNET_CONST = 0.154e9   # per-step optimizer/loss constant
-# tools/measure_flops.py bert @ bs {8,16}, seq 128, bf16, LAMB:
-# flops = 85.775e9 * batch + 3.061e9 (6*N*T sanity: 6*110e6*128 = 84.5e9 ✓)
-_FLOPS_BERT_SEQ_128 = 85.775122432e9
+# tools/measure_flops.py bert @ bs {8,16}, seq 128, bf16, LAMB (post the
+# r5 logsumexp-CE rewrite):
+# flops = 85.763e9 * batch + 3.061e9 (6*N*T sanity: 6*110e6*128 = 84.5e9 ✓)
+_FLOPS_BERT_SEQ_128 = 85.763407872e9
 _FLOPS_BERT_CONST = 3.060924416e9
-# same derivation @ bs {4,8}, seq 512 (the attention-quadratic term shows:
-# 4x tokens -> 4.26x flops)
-_FLOPS_BERT_SEQ_512 = 365.325811712e9
-_FLOPS_BERT_512_CONST = 3.045588992e9
+# same derivation @ bs {4,8}, seq 512, post-rewrite (the attention-
+# quadratic term shows: 4x tokens -> 4.26x flops)
+_FLOPS_BERT_SEQ_512 = 365.279281152e9
+_FLOPS_BERT_512_CONST = 3.044016128e9
 # tools/measure_flops.py widedeep @ bs {8,16}, vocab 100k x 26, dim 16:
 # flops = 909520 * batch + 220.37e6 (const = full-table optimizer scan)
 _FLOPS_WD_EXAMPLE = 909520.0

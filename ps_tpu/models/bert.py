@@ -18,6 +18,7 @@ import dataclasses
 from typing import Any, Optional
 
 import flax.linen as nn
+import jax
 import jax.numpy as jnp
 import numpy as np
 
@@ -153,13 +154,20 @@ class BertMLM(nn.Module):
 
 def mlm_loss(logits, labels, ignore_index: int = -100):
     """Mean cross-entropy over masked positions only (labels == ignore_index
-    elsewhere, matching the data generator's contract)."""
+    elsewhere, matching the data generator's contract).
+
+    Logsumexp form: ``ce = lse(logits) - logits[label]`` instead of
+    gathering from a materialized log_softmax — the [B, S, V] f32
+    log-probability tensor (2 GB at bench shapes) never exists; the
+    vocab axis is consumed by a fused reduction. Same math to fp
+    tolerance (tests/test_bert.py pins it)."""
     valid = labels != ignore_index
     safe_labels = jnp.where(valid, labels, 0)
-    logp = nn.log_softmax(logits.astype(jnp.float32), axis=-1)
-    token_logp = jnp.take_along_axis(logp, safe_labels[..., None], axis=-1)[..., 0]
+    lse = jax.nn.logsumexp(logits.astype(jnp.float32), axis=-1)
+    tok = jnp.take_along_axis(logits, safe_labels[..., None], axis=-1)
+    ce = lse - tok[..., 0].astype(jnp.float32)
     n = jnp.maximum(valid.sum(), 1)
-    return -(token_logp * valid).sum() / n
+    return (ce * valid).sum() / n
 
 
 def make_mlm_loss_fn(model):

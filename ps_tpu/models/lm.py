@@ -220,9 +220,7 @@ def make_pipelined_loss_fn(*, n_heads: int, num_stages: int,
         h = pipe_fn(params["stages"], microbatch(x, microbatches))
         h = h.reshape((-1,) + h.shape[2:])             # [B, T, D]
         logits = readout_apply(params, h)
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
-        ll = jnp.take_along_axis(logp, batch["targets"][..., None], -1)[..., 0]
-        return -jnp.mean(ll)
+        return token_ce(logits, batch["targets"])
 
     return loss_fn
 
@@ -235,11 +233,17 @@ def make_loss_fn(*, n_heads: int, attn_fn: Callable = _full_attention):
     def loss_fn(params, batch):
         logits = apply(params, batch["inputs"], n_heads=n_heads,
                        attn_fn=attn_fn)
-        logp = jax.nn.log_softmax(logits.astype(jnp.float32), -1)
-        ll = jnp.take_along_axis(logp, batch["targets"][..., None], -1)[..., 0]
-        return -jnp.mean(ll)
+        return token_ce(logits, batch["targets"])
 
     return loss_fn
+
+
+def token_ce(logits, targets):
+    """Mean next-token CE in logsumexp form — no [B, T, V] f32
+    log-probability tensor is materialized (see bert.mlm_loss)."""
+    lse = jax.nn.logsumexp(logits.astype(jnp.float32), -1)
+    tok = jnp.take_along_axis(logits, targets[..., None], -1)[..., 0]
+    return jnp.mean(lse - tok.astype(jnp.float32))
 
 
 def lm_batches(batch_size: int, seq_len: int, *, vocab: int = 256,

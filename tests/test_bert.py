@@ -55,6 +55,23 @@ def test_mlm_loss_masks_ignore_index():
     assert float(mlm_loss(logits, jnp.asarray([[-100, -100]]))) == 0.0
 
 
+def test_mlm_loss_logsumexp_form_equals_log_softmax_form():
+    """The r5 byte-stream rewrite (lse - logits[label], no materialized
+    [B, S, V] f32 log-probs) is the same math as the log_softmax gather."""
+    rng = np.random.default_rng(0)
+    logits = jnp.asarray(rng.normal(0, 3, (2, 16, 50)).astype(np.float32))
+    labels = np.where(rng.random((2, 16)) < 0.3,
+                      rng.integers(0, 50, (2, 16)), -100).astype(np.int32)
+    labels = jnp.asarray(labels)
+    valid = labels != -100
+    logp = jax.nn.log_softmax(logits, -1)
+    tok = jnp.take_along_axis(logp, jnp.where(valid, labels, 0)[..., None],
+                              -1)[..., 0]
+    reference = -(tok * valid).sum() / jnp.maximum(valid.sum(), 1)
+    np.testing.assert_allclose(float(mlm_loss(logits, labels)),
+                               float(reference), rtol=1e-6)
+
+
 def test_attention_mask_blocks_padding():
     model, params, batch = _tiny_model_and_batch(batch_size=2, seq_len=16)
     full = model.apply({"params": params}, batch["input_ids"], batch["attention_mask"])
