@@ -297,6 +297,29 @@ def test_stop_drains_inflight_reply():
     ps.shutdown()
 
 
+def test_wait_for_goodbyes_times_out_false():
+    """The quiescence wait reports timeout as False (not an exception),
+    and counts goodbyes exactly once per worker SHUTDOWN."""
+    import jax.numpy as jnp
+
+    from ps_tpu.backends.remote_async import AsyncPSService, RemoteAsyncWorker
+
+    params = {"w": jnp.zeros((4, 4))}
+    ps.init(backend="tpu", mode="async", num_workers=2)
+    store = ps.KVStore(optimizer="sgd", learning_rate=0.1, mode="async")
+    store.init(params)
+    svc = AsyncPSService(store, bind="127.0.0.1")
+    w0 = RemoteAsyncWorker("127.0.0.1", svc.port, 0, params)
+    w0.pull_all()
+    assert svc.wait_for_goodbyes(1, timeout=0.2) is False  # nobody left yet
+    w0.close()
+    assert svc.wait_for_goodbyes(1, timeout=10) is True
+    assert svc.goodbyes == 1
+    assert svc.wait_for_goodbyes(2, timeout=0.2) is False  # worker 1 never came
+    svc.stop()
+    ps.shutdown()
+
+
 def test_idle_client_survives_slow_cadence():
     """Regression (r3): the accepted fd inherited the listener's 200ms
     accept-poll SO_RCVTIMEO on Linux, so any client thinking for longer

@@ -1,0 +1,54 @@
+"""The measurement tools run end to end at toy scale.
+
+BASELINE.md's numbers come from tools/ scripts; a refactor that breaks one
+should fail here, not when someone tries to reproduce a measurement.
+Each runs as a subprocess at the smallest meaningful scale and must emit
+its one-line JSON.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(script, *args, timeout=420):
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "tools", script), *args],
+        env=env, capture_output=True, text=True, timeout=timeout,
+    )
+    assert proc.returncode == 0, f"{script}:\n{proc.stdout}\n{proc.stderr}"
+    return json.loads(proc.stdout.strip().splitlines()[-1])
+
+
+@pytest.mark.slow
+def test_bench_van_smoke():
+    out = _run("bench_van.py", "--mb", "2", "--cycles", "1", "--workers", "2")
+    assert out["tree_mb"] > 1 and out["pull_gbps"] > 0
+    assert "concurrent_pull_2w_gbps" in out
+
+
+@pytest.mark.slow
+def test_bench_dc_asgd_smoke():
+    out = _run("bench_dc_asgd.py", "--applies", "12", "--eval-every", "6",
+               "--hidden", "8", "--batch", "16")
+    assert len(out["sync_curve"]) == 2
+    # 3 tau values x 2 lambdas
+    assert len(out["configs"]) == 6
+    for cfg in out["configs"]:
+        assert len(cfg["curve"]) == 2
+        assert sum(cfg["staleness_hist"].values()) == 12
+
+
+@pytest.mark.slow
+def test_measure_flops_smoke():
+    out = _run("measure_flops.py", "widedeep")
+    assert out["model"] == "widedeep"
+    assert out["slope_per_example"] > 0 and out["const_per_step"] > 0
