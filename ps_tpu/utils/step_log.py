@@ -2,7 +2,9 @@
 
 Reference status unknown (SURVEY.md §6 "Metrics/logging"); the build target
 is a structured per-step record (step, loss, examples/sec, GB/s) as fixed-
-format console lines plus an optional JSONL file for machine consumption.
+format console lines, an optional JSONL file for machine consumption, and
+optional TensorBoard scalars (via the installed tensorflow's tf.summary —
+gated, never a hard dependency).
 """
 
 from __future__ import annotations
@@ -14,31 +16,48 @@ from typing import IO, Optional
 
 class StepLogger:
     """Prints aligned step lines every ``every`` steps and optionally appends
-    every record to a JSONL file.
+    every record to a JSONL file and/or a TensorBoard event file.
 
     Usage::
 
-        log = StepLogger(every=10, jsonl="run.jsonl")
+        log = StepLogger(every=10, jsonl="run.jsonl", tensorboard="tb/run1")
         ...
         log.log(step, loss=float(loss), **metrics.summary())
     """
 
     def __init__(self, every: int = 10, jsonl: Optional[str] = None,
-                 stream: IO = sys.stdout):
+                 tensorboard: Optional[str] = None, stream: IO = sys.stdout):
         self.every = max(int(every), 1)
         self.stream = stream
         self._jsonl: Optional[IO] = open(jsonl, "a") if jsonl else None
+        self._tb = None
+        self._tf = None
+        if tensorboard:
+            try:
+                import tensorflow as tf  # installed in this image; optional
+
+                self._tf = tf
+                self._tb = tf.summary.create_file_writer(tensorboard)
+            except Exception as e:  # noqa: BLE001 — degrade, don't crash
+                print(f"StepLogger: tensorboard disabled ({e!r})",
+                      file=sys.stderr)
 
     def wants(self, step: int) -> bool:
         """True when a record for this step would be printed or written —
         lets callers skip host-device syncs (e.g. ``float(loss)``) on steps
         that produce no output."""
-        return self._jsonl is not None or step % self.every == 0
+        return (self._jsonl is not None or self._tb is not None
+                or step % self.every == 0)
 
     def log(self, step: int, **fields) -> None:
         if self._jsonl is not None:
             self._jsonl.write(json.dumps({"step": step, **fields}) + "\n")
             self._jsonl.flush()
+        if self._tb is not None:
+            with self._tb.as_default():
+                for k, v in fields.items():
+                    if isinstance(v, (int, float)):
+                        self._tf.summary.scalar(k, v, step=step)
         if step % self.every == 0:
             parts = [f"step {step:6d}"]
             for k, v in fields.items():
@@ -52,6 +71,9 @@ class StepLogger:
         if self._jsonl is not None:
             self._jsonl.close()
             self._jsonl = None
+        if self._tb is not None:
+            self._tb.close()
+            self._tb = None
 
     def __enter__(self):
         return self

@@ -51,6 +51,26 @@ def test_step_logger_jsonl(tmp_path):
     assert records == [{"step": 0, "loss": 2.5}, {"step": 1, "loss": 2.25}]
 
 
+def test_step_logger_tensorboard(tmp_path):
+    """Optional TB scalars (SURVEY.md §6): event file written, numeric
+    fields become scalars, non-numeric skipped, close() flushes."""
+    import glob
+    import os
+
+    import pytest
+
+    pytest.importorskip("tensorflow")  # the sink is optional by contract
+    from ps_tpu.utils.step_log import StepLogger
+
+    tb = str(tmp_path / "tb")
+    log = StepLogger(every=1, tensorboard=tb)
+    log.log(0, loss=1.5, note="skipped-non-numeric")
+    log.log(1, loss=1.2)
+    log.close()
+    events = glob.glob(os.path.join(tb, "events.*"))
+    assert len(events) == 1 and os.path.getsize(events[0]) > 0
+
+
 def test_trace_noop():
     with trace(None):
         pass
