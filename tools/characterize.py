@@ -16,9 +16,18 @@ import argparse
 import glob
 import json
 import os
+import sys
 import time
 
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
 import jax
+
+if os.environ.get("JAX_PLATFORMS"):
+    # the image preloads jax pinned to the TPU platform; the env var must
+    # win here so CPU smoke runs (tests/test_tools.py) measure on CPU
+    jax.config.update("jax_platforms", os.environ["JAX_PLATFORMS"])
+
 import jax.numpy as jnp
 
 import ps_tpu as ps
