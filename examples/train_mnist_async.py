@@ -86,6 +86,19 @@ def main():
                          "N-server partition (or env PS_SERVER_URIS / "
                          "PS_ASYNC_SERVER_URI)")
     ap.add_argument("--worker-id", type=int, default=cfg.worker_id)
+    ap.add_argument("--bucket-bytes", type=int,
+                    default=cfg.bucket_bytes or 0,
+                    help="worker: fusion-bucket size for the bucketed/"
+                         "pipelined transport (0 = serial transport; or "
+                         "env PS_BUCKET_BYTES)")
+    ap.add_argument("--pool", type=int, default=cfg.transport_pool,
+                    help="worker: striped connections per server for the "
+                         "bucketed transport (env PS_TRANSPORT_POOL)")
+    ap.add_argument("--overlap", action="store_true",
+                    help="worker: run each push/pull cycle in the "
+                         "background (requires --bucket-bytes); gradients "
+                         "are still computed against exactly the serial "
+                         "step's params")
     ap.add_argument("--shard", type=int, default=cfg.shard,
                     help="server: this server's index in an N-server key "
                          "partition (or env PS_SHARD)")
@@ -102,8 +115,12 @@ def main():
                              "(or PS_ASYNC_SERVER_URI)")
         from ps_tpu.utils import TrainMetrics
 
-        w = ps.connect_async(uri, args.worker_id, params)
-        run = w.make_async_step(loss_fn)
+        w = ps.connect_async(
+            uri, args.worker_id, params,
+            bucket_bytes=args.bucket_bytes or None,
+            pool_size=args.pool if args.bucket_bytes else None,
+        )
+        run = w.make_async_step(loss_fn, overlap=args.overlap)
         log = StepLogger(every=10)
         # the remote worker carries the same byte-counter surface as
         # KVStore, so TrainMetrics reports push/pull GB/s — here those are
@@ -122,10 +139,17 @@ def main():
                 metrics.step(loss)
             if log.wants(step):
                 log.log(step, loss=float(loss), version=w.version)
+        if args.overlap:
+            w.flush()  # land the final background cycle before reporting
         s = metrics.summary()
         print(f"worker {args.worker_id}: done at server version {w.version}; "
               f"wire push {s['push_gb']:.4f} GB / pull {s['pull_gb']:.4f} GB "
               f"({s['push_pull_gbps']:.3f} GB/s)")
+        if "overlap_efficiency" in s:
+            print(f"worker {args.worker_id}: overlap efficiency "
+                  f"{s['overlap_efficiency']:.2f} "
+                  f"({s['transport_hidden_s']:.2f}s of transport hidden "
+                  f"under compute)")
         w.close()
         return
 

@@ -8,10 +8,385 @@ break that parity.
 
 from __future__ import annotations
 
+import queue
+import threading
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
+
 import jax
+import numpy as np
 import optax
 
+from ps_tpu.control import tensor_van as tv
 from ps_tpu.optim.dc import delay_compensate
+from ps_tpu.utils.metrics import TransportStats
+
+class ServerFailureError(RuntimeError):
+    """A remote PS server died mid-job (its connection failed)."""
+
+
+#: Default fusion-bucket size for the pipelined transport. ~4 MiB is the
+#: ps-lite/BytePS sweet spot: large enough that per-message overhead (json
+#: meta, syscalls) is noise, small enough that many buckets are in flight
+#: per tree and the pipeline has something to overlap.
+DEFAULT_BUCKET_BYTES = 4 << 20
+
+# one bucket slice: (key, dtype_str, shape, lo, hi) — byte range [lo, hi)
+# within the key's contiguous row-major buffer
+Slice = Tuple[str, str, list, int, int]
+
+
+class BucketPlan:
+    """Slice a flat ``{key: tensor}`` payload into fixed-size fusion buckets.
+
+    Keys are packed greedily in transport order (sorted — for slash-joined
+    layer paths that is front-of-model first, which is the order the next
+    step's forward needs them). A tensor larger than ``bucket_bytes`` is
+    split across consecutive buckets; small tensors fuse into one bucket.
+    Every bucket except the last holds exactly ``bucket_bytes`` payload
+    bytes, so striping buckets round-robin over a connection pool balances
+    it by construction.
+
+    The encoded frame (:meth:`encode_bucket`) is self-describing: its
+    ``extra["slices"]`` table carries (key, dtype, shape, lo, hi) per
+    slice, so the receiving side reassembles with :class:`BucketAssembler`
+    without any prior shape knowledge — worker and server never need to
+    agree on a plan out of band.
+    """
+
+    def __init__(self, specs: Sequence[Tuple[str, str, list, int]],
+                 bucket_bytes: int = DEFAULT_BUCKET_BYTES):
+        """``specs``: ``(key, dtype_str, shape, nbytes)`` in transport order."""
+        self.bucket_bytes = max(int(bucket_bytes), 1)
+        buckets: List[List[Slice]] = []
+        cur: List[Slice] = []
+        fill = 0
+        for key, dt, shape, nbytes in specs:
+            shape = list(shape)
+            if nbytes == 0:
+                # zero-size tensors still travel (the key must appear)
+                cur.append((key, dt, shape, 0, 0))
+                continue
+            off = 0
+            while off < nbytes:
+                if fill >= self.bucket_bytes:
+                    buckets.append(cur)
+                    cur, fill = [], 0
+                take = min(nbytes - off, self.bucket_bytes - fill)
+                cur.append((key, dt, shape, off, off + take))
+                off += take
+                fill += take
+        buckets.append(cur)  # last (possibly empty for an empty payload)
+        self.buckets = buckets
+        self.total_bytes = sum(n for _, _, _, n in specs)
+
+    @classmethod
+    def from_arrays(cls, arrays: Dict[str, np.ndarray],
+                    bucket_bytes: int = DEFAULT_BUCKET_BYTES,
+                    order: Optional[Sequence[str]] = None) -> "BucketPlan":
+        keys = list(order) if order is not None else sorted(arrays)
+        specs = []
+        for k in keys:
+            a = np.asarray(arrays[k])
+            specs.append((k, a.dtype.str, list(a.shape), a.nbytes))
+        return cls(specs, bucket_bytes)
+
+    @property
+    def nbuckets(self) -> int:
+        return len(self.buckets)
+
+    def encode_bucket(self, kind: int, worker: int,
+                      arrays: Dict[str, np.ndarray], b: int,
+                      extra: Optional[dict] = None) -> bytearray:
+        """Frame bucket ``b``: each slice's bytes are a ``memoryview`` of
+        the live tensor, copied exactly once into the frame
+        (:func:`~ps_tpu.control.tensor_van.encode_chunks`)."""
+        chunks = []
+        slices = self.buckets[b]
+        for key, _, _, lo, hi in slices:
+            a = np.ascontiguousarray(np.asarray(arrays[key]))
+            chunks.append(memoryview(a.reshape(-1)).cast("B")[lo:hi])
+        meta = {**(extra or {}),
+                "bucket": b, "nbuckets": self.nbuckets,
+                "slices": [[k, dt, shape, lo, hi]
+                           for k, dt, shape, lo, hi in slices]}
+        return tv.encode_chunks(kind, worker, chunks, meta)
+
+
+class BucketAssembler:
+    """Reassemble a multi-bucket payload; a torn epoch is never observable.
+
+    Buckets may arrive in any order (they are striped over a connection
+    pool). Every slice carries the push epoch it belongs to; a slice from a
+    different epoch is refused (the per-key epoch tag — a straggler bucket
+    of an aborted push can never contaminate a later tree), a duplicate
+    bucket is refused, and :meth:`finish` refuses any key whose byte
+    coverage is incomplete. Only when all ``nbuckets`` buckets of ONE epoch
+    have landed does :meth:`add` report completion — the caller applies the
+    assembled tree atomically, so readers observe whole pushes or nothing.
+    """
+
+    def __init__(self, epoch: int, nbuckets: int):
+        self.epoch = int(epoch)
+        self.nbuckets = int(nbuckets)
+        self._seen: set = set()
+        self._flat: Dict[str, np.ndarray] = {}    # key -> uint8 buffer
+        self._meta: Dict[str, Tuple[str, list, int]] = {}
+        self._filled: Dict[str, int] = {}
+        self._key_epoch: Dict[str, int] = {}
+
+    def add(self, bucket: int, raw, slices, epoch: Optional[int] = None
+            ) -> bool:
+        """Stage one bucket; returns True when the epoch is complete."""
+        if epoch is not None and int(epoch) != self.epoch:
+            raise RuntimeError(
+                f"bucket of epoch {epoch} offered to assembler of epoch "
+                f"{self.epoch} — torn multi-bucket push refused"
+            )
+        b = int(bucket)
+        if not (0 <= b < self.nbuckets):
+            raise RuntimeError(f"bucket {b} out of range 0..{self.nbuckets-1}")
+        if b in self._seen:
+            raise RuntimeError(f"duplicate bucket {b} for epoch {self.epoch}")
+        raw = np.frombuffer(raw, np.uint8) if not isinstance(raw, np.ndarray) \
+            else raw.reshape(-1).view(np.uint8)
+        off = 0
+        for key, dt, shape, lo, hi in slices:
+            if key not in self._flat:
+                nbytes = (int(np.prod(shape, dtype=np.int64))
+                          * np.dtype(dt).itemsize)
+                self._flat[key] = np.empty(nbytes, np.uint8)
+                self._meta[key] = (dt, list(shape), nbytes)
+                self._filled[key] = 0
+                self._key_epoch[key] = self.epoch
+            n = hi - lo
+            self._flat[key][lo:hi] = raw[off:off + n]
+            self._filled[key] += n
+            off += n
+        self._seen.add(b)
+        return len(self._seen) == self.nbuckets
+
+    def finish(self) -> Dict[str, np.ndarray]:
+        """The assembled ``{key: tensor}`` tree (buffers owned by the
+        assembler's own allocations — safe to hold past frame lifetimes)."""
+        if len(self._seen) != self.nbuckets:
+            raise RuntimeError(
+                f"epoch {self.epoch} incomplete: {len(self._seen)}/"
+                f"{self.nbuckets} buckets"
+            )
+        out = {}
+        for key, (dt, shape, nbytes) in self._meta.items():
+            if self._filled[key] != nbytes:
+                raise RuntimeError(
+                    f"key {key!r} torn: {self._filled[key]}/{nbytes} bytes "
+                    f"in epoch {self.epoch}"
+                )
+            out[key] = self._flat[key].view(np.dtype(dt)).reshape(shape)
+        return out
+
+
+class ChannelPump:
+    """One persistent transport connection + its dedicated sender thread.
+
+    The background half of the pipelined transport: callers ``submit``
+    encoded frames and immediately get a Future for the reply; the pump
+    thread drains the queue in FIFO order over its own
+    :class:`~ps_tpu.control.tensor_van.Channel` (one driving thread per
+    channel, as the van requires). Striping a plan's buckets round-robin
+    over a pool of pumps gives per-server send/recv parallelism — the
+    native sends release the GIL, so pumps genuinely overlap.
+    """
+
+    def __init__(self, ch, on_io: Optional[Callable] = None):
+        import concurrent.futures  # noqa: F401  (Future class used below)
+
+        self._ch = ch
+        self._on_io = on_io  # (bytes_out, bytes_in, seconds) per request
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._closed = False
+        self._t = threading.Thread(target=self._loop, daemon=True)
+        self._t.start()
+
+    def submit(self, payload):
+        import concurrent.futures
+
+        fut = concurrent.futures.Future()
+        if self._closed:
+            # fail fast instead of queueing behind a dead thread — a caller
+            # racing close() (e.g. a background cycle during reconnect)
+            # gets a connection-shaped error, never a forever-pending future
+            fut.set_exception(tv.VanError("pump closed"))
+            return fut
+        self._q.put((payload, fut))
+        return fut
+
+    def _loop(self) -> None:
+        import time
+
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            payload, fut = item
+            if not fut.set_running_or_notify_cancel():
+                continue
+            t0 = time.perf_counter()
+            try:
+                reply = self._ch.request(payload)
+            except BaseException as e:  # surfaced at the caller's wait
+                fut.set_exception(e)
+                continue
+            dt = time.perf_counter() - t0
+            if self._on_io is not None:
+                try:
+                    self._on_io(len(payload), len(reply), dt)
+                except Exception:
+                    pass  # accounting must never fail the transport
+            fut.set_result(reply)
+
+    def close(self) -> None:
+        """Stop the thread (after the queue drains) and close the channel.
+        Requests that slipped in behind the stop sentinel are failed, never
+        left as forever-pending futures."""
+        self._closed = True
+        self._q.put(None)
+        self._t.join(timeout=10)
+        while True:
+            try:
+                item = self._q.get_nowait()
+            except queue.Empty:
+                break
+            if item is not None:
+                item[1].set_exception(tv.VanError("pump closed"))
+        self._ch.close()
+
+
+class BucketedTransportMixin:
+    """Worker-side plumbing of the bucketed/pipelined transport, shared by
+    the dense and sparse remote workers: pump-pool lifecycle, byte/timing
+    accounting, background-handle bookkeeping, and the flush barrier.
+
+    Contract: the concrete worker sets ``_addrs``, ``_bytes_lock``,
+    ``bytes_pushed``/``bytes_pulled`` and calls :meth:`_init_transport`
+    during its init, then :meth:`_open_pumps` once its channels are
+    validated; it may override ``_failure_noun`` for error messages.
+    """
+
+    _failure_noun = "PS server"
+
+    def _init_transport(self, bucket_bytes: Optional[int],
+                        pool_size: Optional[int]) -> None:
+        import uuid
+
+        # <= 0 selects the serial transport, matching the PS_BUCKET_BYTES=0
+        # convention everywhere (a literal 0 must never mean 1-byte buckets)
+        self.bucket_bytes = (None if bucket_bytes is None
+                             or int(bucket_bytes) <= 0 else int(bucket_bytes))
+        # incarnation nonce, sent with every push bucket: a restarted (or
+        # reconnected) worker reuses epoch NUMBERS from zero, so the server
+        # must never complete a staged epoch of a dead incarnation with
+        # buckets from a new one — the nonce makes the two distinguishable
+        self._transport_nonce = uuid.uuid4().hex[:12]
+        self.pool_size = max(int(pool_size), 1) if pool_size is not None \
+            else (2 if self.bucket_bytes is not None else 1)
+        self.transport = TransportStats()
+        self._push_epoch = 0
+        self._pull_epoch = 0
+        self._pumps: Dict[int, List[ChannelPump]] = {}
+        self._bg_pool = None                    # background cycle orchestrator
+        self._pending_cycles: List = []         # unobserved background handles
+
+    def _open_pumps(self, indices) -> None:
+        """Dial ``pool_size`` extra transport connections per server; the
+        main channels stay free for control traffic (stats, checkpoints)."""
+        for i in indices:
+            host, port = self._addrs[i]
+            self._pumps[i] = [
+                ChannelPump(tv.Channel.connect(host, port),
+                            on_io=self._on_pump_io)
+                for _ in range(self.pool_size)
+            ]
+
+    def _on_pump_io(self, sent: int, received: int, seconds: float) -> None:
+        with self._bytes_lock:
+            self.bytes_pushed += sent
+            self.bytes_pulled += received
+        self.transport.record_bucket(sent + received, seconds)
+
+    def _close_transport(self) -> None:
+        """Tear down pumps + orchestrator; safe on a partial construction."""
+        if getattr(self, "_bg_pool", None) is not None:
+            self._bg_pool.shutdown(wait=False)
+            self._bg_pool = None
+        for pumps in getattr(self, "_pumps", {}).values():
+            for p in pumps:
+                p.close()
+        self._pumps = {}
+
+    def _bg_executor(self):
+        """The (lazily created) single background thread that runs whole
+        transport cycles — ONE thread, so cycles serialize per worker and
+        the per-worker push/pull order the staleness bound rests on is
+        exactly the serial order."""
+        if self._bg_pool is None:
+            import concurrent.futures
+
+            self._bg_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="ps-transport"
+            )
+        return self._bg_pool
+
+    def _bucket_reply(self, i: int, fut):
+        """Resolve one pump future, mapping channel death to the same typed
+        failure the serial path raises."""
+        try:
+            return fut.result()
+        except tv.VanError as e:
+            host, port = self._addrs[i]
+            raise ServerFailureError(
+                f"{self._failure_noun} {i} ({host}:{port}) failed "
+                f"mid-job: {e}"
+            ) from e
+
+    def _track_pending(self, pending) -> None:
+        """Register a background handle for flush(). Handles that resolved
+        cleanly — or whose failure was already delivered through a wait() —
+        are pruned here, so a long overlap run does not pin one params tree
+        per step and a failure surfaces exactly once; failed-but-unobserved
+        handles are kept for flush() to surface."""
+        self._pending_cycles = [
+            c for c in self._pending_cycles
+            if not c.done() or (c._exc is not None
+                                and not getattr(c, "_observed", False))
+        ]
+        self._pending_cycles.append(pending)
+
+    def flush(self) -> None:
+        """Barrier: wait until every background cycle has fully landed
+        (pushes applied server-side AND any pulls merged), re-raising the
+        first failure. After flush() the worker is in exactly the state a
+        serial caller would be in — this is what preserves sync-SGD
+        semantics for trainers that overlap."""
+        cycles, self._pending_cycles = self._pending_cycles, []
+        err = None
+        for c in cycles:
+            if getattr(c, "_observed", False):
+                continue  # this failure was already delivered via wait()
+            try:
+                c.wait()
+            except BaseException as e:  # noqa: BLE001 — re-raised below
+                err = err or e
+        if err is not None:
+            raise err
+
+    def _saved_transport_state(self) -> tuple:
+        """Snapshot the identity that must survive a reconnect: cumulative
+        wire counters, transport stats, and the push/pull epoch streams."""
+        return (self.bytes_pushed, self.bytes_pulled, self.collective_bytes,
+                self.transport, self._push_epoch, self._pull_epoch)
+
+    def _restore_transport_state(self, saved: tuple) -> None:
+        (self.bytes_pushed, self.bytes_pulled, self.collective_bytes,
+         self.transport, self._push_epoch, self._pull_epoch) = saved
 
 
 def make_jit_dc_apply_tree(opt: optax.GradientTransformation):

@@ -31,17 +31,27 @@ about the math.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ps_tpu.backends.van_service import VanService
+from ps_tpu.backends.common import (
+    DEFAULT_BUCKET_BYTES,
+    BucketAssembler,
+    BucketedTransportMixin,
+    BucketPlan,
+    ServerFailureError,
+)
+from ps_tpu.backends.van_service import VanService, resolve_ckpt_dir
 from ps_tpu.control import tensor_van as tv
 from ps_tpu.kv import keys as keymod
+from ps_tpu.utils.metrics import TransportStats
 
-
-class ServerFailureError(RuntimeError):
-    """A remote async PS server died mid-job (its connection failed)."""
+__all__ = [
+    "AsyncPSService", "RemoteAsyncWorker", "ServerFailureError",
+    "serve_async", "connect_async", "shard_tree", "PendingCycle",
+]
 
 
 def shard_tree(params_like, shard: int, num_shards: int) -> Dict[str, Any]:
@@ -76,11 +86,16 @@ class AsyncPSService(VanService):
         keys are validated against the ``shard_for_key`` assignment at
         construction and advertised to workers in the HELLO reply so a
         misconfigured topology fails loudly at connect time.
+      ckpt_root: when set, CHECKPOINT saves resolve the client-supplied dir
+        UNDER this root (absolute paths and ``..`` escapes refused) — the
+        unauthenticated endpoint can then never write outside it. None
+        keeps the legacy client-names-the-path behavior (loopback only).
     """
 
     def __init__(self, store, port: int = 0, bind: str = "127.0.0.1",
                  shard: Optional[int] = None,
-                 num_shards: Optional[int] = None):
+                 num_shards: Optional[int] = None,
+                 ckpt_root: Optional[str] = None):
         engine = store._engine
         if getattr(engine, "mode", "sync") != "async":
             raise ValueError("AsyncPSService requires an async-mode KVStore")
@@ -109,6 +124,16 @@ class AsyncPSService(VanService):
         # cross-shard-atomicity protocol these implement
         self._paused = False
         self._pause_cond = threading.Condition(engine._lock)
+        # checkpoint ownership token bookkeeping lives in VanService
+        # (_ckpt_issue_token / _ckpt_token_error): pause hands out a token;
+        # drain_to/save/resume must present it, so two concurrent
+        # checkpoint_all coordinators cannot interleave
+        self._ckpt_root = ckpt_root
+        # bucketed-pull snapshot cache: worker -> one pulled tree awaiting
+        # its remaining bucket requests (per-bucket frames encoded lazily
+        # on the serve thread that asks — pool channels parallelize the
+        # encode)
+        self._pull_cache: Dict[int, dict] = {}
         self._applied: Dict[int, int] = {}   # per-worker applied pushes
         self._drain_targets: Dict[int, int] = {}
         self._log_lock = threading.Lock()
@@ -137,16 +162,23 @@ class AsyncPSService(VanService):
         host = {k: np.asarray(v) for k, v in kv.items()}
         return tv.encode(tv.OK, worker, host, extra={"version": version})
 
-    def _apply_push(self, worker: int, grads: Dict[str, np.ndarray]) -> None:
+    def _apply_push(self, worker: int, grads: Dict[str, np.ndarray],
+                    copy: bool = True) -> None:
         if sorted(grads) != sorted(self._key_order):
             raise KeyError("push keys do not match the registered tree")
-        # copy out of the recv buffer: the engine may keep references beyond
-        # this frame's lifetime
-        grads = {k: np.array(v) for k, v in grads.items()}
+        if copy:
+            # copy out of the recv buffer: the engine may keep references
+            # beyond this frame's lifetime (bucket-assembled trees already
+            # own their buffers and skip this)
+            grads = {k: np.array(v) for k, v in grads.items()}
         with self._engine._lock:
             while (self._paused and not self._draining
                    and not self._admit_while_paused(worker)):
-                self._pause_cond.wait()  # a checkpoint snapshot is in flight
+                self._pause_wait_begin()
+                try:
+                    self._pause_cond.wait()  # checkpoint snapshot in flight
+                finally:
+                    self._pause_wait_end()
             if self._draining:
                 raise RuntimeError("server is draining; push refused")
             self._engine.push_tree(grads, worker=worker)
@@ -161,6 +193,74 @@ class AsyncPSService(VanService):
         for: this worker still lags its cross-shard target."""
         return (self._applied.get(worker, 0)
                 < self._drain_targets.get(worker, 0))
+
+    # -- bucketed transport (server half) -------------------------------------
+
+    def _bucket_push(self, worker: int, tensors, extra) -> bytes:
+        """One bucket of a multi-bucket push. Incomplete epochs only stage
+        (ack reply); the completing bucket applies the WHOLE assembled tree
+        atomically under the engine lock — a torn push is never observable,
+        and the commit reply carries the advanced version."""
+        tree = self._stage_bucket_push(
+            worker, int(extra["bucket"]), int(extra["nbuckets"]),
+            int(extra["epoch"]), tensors["raw"], extra["slices"],
+            nonce=extra.get("nonce"),
+        )
+        if tree is None:
+            return tv.encode(tv.OK, worker, None,
+                             extra={"staged": int(extra["bucket"])})
+        self._apply_push(worker, tree, copy=False)
+        return tv.encode(tv.OK, worker, None, extra={
+            "version": self._engine.version, "committed": True,
+        })
+
+    def _bucket_pull(self, worker: int, extra) -> bytes:
+        """Bucketed pull. Bucket 0 takes ONE atomic engine snapshot (same
+        lock discipline and event-log record as a serial PULL) and replies
+        with the front-of-model slices immediately; buckets 1..n-1 read the
+        cached snapshot, each encoded on its own serve thread — the pool
+        parallelizes the host-conversion + frame-encode that the serial
+        path runs end-to-end."""
+        epoch, b = int(extra["epoch"]), int(extra["bucket"])
+        if b == 0:
+            bb = int(extra.get("bucket_bytes") or DEFAULT_BUCKET_BYTES)
+            with self._engine._lock:
+                kv = self._engine.pull_tree(worker=worker)
+                version = self._engine.version
+                with self._log_lock:
+                    self.event_log.append(["pull", worker])
+            # contiguous host conversion ONCE; per-bucket encodes then slice
+            # it zero-copy (jax arrays convert contiguous, but be explicit)
+            host = {k: np.ascontiguousarray(np.asarray(v))
+                    for k, v in kv.items()}
+            plan = BucketPlan.from_arrays(host, bb, order=self._key_order)
+            with self._stage_lock:
+                if plan.nbuckets > 1:
+                    self._pull_cache[worker] = {
+                        "epoch": epoch, "host": host, "plan": plan,
+                        "version": version,
+                        "left": set(range(1, plan.nbuckets)),
+                    }
+                else:
+                    self._pull_cache.pop(worker, None)
+            return plan.encode_bucket(tv.OK, worker, host, 0, extra={
+                "epoch": epoch, "version": version,
+            })
+        with self._stage_lock:
+            entry = self._pull_cache.get(worker)
+            if (entry is None or entry["epoch"] != epoch
+                    or b not in entry["left"]):
+                return tv.encode(tv.ERR, worker, None, extra={
+                    "error": f"no cached pull snapshot for worker {worker} "
+                             f"epoch {epoch} bucket {b}",
+                })
+            entry["left"].discard(b)
+            if not entry["left"]:
+                self._pull_cache.pop(worker, None)
+        return entry["plan"].encode_bucket(
+            tv.OK, worker, entry["host"], b,
+            extra={"epoch": epoch, "version": entry["version"]},
+        )
 
     def _handle(self, kind: int, worker: int, tensors, extra) -> bytes:
         if kind == tv.HELLO:
@@ -181,6 +281,10 @@ class AsyncPSService(VanService):
         elif kind == tv.PUSH_PULL:
             self._apply_push(worker, tensors)
             return self._params_payload(worker)
+        elif kind == tv.BUCKET_PUSH:
+            return self._bucket_push(worker, tensors, extra)
+        elif kind == tv.BUCKET_PULL:
+            return self._bucket_pull(worker, extra)
         elif kind == tv.STATS:
             with self._log_lock:
                 log = list(self.apply_log)
@@ -215,18 +319,50 @@ class AsyncPSService(VanService):
         so an unlocked save could tear them), which stalls this server's
         traffic for the write's duration: the price of an atomic snapshot
         point, paid once per checkpoint cadence. The endpoint writes paths
-        on the server host and is unauthenticated — another reason
-        ``bind`` defaults to loopback."""
+        on the server host and is unauthenticated — ``ckpt_root`` confines
+        its filesystem reach, and ``bind`` defaults to loopback.
+
+        Ownership: ``pause`` hands the coordinator a token; every later
+        phase must present it. A second coordinator's pause while one is
+        outstanding is refused, and a resume/save without the live token is
+        refused — so concurrent ``checkpoint_all`` calls serialize instead
+        of silently tearing each other's snapshots. Recovery: if a
+        coordinator dies between pause and resume, an operator (or
+        supervisor) sends ``phase="resume", force=True`` — the one
+        deliberate override of the token, so a lost token can never wedge
+        the fleet permanently. (A service ``stop()`` also unwedges: its
+        draining flag wakes paused pushes into refusal.)"""
         import os
 
         phase = extra.get("phase", "save")
         if phase == "pause":
             with self._engine._lock:
+                token = self._ckpt_issue_token()
+                if token is None:
+                    return tv.encode(tv.ERR, worker, None,
+                                     extra={"error": self._ckpt_busy_error()})
                 self._paused = True
                 applied = {str(w): n for w, n in self._applied.items()}
             return tv.encode(tv.OK, worker, None, extra={
                 "version": self._engine.version, "applied": applied,
+                "token": token,
             })
+        if phase == "resume" and extra.get("force"):
+            # operator escape hatch: recover a fleet whose coordinator died
+            # holding the token (documented above); never used by the
+            # normal checkpoint_all protocol
+            with self._engine._lock:
+                self._paused = False
+                self._ckpt_clear_token()
+                self._pause_cond.notify_all()
+            return tv.encode(tv.OK, worker, None,
+                             extra={"version": self._engine.version,
+                                    "forced": True})
+        err = self._ckpt_token_error(phase, extra)
+        if err is not None:
+            # covers both a foreign coordinator racing a live checkpoint
+            # (wrong/absent token) and a straggler phase after resume
+            return tv.encode(tv.ERR, worker, None, extra={"error": err})
         if phase == "drain_to":
             # admit blocked/in-flight pushes until every worker reaches its
             # cross-shard target, then report back. TCP delivery of an
@@ -259,11 +395,13 @@ class AsyncPSService(VanService):
         if phase == "resume":
             with self._engine._lock:
                 self._paused = False
+                self._ckpt_clear_token()
                 self._pause_cond.notify_all()
             return tv.encode(tv.OK, worker, None,
                              extra={"version": self._engine.version})
-        path = (extra["dir"] if self.num_shards is None
-                else os.path.join(extra["dir"], f"shard{self.shard}"))
+        base = resolve_ckpt_dir(self._ckpt_root, extra["dir"])
+        path = (base if self.num_shards is None
+                else os.path.join(base, f"shard{self.shard}"))
         with self._engine._lock:
             self._store.save(path)
             version = self._engine.version
@@ -278,7 +416,8 @@ class AsyncPSService(VanService):
 
 def serve_async(store, port: int = 0, bind: str = "127.0.0.1",
                 shard: Optional[int] = None,
-                num_shards: Optional[int] = None) -> "AsyncPSService":
+                num_shards: Optional[int] = None,
+                ckpt_root: Optional[str] = None) -> "AsyncPSService":
     """Expose an initialized async KVStore to remote worker processes.
 
     The top-level entry of the cross-process async deployment: each server
@@ -291,12 +430,17 @@ def serve_async(store, port: int = 0, bind: str = "127.0.0.1",
     Single-server mode: ``store.init(params)`` with the full tree, no shard
     args. Multi-server mode (the reference's N-server topology): server
     ``s`` of ``N`` runs ``store.init(shard_tree(params, s, N))`` and
-    ``serve_async(store, ..., shard=s, num_shards=N)``."""
+    ``serve_async(store, ..., shard=s, num_shards=N)``. ``ckpt_root``
+    confines CHECKPOINT saves under a server-side root (recommended for
+    any non-loopback bind)."""
     return AsyncPSService(store, port=port, bind=bind,
-                          shard=shard, num_shards=num_shards)
+                          shard=shard, num_shards=num_shards,
+                          ckpt_root=ckpt_root)
 
 
-def connect_async(uri: str, worker: int, params_like) -> "RemoteAsyncWorker":
+def connect_async(uri: str, worker: int, params_like,
+                  bucket_bytes: Optional[int] = None,
+                  pool_size: Optional[int] = None) -> "RemoteAsyncWorker":
     """Join a cross-process async job as worker ``worker``.
 
     ``uri`` is ``host:port`` of the :func:`serve_async` process, or a
@@ -304,39 +448,125 @@ def connect_async(uri: str, worker: int, params_like) -> "RemoteAsyncWorker":
     N-server partition (also the form trainers read from
     ``PS_ASYNC_SERVER_URI``); ``params_like`` is a pytree with the model's
     parameter structure (used to validate the key partition against the
-    servers and to rebuild pulled params)."""
+    servers and to rebuild pulled params).
+
+    ``bucket_bytes`` switches the data plane to the bucketed, pipelined
+    transport (~4 MiB fusion buckets striped over ``pool_size`` persistent
+    connections per server; enables :meth:`RemoteAsyncWorker.
+    push_pull_async` compute/comm overlap). None keeps the serial
+    one-frame-per-cycle transport."""
     addrs = []
     for part in uri.split(","):
         host, port = part.strip().rsplit(":", 1)
         addrs.append((host, int(port)))
-    return RemoteAsyncWorker.connect_many(addrs, worker, params_like)
+    return RemoteAsyncWorker.connect_many(addrs, worker, params_like,
+                                          bucket_bytes=bucket_bytes,
+                                          pool_size=pool_size)
+
+
+class CheckpointRoundError(RuntimeError):
+    """A checkpoint phase was refused by at least one server. ``oks`` holds
+    the extras of the servers that DID accept the phase — a failed pause
+    still hands the coordinator the tokens it needs to resume them."""
+
+    def __init__(self, message: str, oks: Dict[int, dict]):
+        super().__init__(message)
+        self.oks = oks
 
 
 class CheckpointRoundsMixin:
     """One phase of the coordinated checkpoint protocol, fanned to every
     server — shared by the dense and sparse workers (both expose
-    ``_fanout``/``_chs``/``worker``). Raises on any non-OK reply, naming
-    the phase and server."""
+    ``_fanout``/``_chs``/``worker``). Raises :class:`CheckpointRoundError`
+    on any non-OK reply, naming the phase and server (and carrying the
+    successful servers' extras so cleanup can still target them).
 
-    def _checkpoint_round(self, payload_extra: dict) -> Dict[int, dict]:
-        msgs = self._fanout({
-            i: tv.encode(tv.CHECKPOINT, self.worker, None,
-                         extra=payload_extra)
-            for i in range(len(self._chs))
-        })
-        out = {}
+    ``per_server`` merges server-specific fields (the checkpoint ownership
+    token each server handed out at pause) into that server's payload.
+    """
+
+    def _checkpoint_round(self, payload_extra: dict,
+                          per_server: Optional[Dict[int, dict]] = None
+                          ) -> Dict[int, dict]:
+        payloads = {}
+        for i in range(len(self._chs)):
+            extra = dict(payload_extra)
+            if per_server and i in per_server:
+                extra.update(per_server[i])
+            payloads[i] = tv.encode(tv.CHECKPOINT, self.worker, None,
+                                    extra=extra)
+        msgs = self._fanout(payloads)
+        out, errs = {}, {}
         for i, msg in msgs.items():
             kind, _, _, extra = tv.decode(msg)
             if kind != tv.OK:
-                raise RuntimeError(
-                    f"server {i} checkpoint {payload_extra.get('phase')} "
-                    f"failed: {extra.get('error')}"
-                )
-            out[i] = extra
+                errs[i] = extra.get("error")
+            else:
+                out[i] = extra
+        if errs:
+            i, err = sorted(errs.items())[0]
+            raise CheckpointRoundError(
+                f"server {i} checkpoint {payload_extra.get('phase')} "
+                f"failed: {err}", out
+            )
         return out
 
+    def _ckpt_tokens(self, paused: Dict[int, dict]) -> Dict[int, dict]:
+        """Per-server ``{"token": ...}`` payload merge from pause replies."""
+        return {i: {"token": x["token"]} for i, x in paused.items()
+                if "token" in x}
 
-class RemoteAsyncWorker(CheckpointRoundsMixin):
+    def checkpoint_resume_force(self) -> None:
+        """Operator recovery: force-resume every server after a coordinator
+        died between pause and resume (the lost token would otherwise block
+        all pushes indefinitely). The one deliberate token override —
+        never call it while a live checkpoint_all is saving."""
+        self._checkpoint_round({"phase": "resume", "force": True})
+
+
+class PendingCycle:
+    """Handle for one background push→pull transport cycle.
+
+    Returned by :meth:`RemoteAsyncWorker.push_pull_async`: the caller keeps
+    computing (the next batch's forward, data loading, logging) while the
+    cycle's buckets move in the background; :meth:`wait` blocks until the
+    fresh params are in and returns them — the time actually spent blocked
+    is what the overlap-efficiency metric charges against transport time.
+    """
+
+    def __init__(self, stats: Optional[TransportStats] = None):
+        self._evt = threading.Event()
+        self._params = None
+        self._exc: Optional[BaseException] = None
+        self._observed = False  # failure delivered via wait() at least once
+        self._stats = stats
+
+    def _resolve(self, params) -> None:
+        self._params = params
+        self._evt.set()
+
+    def _fail(self, exc: BaseException) -> None:
+        self._exc = exc
+        self._evt.set()
+
+    def done(self) -> bool:
+        return self._evt.is_set()
+
+    def wait(self, timeout: Optional[float] = None):
+        """Block until the cycle lands; returns the freshly pulled params
+        (or re-raises the cycle's transport failure)."""
+        t0 = time.perf_counter()
+        if not self._evt.wait(timeout):
+            raise TimeoutError("transport cycle still in flight")
+        if self._stats is not None:
+            self._stats.record_blocked(time.perf_counter() - t0)
+        if self._exc is not None:
+            self._observed = True  # surfaced once; flush() won't re-raise it
+            raise self._exc
+        return self._params
+
+
+class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
     """A worker NODE of the cross-process async PS.
 
     Computes gradients on this process's own jax devices against the params
@@ -346,20 +576,39 @@ class RemoteAsyncWorker(CheckpointRoundsMixin):
     counts whole-subtree applies to its own key range); per-server values
     are in ``versions``. A failed server connection raises
     :class:`ServerFailureError` naming the server.
+
+    Transport: with ``bucket_bytes=None`` (default) each cycle is one
+    monolithic frame per server (the serial path). With ``bucket_bytes``
+    set, payloads are sliced into fixed-size fusion buckets
+    (:class:`~ps_tpu.backends.common.BucketPlan`) striped over
+    ``pool_size`` persistent connections per server, push/pull become
+    pipelined (:meth:`push_pull_async` runs the whole cycle in the
+    background while the caller computes), and :meth:`flush` is the
+    barrier that restores serial semantics on demand. Either way the
+    server applies whole trees atomically and records the same per-worker
+    event order, so the math — and the staleness bound — is identical.
     """
 
-    def __init__(self, host: str, port: int, worker: int, params_like):
-        self._init_multi([(host, int(port))], worker, params_like)
+    _failure_noun = "async PS server"
+
+    def __init__(self, host: str, port: int, worker: int, params_like,
+                 bucket_bytes: Optional[int] = None,
+                 pool_size: Optional[int] = None):
+        self._init_multi([(host, int(port))], worker, params_like,
+                         bucket_bytes=bucket_bytes, pool_size=pool_size)
 
     @classmethod
     def connect_many(cls, addrs: Sequence[Tuple[str, int]], worker: int,
-                     params_like) -> "RemoteAsyncWorker":
+                     params_like, bucket_bytes: Optional[int] = None,
+                     pool_size: Optional[int] = None) -> "RemoteAsyncWorker":
         self = cls.__new__(cls)
-        self._init_multi(list(addrs), worker, params_like)
+        self._init_multi(list(addrs), worker, params_like,
+                         bucket_bytes=bucket_bytes, pool_size=pool_size)
         return self
 
     def _init_multi(self, addrs: List[Tuple[str, int]], worker: int,
-                    params_like) -> None:
+                    params_like, bucket_bytes: Optional[int] = None,
+                    pool_size: Optional[int] = None) -> None:
         self.worker = worker
         kv, self._treedef = keymod.flatten_with_keys(params_like)
         # placeholders, not the arrays: reconnect() only needs keys +
@@ -381,6 +630,8 @@ class RemoteAsyncWorker(CheckpointRoundsMixin):
         self.bytes_pulled = 0   # reply bytes received (params + protocol)
         self.collective_bytes = 0  # no ICI on the van path, by definition
         self._bytes_lock = threading.Lock()  # _fanout drives _request concurrently
+        # bucketed transport config (None bucket_bytes = serial transport)
+        self._init_transport(bucket_bytes, pool_size)
         try:
             self._connect_and_validate(addrs, worker, kv)
         except Exception:
@@ -399,6 +650,14 @@ class RemoteAsyncWorker(CheckpointRoundsMixin):
             self._pool = concurrent.futures.ThreadPoolExecutor(
                 max_workers=len(self._active)
             )
+        if self.bucket_bytes is not None:
+            try:
+                self._open_pumps(self._active)
+            except Exception:
+                self._close_transport()
+                for ch in self._chs:
+                    ch.close()
+                raise
 
     def _connect_and_validate(self, addrs, worker, kv) -> None:
         n = len(addrs)
@@ -522,6 +781,9 @@ class RemoteAsyncWorker(CheckpointRoundsMixin):
     def pull_all(self) -> Any:
         """Fetch current params (each server records this worker's snapshot
         of its subtree)."""
+        if self.bucket_bytes is not None:
+            self.flush()
+            return self._merge_host_params(self._pull_buckets())
         return self._merge_params(self._fanout({
             i: tv.encode(tv.PULL, self.worker, None) for i in self._active
         }))
@@ -529,6 +791,10 @@ class RemoteAsyncWorker(CheckpointRoundsMixin):
     def push_all(self, grads) -> None:
         """Push a gradient tree; each owner applies its subtree immediately
         with the DC-ASGD correction against this worker's last pull from it."""
+        if self.bucket_bytes is not None:
+            self.flush()
+            self._push_buckets_sync(self._split_by_owner(grads))
+            return
         msgs = self._fanout({
             i: tv.encode(tv.PUSH, self.worker, sub)
             for i, sub in self._split_by_owner(grads).items()
@@ -541,11 +807,142 @@ class RemoteAsyncWorker(CheckpointRoundsMixin):
 
     def push_pull(self, grads) -> Any:
         """push_all + pull_all in ONE round trip per server (the async
-        cycle), all servers in flight concurrently."""
+        cycle), all servers in flight concurrently. Routed through the
+        bucketed pipeline when the worker was connected with
+        ``bucket_bytes`` (identical math — the server applies the same
+        whole tree and snapshots the same atomic pull)."""
+        if self.bucket_bytes is not None:
+            self.flush()  # a cycle racing a serial call would reorder epochs
+            self._push_buckets_sync(self._split_by_owner(grads))
+            return self._merge_host_params(self._pull_buckets())
         return self._merge_params(self._fanout({
             i: tv.encode(tv.PUSH_PULL, self.worker, sub)
             for i, sub in self._split_by_owner(grads).items()
         }))
+
+    # -- bucketed, pipelined transport (worker half) --------------------------
+
+    def _require_bucketed(self) -> None:
+        if self.bucket_bytes is None:
+            raise RuntimeError(
+                "this worker uses the serial transport — connect with "
+                "bucket_bytes=... (e.g. 4 << 20) to enable the bucketed/"
+                "pipelined path"
+            )
+
+    def _push_buckets_sync(self, by_owner: Dict[int, Dict[str, np.ndarray]]
+                           ) -> None:
+        """Slice each owner's subtree into fusion buckets, stripe them over
+        the connection pool, wait for every ack, and adopt the committed
+        versions. The engine sees ONE whole-tree apply per server, exactly
+        like a serial PUSH."""
+        self._push_epoch += 1
+        epoch = self._push_epoch
+        futs: List[Tuple[int, Any]] = []
+        for i, sub in by_owner.items():
+            # contiguous-normalize ONCE per subtree: encode_bucket takes
+            # memoryview slices, and a non-contiguous source would
+            # otherwise be re-copied whole for every bucket it spans
+            sub = {k: np.ascontiguousarray(v) for k, v in sub.items()}
+            plan = BucketPlan.from_arrays(sub, self.bucket_bytes)
+            pumps = self._pumps[i]
+            for b in range(plan.nbuckets):
+                payload = plan.encode_bucket(
+                    tv.BUCKET_PUSH, self.worker, sub, b,
+                    extra={"epoch": epoch,
+                           "nonce": self._transport_nonce},
+                )
+                futs.append((i, pumps[b % len(pumps)].submit(payload)))
+        for i, fut in futs:
+            kind, _, _, extra = tv.decode(self._bucket_reply(i, fut))
+            if kind != tv.OK:
+                raise RuntimeError(f"server {i} error: {extra.get('error')}")
+            if extra.get("committed"):
+                self.versions[i] = int(extra["version"])
+
+    def _pull_buckets(self) -> Dict[str, np.ndarray]:
+        """Bucketed pull: bucket 0 snapshots each server's subtree (and
+        names the bucket count); the rest stream over the pool. Requests go
+        out front-of-model first, so the keys the next forward needs first
+        are the first bytes on the wire."""
+        self._pull_epoch += 1
+        epoch = self._pull_epoch
+        first = {
+            i: self._pumps[i][0].submit(tv.encode(
+                tv.BUCKET_PULL, self.worker, None,
+                extra={"epoch": epoch, "bucket": 0,
+                       "bucket_bytes": self.bucket_bytes},
+            ))
+            for i in self._active
+        }
+        kv: Dict[str, np.ndarray] = {}
+        rest: List[Tuple[int, Any]] = []
+        assemblers: Dict[int, Any] = {}
+        for i, fut in first.items():
+            kind, _, tensors, extra = tv.decode(self._bucket_reply(i, fut))
+            if kind != tv.OK:
+                raise RuntimeError(f"server {i} error: {extra.get('error')}")
+            self.versions[i] = int(extra["version"])
+            n = int(extra["nbuckets"])
+            asm = BucketAssembler(epoch, n)
+            if asm.add(0, tensors["raw"], extra["slices"], epoch):
+                kv.update(asm.finish())
+                continue
+            assemblers[i] = asm
+            pumps = self._pumps[i]
+            for b in range(1, n):
+                payload = tv.encode(tv.BUCKET_PULL, self.worker, None,
+                                    extra={"epoch": epoch, "bucket": b})
+                rest.append((i, pumps[b % len(pumps)].submit(payload)))
+        for i, fut in rest:
+            kind, _, tensors, extra = tv.decode(self._bucket_reply(i, fut))
+            if kind != tv.OK:
+                raise RuntimeError(f"server {i} error: {extra.get('error')}")
+            if assemblers[i].add(int(extra["bucket"]), tensors["raw"],
+                                 extra["slices"], epoch):
+                kv.update(assemblers[i].finish())
+        return kv
+
+    def _merge_host_params(self, kv: Dict[str, np.ndarray]) -> Any:
+        import jax.numpy as jnp
+
+        self._params = keymod.unflatten(
+            self._treedef, {k: jnp.asarray(v) for k, v in kv.items()},
+            self._key_order,
+        )
+        return self._params
+
+    def push_pull_async(self, grads) -> PendingCycle:
+        """Start one full transport cycle (bucketed push, then ordered pull
+        prefetch) in the background and return immediately.
+
+        The returned :class:`PendingCycle` resolves to the freshly pulled
+        params. Cycles are serialized per worker (a second call queues
+        behind the first), so the per-worker push/pull order the staleness
+        bound rests on is exactly the serial order — async mode bounds
+        staleness precisely as before; calling :meth:`wait`/:meth:`flush`
+        before computing the next gradients restores sync-step semantics
+        bit for bit. Overlap comes from everything the caller does between
+        the call and the wait: next-batch prep, metrics, the previous
+        step's host work."""
+        self._require_bucketed()
+        by_owner = self._split_by_owner(grads)  # host copy: caller may mutate
+        pending = PendingCycle(self.transport)
+        self._track_pending(pending)
+        self._bg_executor().submit(self._run_cycle, by_owner, pending)
+        return pending
+
+    def _run_cycle(self, by_owner, pending: PendingCycle) -> None:
+        t0 = time.perf_counter()
+        try:
+            self._push_buckets_sync(by_owner)
+            params = self._merge_host_params(self._pull_buckets())
+        except BaseException as e:
+            pending._fail(e)
+        else:
+            pending._resolve(params)
+        finally:
+            self.transport.record_cycle(time.perf_counter() - t0)
 
     def stats(self) -> dict:
         """Single-server: that server's stats dict (back-compat shape).
@@ -582,11 +979,21 @@ class RemoteAsyncWorker(CheckpointRoundsMixin):
         shard_tree(params, i, N)); store.restore(path/shard<i>);
         serve_async(store, shard=i, num_shards=N)`` and workers
         :meth:`reconnect`."""
+        tokens: Dict[int, dict] = {}
         try:
             # pause inside the protected region: if ANY round fails, the
             # surviving servers are still resumed — a fleet must never be
-            # left blocked by a failed checkpoint
-            paused = self._checkpoint_round({"dir": path, "phase": "pause"})
+            # left blocked by a failed checkpoint. Pause hands each server's
+            # ownership token back; every later phase presents it, so a
+            # concurrent coordinator can neither pause over us nor resume
+            # our pause out from under the save.
+            try:
+                paused = self._checkpoint_round({"dir": path,
+                                                 "phase": "pause"})
+            except CheckpointRoundError as e:
+                tokens = self._ckpt_tokens(e.oks)  # resume the paused subset
+                raise
+            tokens = self._ckpt_tokens(paused)
             targets: Dict[str, int] = {}
             for extra in paused.values():
                 for w, n in extra.get("applied", {}).items():
@@ -597,18 +1004,22 @@ class RemoteAsyncWorker(CheckpointRoundsMixin):
             )
             if lagging:
                 self._checkpoint_round({"dir": path, "phase": "drain_to",
-                                        "targets": targets})
-            saves = self._checkpoint_round({"dir": path, "phase": "save"})
+                                        "targets": targets},
+                                       per_server=tokens)
+            saves = self._checkpoint_round({"dir": path, "phase": "save"},
+                                           per_server=tokens)
         except BaseException:
             # resume the healthy servers, then let the ORIGINAL failure
             # propagate (the resume round hits the same dead server — its
             # error would only mask the root cause)
             try:
-                self._checkpoint_round({"dir": path, "phase": "resume"})
+                self._checkpoint_round({"dir": path, "phase": "resume"},
+                                       per_server=tokens)
             except Exception:
                 pass
             raise
-        self._checkpoint_round({"dir": path, "phase": "resume"})
+        self._checkpoint_round({"dir": path, "phase": "resume"},
+                               per_server=tokens)
         return [int(saves[i]["version"]) for i in range(len(self._chs))]
 
     def reconnect(self, addrs: Optional[Sequence[Tuple[str, int]]] = None
@@ -617,35 +1028,76 @@ class RemoteAsyncWorker(CheckpointRoundsMixin):
         servers usually come back on new ephemeral ports) and revalidate
         the partition. The first pull after a reconnect is a fresh
         snapshot; staleness restarts from the servers' restored version
-        vectors."""
+        vectors. Cumulative wire counters, transport stats, and the
+        push/pull epoch streams survive the re-dial — even a FAILED
+        re-dial, so TrainMetrics GB/s continuity holds across a restart
+        and a retried reconnect just works."""
+        try:
+            self.flush()  # land (or fail fast) in-flight background cycles
+        except Exception:
+            pass  # a dead server is exactly why we are reconnecting
+        saved = self._saved_transport_state()
+        self._close_transport()
         for ch in self._chs:
             ch.close()  # dead or stale; no SHUTDOWN owed
         if self._pool is not None:
             self._pool.shutdown(wait=False)
-        self._init_multi(list(addrs) if addrs is not None else self._addrs,
-                         self.worker, keymod.unflatten(
-                             self._treedef, self._kv_like, self._key_order))
+        try:
+            self._init_multi(
+                list(addrs) if addrs is not None else self._addrs,
+                self.worker, keymod.unflatten(
+                    self._treedef, self._kv_like, self._key_order),
+                bucket_bytes=self.bucket_bytes, pool_size=self.pool_size)
+        finally:
+            self._restore_transport_state(saved)
 
-    def make_async_step(self, loss_fn, has_aux: bool = False):
+    def make_async_step(self, loss_fn, has_aux: bool = False,
+                        overlap: bool = False):
         """``run(batch, *extra) -> loss`` — grad against the last-pulled
-        (stale) params on THIS process's devices, then one push_pull."""
+        (stale) params on THIS process's devices, then one push_pull.
+
+        With ``overlap=True`` (bucketed transport required) the cycle runs
+        in the background: ``run`` returns as soon as the loss is
+        dispatched, and the NEXT call waits for the fresh params before
+        computing — gradients are computed against exactly the same params
+        as the serial step (loss-for-loss parity), while the transport of
+        step k hides under the caller's inter-step host work. Call
+        :meth:`flush` after the loop (``close()`` also does) to land the
+        final push."""
         import jax
 
+        if overlap:
+            self._require_bucketed()
         grad_fn = jax.jit(jax.value_and_grad(loss_fn, has_aux=has_aux))
+        pending: List[PendingCycle] = []
 
         def run(batch, *extra):
-            params = self._params if self._params is not None else self.pull_all()
+            if pending:
+                params = pending.pop().wait()
+            elif self._params is not None:
+                params = self._params
+            else:
+                params = self.pull_all()
             if has_aux:
                 (loss, aux), grads = grad_fn(params, batch, *extra)
             else:
                 loss, grads = grad_fn(params, batch, *extra)
                 aux = None
-            self.push_pull(grads)
+            if overlap:
+                pending.append(self.push_pull_async(grads))
+            else:
+                self.push_pull(grads)
             return (loss, aux) if has_aux else loss
 
         return run
 
     def close(self) -> None:
+        try:
+            if self._pending_cycles:
+                self.flush()  # land in-flight cycles before the goodbyes
+        except Exception:
+            pass  # a dead server must not block the local teardown
+        self._close_transport()  # pool channels hang up silently (no goodbye)
         for ch in self._chs:
             try:
                 ch.request(tv.encode(tv.SHUTDOWN, self.worker, None))

@@ -37,11 +37,17 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from ps_tpu.backends.remote_async import (
-    CheckpointRoundsMixin,
+from ps_tpu.backends.common import (
+    BucketedTransportMixin,
+    BucketPlan,
     ServerFailureError,
 )
-from ps_tpu.backends.van_service import VanService
+from ps_tpu.backends.remote_async import (
+    CheckpointRoundError,
+    CheckpointRoundsMixin,
+    PendingCycle,
+)
+from ps_tpu.backends.van_service import VanService, resolve_ckpt_dir
 from ps_tpu.control import tensor_van as tv
 
 
@@ -95,7 +101,8 @@ class SparsePSService(VanService):
     def __init__(self, tables: Dict[str, Any], port: int = 0,
                  bind: str = "127.0.0.1", shard: Optional[int] = None,
                  num_shards: Optional[int] = None,
-                 total_rows: Optional[Dict[str, int]] = None):
+                 total_rows: Optional[Dict[str, int]] = None,
+                 ckpt_root: Optional[str] = None):
         if not tables:
             raise ValueError("no tables to serve")
         if (shard is None) != (num_shards is None):
@@ -130,9 +137,13 @@ class SparsePSService(VanService):
         self._lock = threading.Lock()
         self._draining = False
         # checkpoint pause (see AsyncPSService._checkpoint): pushes BLOCK
-        # while a coordinated cross-shard snapshot is in flight
+        # while a coordinated cross-shard snapshot is in flight. Pause
+        # hands out an ownership token; later phases must present it
+        # (concurrent coordinators serialize instead of tearing snapshots;
+        # token bookkeeping lives in VanService).
         self._paused = False
         self._pause_cond = threading.Condition(self._lock)
+        self._ckpt_root = ckpt_root
         # seeded from the tables' own (checkpoint-restored) counters, so a
         # server restarted from SparseEmbedding.restore resumes its version
         # stream instead of resetting to 0 (coordinated-checkpoint story)
@@ -178,20 +189,26 @@ class SparsePSService(VanService):
         return ids - m["lo"]
 
     def _apply_push(self, worker: int,
-                    per_table: Dict[str, Dict[str, np.ndarray]]) -> None:
+                    per_table: Dict[str, Dict[str, np.ndarray]],
+                    copy: bool = True) -> None:
         # copy out of the recv buffer: the engine keeps references beyond
-        # this frame's lifetime
+        # this frame's lifetime (bucket-assembled pushes own their buffers)
+        arr = np.array if copy else np.asarray
         todo = []
         for name, t in per_table.items():
             if "ids" not in t or "grads" not in t:
                 raise KeyError(f"push for {name!r} needs ids + grads")
-            todo.append((name, self._localize(name, np.array(t["ids"])),
-                         np.array(t["grads"])))
+            todo.append((name, self._localize(name, arr(t["ids"])),
+                         arr(t["grads"])))
         if not todo:
             return  # push_pull with no rows for this server: nothing applied
         with self._lock:
             while self._paused and not self._draining:
-                self._pause_cond.wait()  # a checkpoint snapshot is in flight
+                self._pause_wait_begin()
+                try:
+                    self._pause_cond.wait()  # checkpoint snapshot in flight
+                finally:
+                    self._pause_wait_end()
             if self._draining:
                 raise RuntimeError("server is draining; push refused")
             for name, ids, grads in todo:
@@ -228,6 +245,24 @@ class SparsePSService(VanService):
                     for n, t in per.items() if "pull_ids" in t}
             self._apply_push(worker, push)
             return self._rows_payload(worker, pull)
+        elif kind == tv.ROW_BUCKET_PUSH:
+            # one fusion bucket of a multi-bucket row push: stage until the
+            # epoch completes, then apply the WHOLE multi-table push
+            # atomically (a torn push is never observable — row state may
+            # tolerate partial pushes semantically, but a bucketed push
+            # commits as the single unit the worker sent)
+            tree = self._stage_bucket_push(
+                worker, int(extra["bucket"]), int(extra["nbuckets"]),
+                int(extra["epoch"]), tensors["raw"], extra["slices"],
+                nonce=extra.get("nonce"),
+            )
+            if tree is None:
+                return tv.encode(tv.OK, worker, None,
+                                 extra={"staged": int(extra["bucket"])})
+            self._apply_push(worker, self._split(tree), copy=False)
+            return tv.encode(tv.OK, worker, None, extra={
+                "versions": dict(self.versions), "committed": True,
+            })
         elif kind == tv.STATS:
             with self._log_lock:
                 log = list(self.apply_log)
@@ -264,17 +299,37 @@ class SparsePSService(VanService):
         phase = extra.get("phase", "save")
         if phase == "pause":
             with self._lock:
+                token = self._ckpt_issue_token()
+                if token is None:
+                    return tv.encode(tv.ERR, worker, None,
+                                     extra={"error": self._ckpt_busy_error()})
                 self._paused = True
+            return tv.encode(tv.OK, worker, None, extra={
+                "versions": dict(self.versions), "token": token,
+            })
+        if phase == "resume" and extra.get("force"):
+            # operator escape hatch for a coordinator that died holding the
+            # token (see AsyncPSService._checkpoint)
+            with self._lock:
+                self._paused = False
+                self._ckpt_clear_token()
+                self._pause_cond.notify_all()
             return tv.encode(tv.OK, worker, None,
-                             extra={"versions": dict(self.versions)})
+                             extra={"versions": dict(self.versions),
+                                    "forced": True})
+        err = self._ckpt_token_error(phase, extra)
+        if err is not None:
+            return tv.encode(tv.ERR, worker, None, extra={"error": err})
         if phase == "resume":
             with self._lock:
                 self._paused = False
+                self._ckpt_clear_token()
                 self._pause_cond.notify_all()
             return tv.encode(tv.OK, worker, None,
                              extra={"versions": dict(self.versions)})
-        root = (extra["dir"] if self.num_shards is None
-                else os.path.join(extra["dir"], f"shard{self.shard}"))
+        base = resolve_ckpt_dir(self._ckpt_root, extra["dir"])
+        root = (base if self.num_shards is None
+                else os.path.join(base, f"shard{self.shard}"))
         with self._lock:
             for name, emb in self._tables.items():
                 emb.save(os.path.join(root, name))
@@ -291,7 +346,8 @@ class SparsePSService(VanService):
 def serve_sparse(tables: Dict[str, Any], port: int = 0,
                  bind: str = "127.0.0.1", shard: Optional[int] = None,
                  num_shards: Optional[int] = None,
-                 total_rows: Optional[Dict[str, int]] = None
+                 total_rows: Optional[Dict[str, int]] = None,
+                 ckpt_root: Optional[str] = None
                  ) -> "SparsePSService":
     """Expose initialized sparse tables to remote worker processes.
 
@@ -302,35 +358,61 @@ def serve_sparse(tables: Dict[str, Any], port: int = 0,
     ``total_rows={name: total}``. Workers connect with
     :func:`connect_sparse`."""
     return SparsePSService(tables, port=port, bind=bind, shard=shard,
-                           num_shards=num_shards, total_rows=total_rows)
+                           num_shards=num_shards, total_rows=total_rows,
+                           ckpt_root=ckpt_root)
 
 
 def connect_sparse(uri: str, worker: int,
-                   tables: Dict[str, Tuple[int, int]]
+                   tables: Dict[str, Tuple[int, int]],
+                   bucket_bytes: Optional[int] = None,
+                   pool_size: Optional[int] = None
                    ) -> "RemoteSparseWorker":
     """Join a cross-process sparse PS as worker ``worker``.
 
     ``uri`` is ``host:port`` or a comma-separated list naming every server
     of the row partition; ``tables`` is ``{name: (total_rows, dim)}`` — the
     worker-side expectation validated against what the servers advertise
-    (coverage must be exact and disjoint)."""
+    (coverage must be exact and disjoint). ``bucket_bytes`` enables the
+    bucketed transport and :meth:`RemoteSparseWorker.push_async`."""
     addrs = []
     for part in uri.split(","):
         host, port = part.strip().rsplit(":", 1)
         addrs.append((host, int(port)))
-    return RemoteSparseWorker(addrs, worker, tables)
+    return RemoteSparseWorker(addrs, worker, tables,
+                              bucket_bytes=bucket_bytes, pool_size=pool_size)
 
 
-class RemoteSparseWorker(CheckpointRoundsMixin):
+class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
     """A worker NODE of the cross-process sparse PS.
 
     Routes global row ids to owner servers by range, fans per-server
     requests out concurrently (one round trip per server per cycle), and
     reassembles pulled rows in id order. ``versions[name]`` sums the
-    per-server apply counters for the table."""
+    per-server apply counters for the table.
+
+    Transport: as the dense worker — ``bucket_bytes=None`` (default) sends
+    each cycle as one frame per server; with it set, row pushes travel as
+    fusion buckets striped over ``pool_size`` extra connections per server
+    and :meth:`push_async`/:meth:`flush` give non-blocking pushes whose
+    transport hides under the next batch's compute."""
+
+    _failure_noun = "sparse PS server"
 
     def __init__(self, addrs: Sequence[Tuple[str, int]], worker: int,
-                 tables: Dict[str, Tuple[int, int]]):
+                 tables: Dict[str, Tuple[int, int]],
+                 bucket_bytes: Optional[int] = None,
+                 pool_size: Optional[int] = None):
+        self._init_multi(list(addrs), worker, tables,
+                         bucket_bytes=bucket_bytes, pool_size=pool_size)
+
+    def _init_multi(self, addrs: List[Tuple[str, int]], worker: int,
+                    tables: Dict[str, Tuple[int, int]],
+                    bucket_bytes: Optional[int] = None,
+                    pool_size: Optional[int] = None) -> None:
+        """Fresh dial + validation — ``__init__``'s whole body, factored so
+        :meth:`reconnect` re-inits without re-running ``__init__`` on a
+        live instance (and so a failed re-dial leaves the identity fields
+        intact for a clean retry)."""
         self.worker = worker
         self._addrs = list(addrs)
         self._spec = {n: (int(v), int(d)) for n, (v, d) in tables.items()}
@@ -350,6 +432,7 @@ class RemoteSparseWorker(CheckpointRoundsMixin):
         self.bytes_pulled = 0
         self.collective_bytes = 0
         self._bytes_lock = threading.Lock()
+        self._init_transport(bucket_bytes, pool_size)
         try:
             self._connect_and_validate(worker)
         except Exception:
@@ -361,6 +444,14 @@ class RemoteSparseWorker(CheckpointRoundsMixin):
             import concurrent.futures
 
             self._pool = concurrent.futures.ThreadPoolExecutor(max_workers=n)
+        if self.bucket_bytes is not None:
+            try:
+                self._open_pumps(range(len(self._addrs)))
+            except Exception:
+                self._close_transport()
+                for ch in self._chs:
+                    ch.close()
+                raise
 
     def _connect_and_validate(self, worker: int) -> None:
         n = len(self._addrs)
@@ -485,6 +576,8 @@ class RemoteSparseWorker(CheckpointRoundsMixin):
     def pull(self, requests: Dict[str, Any]) -> Dict[str, np.ndarray]:
         """``{table: global ids [N]} -> {table: rows [N, dim]}`` — one
         concurrent round over the owners, rows reassembled in id order."""
+        if self._pending_cycles:
+            self.flush()  # a pull must not overtake an in-flight push
         reqs, routes = self._build_pull(requests)
         msgs = self._fanout({
             i: tv.encode(tv.ROW_PULL, self.worker, t) for i, t in reqs.items()
@@ -534,7 +627,14 @@ class RemoteSparseWorker(CheckpointRoundsMixin):
              dedupe: bool = True) -> None:
         """``{table: (global ids [N], row_grads [N, dim])}`` — owners
         scatter-apply immediately (async semantics). ``dedupe`` merges
-        duplicate rows worker-side first, shrinking the wire payload."""
+        duplicate rows worker-side first, shrinking the wire payload.
+        Bucketed transport (``bucket_bytes`` set) slices each server's
+        payload into fusion buckets over the pool; the server applies the
+        reassembled push as one atomic unit either way."""
+        if self.bucket_bytes is not None:
+            self.flush()  # keep per-worker push order == epoch order
+            self._push_buckets_sync(self._build_push(pushes, dedupe))
+            return
         msgs = self._fanout({
             i: tv.encode(tv.ROW_PUSH, self.worker, t)
             for i, t in self._build_push(pushes, dedupe).items()
@@ -542,11 +642,71 @@ class RemoteSparseWorker(CheckpointRoundsMixin):
         for i, m in msgs.items():
             self._check(i, m)
 
+    # -- bucketed, non-blocking push (the pipelined transport) ----------------
+
+    def _push_buckets_sync(self, reqs: Dict[int, Dict[str, np.ndarray]]
+                           ) -> None:
+        """Stripe each server's ``{table/ids, table/grads}`` payload over
+        the pool as byte-sliced fusion buckets; the completing bucket's
+        reply carries the committed versions."""
+        self._push_epoch += 1
+        epoch = self._push_epoch
+        futs: List[Tuple[int, Any]] = []
+        for i, t in reqs.items():
+            # contiguous-normalize once per payload (see the dense twin)
+            t = {k: np.ascontiguousarray(v) for k, v in t.items()}
+            plan = BucketPlan.from_arrays(t, self.bucket_bytes)
+            pumps = self._pumps[i]
+            for b in range(plan.nbuckets):
+                payload = plan.encode_bucket(
+                    tv.ROW_BUCKET_PUSH, self.worker, t, b,
+                    extra={"epoch": epoch,
+                           "nonce": self._transport_nonce},
+                )
+                futs.append((i, pumps[b % len(pumps)].submit(payload)))
+        for i, fut in futs:
+            self._check(i, self._bucket_reply(i, fut))
+
+    def push_async(self, pushes: Dict[str, Tuple[Any, Any]],
+                   dedupe: bool = True) -> PendingCycle:
+        """Non-blocking :meth:`push`: payloads are built now (so the caller
+        may mutate its arrays), then a background sender drains the bucket
+        queue while the caller computes the next batch. Returns a handle;
+        :meth:`flush` (or ``handle.wait()``) is the barrier that restores
+        synchronous semantics — per-worker push order is preserved either
+        way, so async staleness bounds are unchanged."""
+        if self.bucket_bytes is None:
+            raise RuntimeError(
+                "push_async needs the bucketed transport — construct the "
+                "worker with bucket_bytes=... (e.g. 4 << 20)"
+            )
+        reqs = self._build_push(pushes, dedupe)
+        pending = PendingCycle(self.transport)
+        self._track_pending(pending)
+
+        def run():
+            import time as _time
+
+            t0 = _time.perf_counter()
+            try:
+                self._push_buckets_sync(reqs)
+            except BaseException as e:
+                pending._fail(e)
+            else:
+                pending._resolve(None)
+            finally:
+                self.transport.record_cycle(_time.perf_counter() - t0)
+
+        self._bg_executor().submit(run)
+        return pending
+
     def push_pull(self, pushes: Dict[str, Tuple[Any, Any]],
                   requests: Dict[str, Any],
                   dedupe: bool = True) -> Dict[str, np.ndarray]:
         """Push this cycle's row grads and pull the next cycle's rows in ONE
         round trip per server (the sparse async cycle)."""
+        if self._pending_cycles:
+            self.flush()  # a cycle must not overtake an in-flight push
         reqs = self._build_push(pushes, dedupe)
         pull_reqs, routes = self._build_pull(requests)
         for i, t in pull_reqs.items():
@@ -571,18 +731,30 @@ class RemoteSparseWorker(CheckpointRoundsMixin):
         tables, ``restore``s each from its shard dir, and serves again
         (versions resume from the restored push counts); workers
         :meth:`reconnect`."""
+        tokens: Dict[int, dict] = {}
         try:
             # pause inside the protected region: a failed round must still
-            # resume the surviving servers (never wedge the fleet)
-            self._checkpoint_round({"dir": path, "phase": "pause"})
-            saves = self._checkpoint_round({"dir": path, "phase": "save"})
+            # resume the surviving servers (never wedge the fleet). As in
+            # the dense protocol, pause hands out per-server ownership
+            # tokens that every later phase must present.
+            try:
+                paused = self._checkpoint_round({"dir": path,
+                                                 "phase": "pause"})
+            except CheckpointRoundError as e:
+                tokens = self._ckpt_tokens(e.oks)
+                raise
+            tokens = self._ckpt_tokens(paused)
+            saves = self._checkpoint_round({"dir": path, "phase": "save"},
+                                           per_server=tokens)
         except BaseException:
             try:
-                self._checkpoint_round({"dir": path, "phase": "resume"})
+                self._checkpoint_round({"dir": path, "phase": "resume"},
+                                       per_server=tokens)
             except Exception:
                 pass  # the original failure names the culprit
             raise
-        self._checkpoint_round({"dir": path, "phase": "resume"})
+        self._checkpoint_round({"dir": path, "phase": "resume"},
+                               per_server=tokens)
         totals: Dict[str, int] = {n: 0 for n in self._spec}
         for extra in saves.values():
             for n, v in extra["versions"].items():
@@ -593,13 +765,27 @@ class RemoteSparseWorker(CheckpointRoundsMixin):
                   ) -> None:
         """Re-dial every server (optionally at new addresses) and
         revalidate the row partition — the worker half of the
-        checkpoint/restart story."""
+        checkpoint/restart story. Cumulative wire counters, transport
+        stats, and the push epoch stream survive the re-dial — even a
+        FAILED one (TrainMetrics GB/s continuity across a restart, and a
+        retried ``reconnect`` just works)."""
+        try:
+            self.flush()  # land (or fail fast) in-flight background pushes
+        except Exception:
+            pass  # a dead server is exactly why we are reconnecting
+        saved = self._saved_transport_state()
+        self._close_transport()
         for ch in self._chs:
             ch.close()  # dead or stale; no SHUTDOWN owed
         if self._pool is not None:
             self._pool.shutdown(wait=False)
-        self.__init__(list(addrs) if addrs is not None else self._addrs,
-                      self.worker, dict(self._spec))
+        try:
+            self._init_multi(
+                list(addrs) if addrs is not None else self._addrs,
+                self.worker, dict(self._spec),
+                bucket_bytes=self.bucket_bytes, pool_size=self.pool_size)
+        finally:
+            self._restore_transport_state(saved)
 
     def stats(self) -> dict:
         msgs = self._fanout({
@@ -616,6 +802,12 @@ class RemoteSparseWorker(CheckpointRoundsMixin):
                 "versions": self.versions()}
 
     def close(self) -> None:
+        try:
+            if self._pending_cycles:
+                self.flush()  # land in-flight pushes before the goodbyes
+        except Exception:
+            pass  # a dead server must not block the local teardown
+        self._close_transport()  # pool channels hang up silently (no goodbye)
         for ch in self._chs:
             try:
                 ch.request(tv.encode(tv.SHUTDOWN, self.worker, None))

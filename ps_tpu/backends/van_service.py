@@ -35,11 +35,39 @@ no request of that worker can race anything.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 import time
-from typing import List, Optional
+from typing import Dict, List, Optional
 
+from ps_tpu.backends.common import BucketAssembler
 from ps_tpu.control import tensor_van as tv
+
+
+def resolve_ckpt_dir(root: Optional[str], client_dir: str) -> str:
+    """Resolve a client-supplied CHECKPOINT dir under the service's
+    ``ckpt_root``.
+
+    With no root configured the legacy behavior stands (the client names an
+    arbitrary server-host path — loopback-bind deployments only). With a
+    root, the client path must be relative and may not escape: absolute
+    paths and ``..`` traversals are refused, so an unauthenticated peer can
+    never direct the server's filesystem writes outside the root.
+    """
+    if root is None:
+        return client_dir
+    if os.path.isabs(client_dir):
+        raise ValueError(
+            f"absolute checkpoint path {client_dir!r} refused: this server "
+            f"confines checkpoints under ckpt_root={root!r} — pass a "
+            f"relative path"
+        )
+    norm = os.path.normpath(client_dir)
+    if norm == ".." or norm.startswith(".." + os.sep):
+        raise ValueError(
+            f"checkpoint path {client_dir!r} escapes ckpt_root={root!r}"
+        )
+    return os.path.join(root, norm)
 
 
 class VanService:
@@ -64,6 +92,21 @@ class VanService:
         # sent — what stop() waits out before severing anything
         self._inflight = 0
         self._inflight_cond = threading.Condition()
+        # of those, how many are parked on a checkpoint-pause condition
+        # (not executing): stop()'s drain wait subtracts them instead of
+        # burning the full grace on requests that can only finish once the
+        # draining flag wakes them into refusal
+        self._pause_blocked = 0
+        # multi-bucket push staging (BUCKET_PUSH / ROW_BUCKET_PUSH): one
+        # in-flight epoch per worker; only a COMPLETE epoch is handed to the
+        # subclass's apply, so a torn multi-bucket push is never observable
+        self._stage_lock = threading.Lock()
+        self._push_stage: Dict[int, BucketAssembler] = {}
+        # checkpoint ownership token (issued at pause, validated by every
+        # later phase, cleared at resume) — shared bookkeeping for both
+        # concrete services; mutated only under the subclass's apply lock
+        self._ckpt_token: Optional[int] = None
+        self._ckpt_seq = 0
         self.goodbyes = 0  # workers that sent SHUTDOWN (clean departures)
         self._goodbye_cond = threading.Condition()
         self._accept_thread = threading.Thread(
@@ -82,6 +125,97 @@ class VanService:
 
     def _set_draining(self) -> None:
         raise NotImplementedError
+
+    # -- bucketed-push staging -------------------------------------------------
+
+    def _stage_bucket_push(self, worker: int, bucket: int, nbuckets: int,
+                           epoch: int, raw, slices,
+                           nonce: Optional[str] = None) -> Optional[dict]:
+        """Stage one bucket of worker's multi-bucket push; returns the fully
+        assembled ``{key: tensor}`` tree when this bucket completes the
+        epoch, else None (reply with a plain ack).
+
+        One epoch in flight per worker (the worker's sender serializes
+        cycles, and waits out every bucket of an epoch before starting the
+        next). A bucket of a different (epoch, incarnation-nonce) pair
+        therefore always means the worker moved on — forward after
+        abandoning a push mid-flight, or into a new incarnation after a
+        restart/reconnect reset its epoch counter (its old connections are
+        severed, so a genuine straggler of the staged epoch can no longer
+        arrive; the nonce catches even an epoch-NUMBER collision between
+        incarnations). Either way the incomplete epoch is dropped whole,
+        never half-applied — and merged with nothing — and the new epoch
+        stages fresh. A malformed bucket (duplicate, bad range) also drops
+        the whole staged epoch, so a retry starts clean instead of
+        completing against poisoned state.
+        """
+        with self._stage_lock:
+            asm = self._push_stage.get(worker)
+            if asm is not None and (asm.epoch != epoch
+                                    or getattr(asm, "nonce", None) != nonce):
+                logging.getLogger(__name__).warning(
+                    "worker %d abandoned push epoch %d (%d/%d buckets); "
+                    "superseded by epoch %d", worker, asm.epoch,
+                    len(asm._seen), asm.nbuckets, epoch,
+                )
+                asm = None
+            if asm is None:
+                asm = BucketAssembler(epoch, nbuckets)
+                asm.nonce = nonce
+                self._push_stage[worker] = asm
+            try:
+                complete = asm.add(bucket, raw, slices, epoch)
+            except Exception:
+                self._push_stage.pop(worker, None)
+                raise
+            if complete:
+                del self._push_stage[worker]
+        return asm.finish() if complete else None
+
+    # -- checkpoint ownership tokens ------------------------------------------
+
+    def _ckpt_issue_token(self) -> Optional[int]:
+        """Issue the pause ownership token (call under the apply lock);
+        None when a checkpoint is already outstanding — the caller replies
+        with :meth:`_ckpt_busy_error`."""
+        if self._ckpt_token is not None:
+            return None
+        self._ckpt_seq += 1
+        self._ckpt_token = self._ckpt_seq
+        return self._ckpt_token
+
+    def _ckpt_busy_error(self) -> str:
+        return (f"checkpoint already in progress (token {self._ckpt_token} "
+                f"outstanding) — serialize checkpoint coordinators")
+
+    def _ckpt_token_error(self, phase: str, extra: dict) -> Optional[str]:
+        """Error string when the phase's presented token does not match the
+        outstanding one; None when it does. (``resume`` with ``force`` is
+        the caller's deliberate bypass and skips this gate.)"""
+        token = extra.get("token")
+        token = None if token is None else int(token)
+        if token != self._ckpt_token:
+            return (f"checkpoint {phase} with invalid token {token!r} "
+                    f"(outstanding: {self._ckpt_token!r})")
+        return None
+
+    def _ckpt_clear_token(self) -> None:
+        """Call under the apply lock, at (any) resume."""
+        self._ckpt_token = None
+
+    # -- checkpoint-pause drain accounting ------------------------------------
+
+    def _pause_wait_begin(self) -> None:
+        """Subclass hook: call immediately before parking a serve thread on
+        a checkpoint-pause condition (so stop() can discount it)."""
+        with self._inflight_cond:
+            self._pause_blocked += 1
+            self._inflight_cond.notify_all()
+
+    def _pause_wait_end(self) -> None:
+        with self._inflight_cond:
+            self._pause_blocked -= 1
+            self._inflight_cond.notify_all()
 
     # -- accept / serve --------------------------------------------------------
 
@@ -178,7 +312,14 @@ class VanService:
         subclass's draining flag — set under its apply lock — refuses every
         later commit, so even a serve thread that outlives the bounded
         join (e.g. stuck in a minutes-long jit compile) can never land a
-        push after this method returns."""
+        push after this method returns.
+
+        Requests parked on a checkpoint-pause condition do NOT count toward
+        the drain wait (they cannot finish until the draining flag wakes
+        them into refusal — a coordinator that died between pause and
+        resume must not cost the full grace); they are woken by
+        ``_set_draining`` and given a short bounded window to send their
+        ERR replies before the sever."""
         self._stop.set()
         # join BEFORE closing: the accept thread may be inside tv_accept on
         # the listener handle (its 200ms timeout bounds the wait); closing
@@ -188,9 +329,10 @@ class VanService:
         deadline = time.monotonic() + grace
         while True:
             with self._inflight_cond:
-                while self._inflight > 0 and time.monotonic() < deadline:
+                while (self._inflight - self._pause_blocked > 0
+                       and time.monotonic() < deadline):
                     self._inflight_cond.wait(deadline - time.monotonic())
-                drained = self._inflight == 0
+                drained = self._inflight - self._pause_blocked == 0
             if not drained:
                 logging.getLogger(__name__).warning(
                     "request(s) still in flight after %.1fs drain grace; "
@@ -204,11 +346,17 @@ class VanService:
             # stable zero proceeds to the sever.
             time.sleep(0.05)
             with self._inflight_cond:
-                if self._inflight == 0:
+                if self._inflight - self._pause_blocked == 0:
                     break
             if time.monotonic() >= deadline:
                 break
         self._set_draining()
+        # pause-parked requests just woke into refusal: give them a short
+        # bounded window to send their ERR replies intact before severing
+        with self._inflight_cond:
+            end = min(deadline, time.monotonic() + 2.0)
+            while self._inflight > 0 and time.monotonic() < end:
+                self._inflight_cond.wait(max(end - time.monotonic(), 0.01))
         with self._chan_lock:
             chans = list(self._channels)
             conns = list(self._conns)

@@ -145,13 +145,22 @@ def restore(path: str, abstract: Any, meta: Optional[Dict[str, Any]] = None) -> 
     if meta is None:
         meta = read_meta(path)
     restore_args = ocp.checkpoint_utils.construct_restore_args(abstract)
-    # partial_restore: the targets may name a SUBSET of the saved tree (an
+    # partial restore: the targets may name a SUBSET of the saved tree (an
     # elastic shrink skips dropped workers' snapshots); untargeted leaves
-    # are never read off disk
+    # are never read off disk. Newer orbax spells this partial_restore=True;
+    # older releases (< 0.9) use the legacy transforms={} idiom.
+    import inspect
+
+    if "partial_restore" in inspect.signature(
+            ocp.args.PyTreeRestore.__init__).parameters:
+        restore = ocp.args.PyTreeRestore(
+            item=abstract, restore_args=restore_args, partial_restore=True)
+    else:
+        restore = ocp.args.PyTreeRestore(
+            item=abstract, restore_args=restore_args, transforms={})
     out = _checkpointer().restore(
         os.path.join(os.path.abspath(path), meta["arrays_dir"]),
-        args=ocp.args.PyTreeRestore(item=abstract, restore_args=restore_args,
-                                    partial_restore=True),
+        args=restore,
     )
 
     # orbax restores some small/scalar leaves onto the default device only;

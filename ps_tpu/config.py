@@ -20,6 +20,11 @@ Environment variables honored by :meth:`Config.from_env`:
 - ``PS_WORKER_ID``         — this worker's id in the cross-process job
 - ``PS_SHARD`` / ``PS_NUM_SHARDS`` — server side: this server's index in /
   the size of the key (or row-range) partition
+- ``PS_BUCKET_BYTES``       — bucketed van transport: fusion-bucket size in
+  bytes (0/unset = serial one-frame-per-cycle transport)
+- ``PS_TRANSPORT_POOL``     — connections per server for bucket striping
+- ``PS_CKPT_ROOT``          — server side: confine CHECKPOINT saves under
+  this root (client paths relative-only, ``..`` refused)
 - ``DMLC_ROLE``, ``DMLC_NUM_WORKER``, ``DMLC_NUM_SERVER``,
   ``DMLC_PS_ROOT_URI``/``_PORT`` are accepted as aliases where the meaning
   is knowable, so reference-family launcher scripts keep working.
@@ -88,6 +93,16 @@ class Config:
     worker_id: int = 0                  # worker: id within the job
     shard: Optional[int] = None         # server: index in the partition
     num_shards: Optional[int] = None    # server: partition size
+    # bucketed/pipelined van transport (backends/common.py BucketPlan):
+    # None = serial one-frame-per-cycle transport; set (e.g. 4 << 20) to
+    # slice push/pull payloads into fusion buckets striped over
+    # transport_pool persistent connections per server, enabling
+    # compute/comm overlap (push_pull_async / push_async + flush)
+    bucket_bytes: Optional[int] = None
+    transport_pool: int = 2
+    # server: confine CHECKPOINT saves under this root (client paths must
+    # be relative, '..' escapes refused). None = legacy client-names-path.
+    ckpt_root: Optional[str] = None
     heartbeat_base_port: Optional[int] = None
     peer_hosts: Optional[str] = None
     heartbeat_bind: Optional[str] = None
@@ -156,6 +171,11 @@ class Config:
             raise ValueError(
                 f"shard {self.shard} out of range for {self.num_shards}"
             )
+        if self.bucket_bytes is not None and self.bucket_bytes < 1:
+            raise ValueError("bucket_bytes must be >= 1 (or None for the "
+                             "serial transport)")
+        if self.transport_pool < 1:
+            raise ValueError("transport_pool must be >= 1")
 
     @classmethod
     def from_env(cls, **overrides) -> "Config":
@@ -201,6 +221,14 @@ class Config:
             # shard index still needs PS_SHARD (DMLC assigns it via the
             # scheduler, which has no equivalent here)
             kwargs["num_shards"] = int(env["DMLC_NUM_SERVER"])
+        if "PS_BUCKET_BYTES" in env:
+            # "0" / "" explicitly selects the serial transport
+            bb = int(env["PS_BUCKET_BYTES"] or 0)
+            kwargs["bucket_bytes"] = bb if bb > 0 else None
+        if "PS_TRANSPORT_POOL" in env:
+            kwargs["transport_pool"] = int(env["PS_TRANSPORT_POOL"])
+        if "PS_CKPT_ROOT" in env:
+            kwargs["ckpt_root"] = env["PS_CKPT_ROOT"] or None
         if "PS_HEARTBEAT_BASE_PORT" in env:
             kwargs["heartbeat_base_port"] = int(env["PS_HEARTBEAT_BASE_PORT"])
         if "PS_PEER_HOSTS" in env:

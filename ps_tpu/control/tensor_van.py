@@ -44,6 +44,14 @@ ROW_PULL = 8       # {"<table>/ids"} -> {"<table>/rows"} + versions
 ROW_PUSH = 9       # {"<table>/ids", "<table>/grads"} -> ack + versions
 ROW_PUSH_PULL = 10  # push + pull in one round trip per server
 CHECKPOINT = 11    # {"dir"} -> server saves its shard; ack + version(s)
+# bucketed transport (backends/common.py BucketPlan): a logical push/pull
+# travels as fixed-size fusion buckets striped over a pool of connections
+BUCKET_PUSH = 12   # one slice-bucket of a multi-bucket push; the bucket
+#                    completing the epoch commits the WHOLE tree atomically
+BUCKET_PULL = 13   # bucket 0 snapshots the tree server-side; buckets 1..n-1
+#                    stream the remaining slices of that same snapshot
+ROW_BUCKET_PUSH = 14  # sparse twin: row chunks staged per epoch, applied
+#                    as ONE atomic multi-table push when the epoch completes
 
 _HDR = struct.Struct("<BIQ")  # kind, worker_id, meta_len
 
@@ -103,6 +111,35 @@ def encode(kind: int, worker: int, tensors: Optional[Dict[str, np.ndarray]],
     for a in arrays:
         n = a.nbytes
         buf[off:off + n] = memoryview(a).cast("B")
+        off += n
+    return buf
+
+
+def encode_chunks(kind: int, worker: int, chunks, extra: Optional[dict] = None
+                  ) -> bytearray:
+    """One message whose single tensor ``raw`` (uint8 ``[total]``) is the
+    concatenation of ``chunks`` — buffer-protocol byte views, typically
+    ``memoryview`` slices of live tensors (the bucketed-transport frame of
+    :class:`ps_tpu.backends.common.BucketPlan`).
+
+    Same zero-extra-copy discipline as :func:`encode`: each chunk's bytes
+    are copied exactly once, straight into the preallocated frame — no
+    intermediate concatenation buffer.
+    """
+    total = sum(len(c) for c in chunks)
+    meta = {
+        "tensors": [{"name": "raw", "dtype": "|u1", "shape": [total]}],
+        "extra": extra or {},
+    }
+    mj = json.dumps(meta).encode()
+    buf = bytearray(_HDR.size + len(mj) + total)
+    _HDR.pack_into(buf, 0, kind, worker, len(mj))
+    off = _HDR.size
+    buf[off:off + len(mj)] = mj
+    off += len(mj)
+    for c in chunks:
+        n = len(c)
+        buf[off:off + n] = c
         off += n
     return buf
 

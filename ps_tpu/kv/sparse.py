@@ -45,7 +45,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # jax < 0.6 keeps shard_map in experimental
+    from jax.experimental.shard_map import shard_map
 
 from ps_tpu.api import current_context
 from ps_tpu.optim.rowwise import make_rowwise

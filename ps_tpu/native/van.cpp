@@ -25,6 +25,7 @@
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <cerrno>
+#include <poll.h>
 #include <sys/socket.h>
 #include <sys/time.h>
 #include <unistd.h>
@@ -307,13 +308,13 @@ int tv_listener_port(void* h) { return static_cast<Listener*>(h)->port; }
 // timeout or listener close.
 void* tv_accept(void* h, int timeout_ms) {
   auto* l = static_cast<Listener*>(h);
-  if (timeout_ms >= 0) {
-    timeval tv{timeout_ms / 1000, (timeout_ms % 1000) * 1000};
-    setsockopt(l->fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
-  } else {
-    timeval tv{0, 0};
-    setsockopt(l->fd, SOL_SOCKET, SO_RCVTIMEO, &tv, sizeof(tv));
-  }
+  // poll(), not SO_RCVTIMEO on the listener: some kernels/sandboxes (e.g.
+  // gVisor-style runtimes) do not honor RCVTIMEO for accept(2), which
+  // turned every accept-poll tick into an indefinite block (and every
+  // service stop() into a full 5s thread-join timeout)
+  pollfd p{l->fd, POLLIN, 0};
+  int r = poll(&p, 1, timeout_ms);  // timeout_ms < 0 blocks indefinitely
+  if (r <= 0 || !(p.revents & POLLIN)) return nullptr;
   int fd = accept(l->fd, nullptr, nullptr);
   if (fd < 0) return nullptr;
   // the accepted fd INHERITS the listener's SO_RCVTIMEO (the accept-poll

@@ -28,7 +28,13 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-from jax import shard_map
+try:
+    from jax import shard_map
+except ImportError:  # jax < 0.6 keeps shard_map in experimental
+    from jax.experimental.shard_map import shard_map
+
+from ps_tpu.parallel.mesh import axis_size
+
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 SEQ_AXIS = "seq"
@@ -51,7 +57,7 @@ def _block_scores(q, k, scale, causal, q_start, k_start):
 def _ring_attention_block(q, k, v, *, axis: str, causal: bool, scale: float):
     """Per-shard ring attention (call inside shard_map; q/k/v local blocks
     [B, T_local, H, D])."""
-    size = jax.lax.axis_size(axis)
+    size = axis_size(axis)
     idx = jax.lax.axis_index(axis)
     t_local = q.shape[1]
     b, h = q.shape[0], q.shape[2]
@@ -107,7 +113,7 @@ def _ulysses_attention_block(q, k, v, *, axis: str, causal: bool,
                              scale: float):
     """Per-shard Ulysses attention: a2a swaps seq-sharded -> head-sharded,
     full attention on the local head slice, a2a back."""
-    size = jax.lax.axis_size(axis)
+    size = axis_size(axis)
 
     def seq_to_heads(x):  # [B, T/s, H, D] -> [B, T, H/s, D]
         return jax.lax.all_to_all(x, axis, split_axis=2, concat_axis=1,

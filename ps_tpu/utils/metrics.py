@@ -11,6 +11,7 @@ turns those counters plus wall-clock into the reported rates.
 from __future__ import annotations
 
 import collections
+import threading
 import time
 from typing import Deque, Dict, Optional
 
@@ -39,6 +40,83 @@ class Meter:
 
     def reset(self) -> None:
         self._events.clear()
+
+
+class TransportStats:
+    """Per-bucket and per-cycle accounting for the pipelined transport.
+
+    The bucketed remote workers feed this from their pump threads: one
+    ``record_bucket`` per request/reply round (wire bytes + latency), one
+    ``record_cycle`` per background push→pull cycle (its wall time), and
+    one ``record_blocked`` per caller wait (time the training loop actually
+    stalled on transport). ``overlap_efficiency`` is the headline derived
+    metric: the fraction of transport wall time hidden under compute —
+    1.0 means the worker never waited, 0.0 means fully serial.
+    """
+
+    def __init__(self, window: int = 256):
+        self._lock = threading.Lock()
+        self._bucket_window: Deque = collections.deque(maxlen=window)
+        self.buckets = 0
+        self.bucket_bytes = 0
+        self.bucket_seconds = 0.0
+        self.cycles = 0
+        self.busy_s = 0.0      # wall time background transport was active
+        self.blocked_s = 0.0   # time callers spent blocked on wait()/flush()
+
+    def record_bucket(self, nbytes: int, seconds: float) -> None:
+        with self._lock:
+            self.buckets += 1
+            self.bucket_bytes += int(nbytes)
+            self.bucket_seconds += float(seconds)
+            self._bucket_window.append((int(nbytes), float(seconds)))
+
+    def record_cycle(self, busy_s: float) -> None:
+        with self._lock:
+            self.cycles += 1
+            self.busy_s += float(busy_s)
+
+    def record_blocked(self, seconds: float) -> None:
+        with self._lock:
+            self.blocked_s += float(seconds)
+
+    def overlap_efficiency(self) -> Optional[float]:
+        """Fraction of transport wall time hidden under compute (None until
+        a cycle completes)."""
+        with self._lock:
+            if self.busy_s <= 0:
+                return None
+            return max(0.0, min(1.0, 1.0 - self.blocked_s / self.busy_s))
+
+    def bucket_gbps(self) -> float:
+        """Recent per-bucket wire rate (window average), GB/s."""
+        with self._lock:
+            b = sum(n for n, _ in self._bucket_window)
+            t = sum(s for _, s in self._bucket_window)
+        return b / t / 1e9 if t > 0 else 0.0
+
+    def snapshot(self) -> tuple:
+        with self._lock:
+            return (self.buckets, self.bucket_bytes, self.bucket_seconds,
+                    self.cycles, self.busy_s, self.blocked_s)
+
+    def summary(self, since: Optional[tuple] = None) -> Dict[str, float]:
+        b0 = since or (0, 0, 0.0, 0, 0.0, 0.0)
+        now = self.snapshot()
+        d = [a - b for a, b in zip(now, b0)]
+        out: Dict[str, float] = {
+            "transport_buckets": int(d[0]),
+            "transport_busy_s": round(d[4], 4),
+            "transport_blocked_s": round(d[5], 4),
+        }
+        if d[2] > 0:
+            out["bucket_gbps"] = round(d[1] / d[2] / 1e9, 4)
+        if d[4] > 0:
+            out["overlap_efficiency"] = round(
+                max(0.0, min(1.0, 1.0 - d[5] / d[4])), 4
+            )
+            out["transport_hidden_s"] = round(max(d[4] - d[5], 0.0), 4)
+        return out
 
 
 class TrainMetrics:
@@ -72,6 +150,8 @@ class TrainMetrics:
              self.store.collective_bytes)
             if self.store is not None else (0, 0, 0)
         )
+        ts = getattr(self.store, "transport", None)
+        self._transport_from = ts.snapshot() if ts is not None else None
 
     def mark_compiled(self) -> None:
         """Call after the warmup step: resets the timed region so compile
@@ -117,4 +197,9 @@ class TrainMetrics:
             hist = getattr(self.store, "staleness_histogram", None)
             if hist:
                 out["staleness_hist"] = {str(t): n for t, n in sorted(hist.items())}
+            ts = getattr(self.store, "transport", None)
+            if ts is not None and ts.cycles > 0:
+                # the pipelined remote workers: per-bucket wire rate and the
+                # fraction of transport wall time hidden under compute
+                out.update(ts.summary(since=self._transport_from))
         return out

@@ -63,6 +63,10 @@ class StepLogger:
             for k, v in fields.items():
                 if isinstance(v, float):
                     parts.append(f"{k} {v:.4f}" if abs(v) < 1e4 else f"{k} {v:.3e}")
+                elif isinstance(v, dict):
+                    # structured sub-records (staleness_hist, per-bucket
+                    # transport timings) print as compact json, not repr
+                    parts.append(f"{k} {json.dumps(v, separators=(',', ':'))}")
                 else:
                     parts.append(f"{k} {v}")
             print("  ".join(parts), file=self.stream)

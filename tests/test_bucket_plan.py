@@ -1,0 +1,135 @@
+"""BucketPlan / BucketAssembler round-trip — the bucketed transport's
+codec layer (backends/common.py).
+
+Slice → frame (encode_chunks, one copy per slice) → decode (zero-copy raw
+view) → reassemble must be the identity for any dtype mix, odd sizes, and
+bucket sizes that split tensors mid-buffer; and the assembler's epoch tags
+must make a torn multi-bucket push structurally impossible to observe.
+"""
+
+import numpy as np
+import pytest
+
+from ps_tpu.backends.common import BucketAssembler, BucketPlan
+from ps_tpu.control import tensor_van as tv
+
+
+def _round_trip(arrays, bucket_bytes):
+    plan = BucketPlan.from_arrays(arrays, bucket_bytes)
+    asm = BucketAssembler(epoch=7, nbuckets=plan.nbuckets)
+    done = False
+    for b in range(plan.nbuckets):
+        frame = plan.encode_bucket(tv.BUCKET_PUSH, 3, arrays, b,
+                                   extra={"epoch": 7})
+        kind, worker, tensors, extra = tv.decode(memoryview(bytes(frame)))
+        assert kind == tv.BUCKET_PUSH and worker == 3
+        assert extra["epoch"] == 7
+        assert extra["nbuckets"] == plan.nbuckets
+        done = asm.add(extra["bucket"], tensors["raw"], extra["slices"],
+                       extra["epoch"])
+    assert done
+    out = asm.finish()
+    assert sorted(out) == sorted(arrays)
+    for k, v in arrays.items():
+        got = out[k]
+        assert got.dtype == np.asarray(v).dtype
+        assert got.shape == np.asarray(v).shape
+        np.testing.assert_array_equal(got, np.asarray(v), err_msg=k)
+    return plan
+
+
+def test_round_trip_dtype_mix_and_odd_sizes():
+    rng = np.random.default_rng(0)
+    arrays = {
+        "a/f32": rng.normal(0, 1, (13, 7)).astype(np.float32),
+        "b/f16": rng.normal(0, 1, (9, 11)).astype(np.float16),
+        "c/i32": rng.integers(-5, 5, (17,)).astype(np.int32),
+        "d/f64": rng.normal(0, 1, (3, 5, 2)).astype(np.float64),
+        "e/u8": rng.integers(0, 255, (101,)).astype(np.uint8),
+    }
+    for bucket_bytes in (1, 37, 128, 1000, 1 << 20):
+        _round_trip(arrays, bucket_bytes)
+
+
+def test_large_tensor_splits_across_buckets():
+    a = {"big/w": np.arange(10_000, dtype=np.float32)}
+    plan = _round_trip(a, 1024)
+    assert plan.nbuckets == int(np.ceil(40_000 / 1024))
+    # every bucket except possibly the last is exactly full
+    for bucket in plan.buckets[:-1]:
+        assert sum(hi - lo for _, _, _, lo, hi in bucket) == 1024
+
+
+def test_small_tensors_fuse_into_one_bucket():
+    arrays = {f"k{i:02d}": np.full((4,), i, np.float32) for i in range(10)}
+    plan = _round_trip(arrays, 1 << 20)
+    assert plan.nbuckets == 1
+    assert len(plan.buckets[0]) == 10
+
+
+def test_zero_size_and_scalar_tensors():
+    arrays = {
+        "empty": np.zeros((0, 5), np.float32),
+        "scalar": np.asarray(np.float32(3.5)).reshape(()),
+        "one": np.ones((1,), np.int32),
+    }
+    _round_trip(arrays, 8)
+
+
+def test_transport_order_is_sorted_keys():
+    arrays = {"z/last": np.zeros(4, np.float32),
+              "a/first": np.ones(4, np.float32)}
+    plan = BucketPlan.from_arrays(arrays, 1 << 20)
+    assert plan.buckets[0][0][0] == "a/first"  # front of the model first
+
+
+def test_epoch_mismatch_refused():
+    arrays = {"w": np.arange(100, dtype=np.float32)}
+    plan = BucketPlan.from_arrays(arrays, 64)
+    assert plan.nbuckets > 1
+    asm = BucketAssembler(epoch=1, nbuckets=plan.nbuckets)
+    frame = plan.encode_bucket(tv.BUCKET_PUSH, 0, arrays, 0,
+                               extra={"epoch": 2})
+    _, _, tensors, extra = tv.decode(memoryview(bytes(frame)))
+    with pytest.raises(RuntimeError, match="torn"):
+        asm.add(extra["bucket"], tensors["raw"], extra["slices"],
+                extra["epoch"])
+
+
+def test_duplicate_bucket_refused():
+    arrays = {"w": np.arange(64, dtype=np.float32)}
+    plan = BucketPlan.from_arrays(arrays, 64)
+    asm = BucketAssembler(epoch=0, nbuckets=plan.nbuckets)
+    frame = plan.encode_bucket(tv.BUCKET_PUSH, 0, arrays, 0,
+                               extra={"epoch": 0})
+    _, _, tensors, extra = tv.decode(memoryview(bytes(frame)))
+    asm.add(0, tensors["raw"], extra["slices"], 0)
+    with pytest.raises(RuntimeError, match="duplicate"):
+        asm.add(0, tensors["raw"], extra["slices"], 0)
+
+
+def test_incomplete_epoch_cannot_finish():
+    arrays = {"w": np.arange(100, dtype=np.float32)}
+    plan = BucketPlan.from_arrays(arrays, 64)
+    assert plan.nbuckets > 1
+    asm = BucketAssembler(epoch=0, nbuckets=plan.nbuckets)
+    frame = plan.encode_bucket(tv.BUCKET_PUSH, 0, arrays, 0,
+                               extra={"epoch": 0})
+    _, _, tensors, extra = tv.decode(memoryview(bytes(frame)))
+    assert not asm.add(0, tensors["raw"], extra["slices"], 0)
+    with pytest.raises(RuntimeError, match="incomplete"):
+        asm.finish()
+
+
+def test_total_bytes_and_coverage():
+    rng = np.random.default_rng(1)
+    arrays = {f"t{i}": rng.normal(0, 1, (i + 1, 3)).astype(np.float32)
+              for i in range(5)}
+    plan = BucketPlan.from_arrays(arrays, 40)
+    covered = {}
+    for bucket in plan.buckets:
+        for key, _, _, lo, hi in bucket:
+            covered[key] = covered.get(key, 0) + (hi - lo)
+    for k, v in arrays.items():
+        assert covered[k] == v.nbytes, k
+    assert plan.total_bytes == sum(v.nbytes for v in arrays.values())

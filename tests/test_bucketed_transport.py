@@ -1,0 +1,477 @@
+"""Bucketed, pipelined push/pull transport — the compute/comm-overlap path.
+
+The transport contract: bucketing, striping over the connection pool, and
+background cycles change NOTHING about the math. A bucketed worker's
+push/pull sequence drives the engine through exactly the serial event
+order (whole-tree applies, atomic snapshot pulls), a torn multi-bucket
+push is never observable (per-key epoch tags + complete-epoch commit), and
+the overlapped step function is loss-for-loss identical to the serial one
+on the MNIST MLP config.
+"""
+
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import ps_tpu as ps
+from ps_tpu.backends.common import BucketPlan
+from ps_tpu.backends.remote_async import (
+    AsyncPSService,
+    RemoteAsyncWorker,
+    connect_async,
+    shard_tree,
+)
+from ps_tpu.control import tensor_van as tv
+from ps_tpu.kv import keys as keymod
+
+
+def _params(seed=0, n=6, shape=(32, 17)):
+    rng = np.random.default_rng(seed)
+    return {f"layer{i}/w": jnp.asarray(
+        rng.normal(0, 1, shape).astype(np.float32)) for i in range(n)}
+
+
+def _flat(tree):
+    return {k: np.asarray(v)
+            for k, v in keymod.flatten_with_keys(tree)[0].items()}
+
+
+def _fresh_job(params, num_workers=1):
+    ps.init(backend="tpu", mode="async", num_workers=num_workers,
+            dc_lambda=0.04)
+    store = ps.KVStore(optimizer="sgd", learning_rate=0.05, mode="async")
+    store.init(params)
+    return store, AsyncPSService(store, bind="127.0.0.1")
+
+
+def test_bucketed_push_pull_matches_serial_bit_for_bit():
+    """Two identical single-worker jobs, same grad sequence: the serial and
+    the bucketed transports land bit-identical parameters."""
+    params = _params()
+    grads_seq = [
+        {k: jnp.full_like(v, 0.01 * (s + 1)) for k, v in params.items()}
+        for s in range(4)
+    ]
+    finals = []
+    for bucket_bytes in (None, 1 << 12):
+        store, svc = _fresh_job(params)
+        w = RemoteAsyncWorker("127.0.0.1", svc.port, 0, params,
+                              bucket_bytes=bucket_bytes, pool_size=3)
+        w.pull_all()
+        for g in grads_seq:
+            w.push_pull(g)
+        finals.append(_flat(w._params))
+        assert w.version == len(grads_seq)
+        w.close()
+        svc.stop()
+        ps.shutdown()
+    for k in finals[0]:
+        np.testing.assert_array_equal(finals[0][k], finals[1][k], err_msg=k)
+
+
+def test_bucketed_multi_server_partition():
+    """Bucketed transport over a 2-shard key partition: every owner gets
+    its subtree, versions advance per shard, results match serial."""
+    params = _params(seed=3)
+    grads = {k: jnp.full_like(v, 0.02) for k, v in params.items()}
+    finals = []
+    for bucket_bytes in (None, 1 << 11):
+        ps.init(backend="tpu", mode="async", num_workers=1, dc_lambda=0.0)
+        svcs = []
+        for s in range(2):
+            st = ps.KVStore(optimizer="sgd", learning_rate=0.1, mode="async")
+            st.init(shard_tree(params, s, 2))
+            svcs.append(AsyncPSService(st, bind="127.0.0.1",
+                                       shard=s, num_shards=2))
+        uri = ",".join(f"127.0.0.1:{s.port}" for s in svcs)
+        w = connect_async(uri, 0, params, bucket_bytes=bucket_bytes)
+        w.pull_all()
+        w.push_pull(grads)
+        w.push_pull(grads)
+        assert w.versions == [2, 2]
+        finals.append(_flat(w._params))
+        w.close()
+        for s in svcs:
+            s.stop()
+        ps.shutdown()
+    for k in finals[0]:
+        np.testing.assert_array_equal(finals[0][k], finals[1][k], err_msg=k)
+
+
+def test_torn_push_is_never_observable():
+    """Send all but one bucket of a push epoch, pull concurrently: params
+    and version are untouched (the partial push is invisible). The final
+    bucket commits the whole tree atomically."""
+    params = _params(seed=5, n=4, shape=(64, 16))
+    store, svc = _fresh_job(params)
+    w = RemoteAsyncWorker("127.0.0.1", svc.port, 0, params)
+    before = _flat(w.pull_all())
+
+    host = {k: np.full(np.asarray(v).shape, 0.5, np.float32)
+            for k, v in params.items()}
+    plan = BucketPlan.from_arrays(host, 1 << 10)
+    assert plan.nbuckets >= 3, "tree too small to tear"
+    ch = tv.Channel.connect("127.0.0.1", svc.port)
+    for b in range(plan.nbuckets - 1):  # everything EXCEPT the last bucket
+        kind, _, _, extra = tv.decode(ch.request(plan.encode_bucket(
+            tv.BUCKET_PUSH, 0, host, b, extra={"epoch": 1})))
+        assert kind == tv.OK and "committed" not in extra
+
+    # a concurrent reader sees the pre-push state, and no version advance
+    assert store._engine.version == 0
+    mid = _flat(w.pull_all())
+    for k in before:
+        np.testing.assert_array_equal(before[k], mid[k], err_msg=k)
+
+    # the completing bucket commits exactly one whole-tree apply
+    kind, _, _, extra = tv.decode(ch.request(plan.encode_bucket(
+        tv.BUCKET_PUSH, 0, host, plan.nbuckets - 1, extra={"epoch": 1})))
+    assert kind == tv.OK and extra.get("committed")
+    assert int(extra["version"]) == 1
+    after = _flat(w.pull_all())
+    changed = any(not np.array_equal(before[k], after[k]) for k in before)
+    assert changed, "committed push had no effect"
+    ch.close()
+    w.close()
+    svc.stop()
+    ps.shutdown()
+
+
+def test_abandoned_epoch_superseded_not_merged():
+    """Buckets of epoch 1 left incomplete, then a full epoch 2 push: the
+    stale epoch is dropped whole — its slices never contaminate epoch 2's
+    tree (the per-key epoch tag contract)."""
+    params = _params(seed=6, n=3, shape=(64, 8))
+    store, svc = _fresh_job(params)
+    w = RemoteAsyncWorker("127.0.0.1", svc.port, 0, params)
+    w.pull_all()
+
+    poison = {k: np.full(np.asarray(v).shape, 99.0, np.float32)
+              for k, v in params.items()}
+    real = {k: np.full(np.asarray(v).shape, 0.25, np.float32)
+            for k, v in params.items()}
+    plan = BucketPlan.from_arrays(poison, 1 << 9)
+    assert plan.nbuckets >= 2
+    ch = tv.Channel.connect("127.0.0.1", svc.port)
+    kind, _, _, _ = tv.decode(ch.request(plan.encode_bucket(
+        tv.BUCKET_PUSH, 0, poison, 0, extra={"epoch": 1})))
+    assert kind == tv.OK
+
+    plan2 = BucketPlan.from_arrays(real, 1 << 9)
+    for b in range(plan2.nbuckets):
+        kind, _, _, extra = tv.decode(ch.request(plan2.encode_bucket(
+            tv.BUCKET_PUSH, 0, real, b, extra={"epoch": 2})))
+        assert kind == tv.OK
+    assert extra.get("committed") and int(extra["version"]) == 1
+
+    # replay: one engine apply of exactly `real` on the initial params
+    ps_ref = ps.KVStore(optimizer="sgd", learning_rate=0.05, mode="async")
+    ps_ref.init(params)
+    eng = ps_ref._engine
+    eng.pull_tree(worker=0)
+    eng.push_tree(real, worker=0)
+    want = {k: np.asarray(v) for k, v in eng.pull_tree(worker=0).items()}
+    got = _flat(w.pull_all())
+    for k in want:
+        np.testing.assert_array_equal(want[k], got[k], err_msg=k)
+    ch.close()
+    w.close()
+    svc.stop()
+    ps.shutdown()
+
+
+def test_overlap_cycle_and_flush_barrier():
+    """push_pull_async returns immediately; wait() yields the post-apply
+    params; flush() is a full barrier; transport stats populate."""
+    params = _params(seed=7)
+    store, svc = _fresh_job(params)
+    w = RemoteAsyncWorker("127.0.0.1", svc.port, 0, params,
+                          bucket_bytes=1 << 12, pool_size=2)
+    w.pull_all()
+    grads = {k: jnp.full_like(v, 0.01) for k, v in params.items()}
+    pending = w.push_pull_async(grads)
+    got = _flat(pending.wait())
+    assert store._engine.version == 1
+    want = {k: np.asarray(v)
+            for k, v in store._engine.pull_tree(worker=1).items()}
+    for k in want:
+        np.testing.assert_array_equal(want[k], got[k], err_msg=k)
+    w.push_pull_async(grads)
+    w.flush()
+    assert store._engine.version == 2
+    eff = w.transport.overlap_efficiency()
+    assert eff is not None and 0.0 <= eff <= 1.0
+    assert w.transport.cycles == 2
+    assert w.transport.buckets > 0
+    w.close()
+    svc.stop()
+    ps.shutdown()
+
+
+def test_overlap_step_loss_parity_mnist_mlp():
+    """The satellite acceptance test: on the MNIST MLP config, the
+    overlapped step function produces EXACTLY the serial step's losses —
+    overlap hides transport, it never changes what grads are computed
+    against."""
+    from ps_tpu.data.synthetic import mnist_batches
+    from ps_tpu.models.mlp import MLP, cross_entropy_loss
+
+    model = MLP(hidden=32)
+    params0 = model.init(jax.random.key(0),
+                         jnp.zeros((1, 28, 28, 1)))["params"]
+
+    def loss_fn(p, batch):
+        images, labels = batch
+        return cross_entropy_loss(model.apply({"params": p}, images), labels)
+
+    steps, bs = 8, 32
+    losses = {}
+    for mode in ("serial", "overlap"):
+        ps.init(backend="tpu", mode="async", num_workers=1, dc_lambda=0.04)
+        store = ps.KVStore(optimizer="sgd", learning_rate=0.1, mode="async")
+        store.init(params0)
+        svc = AsyncPSService(store, bind="127.0.0.1")
+        kw = (dict(bucket_bytes=1 << 12, pool_size=2)
+              if mode == "overlap" else {})
+        w = RemoteAsyncWorker("127.0.0.1", svc.port, 0, params0, **kw)
+        run = w.make_async_step(loss_fn, overlap=(mode == "overlap"))
+        ls = []
+        for batch in mnist_batches(bs, steps=steps):
+            images, labels = batch
+            ls.append(float(run((jnp.asarray(images), jnp.asarray(labels)))))
+        if mode == "overlap":
+            w.flush()
+        losses[mode] = ls
+        assert store._engine.version == steps
+        w.close()
+        svc.stop()
+        ps.shutdown()
+    np.testing.assert_array_equal(np.array(losses["serial"]),
+                                  np.array(losses["overlap"]))
+    assert losses["serial"][-1] < losses["serial"][0], "model did not learn"
+
+
+def test_overlap_under_concurrent_workers():
+    """A bucketed overlapped worker and a serial worker hammer one server
+    concurrently: all cycles land, versions account for every push, and
+    the engine never sees a torn tree (its key check would raise)."""
+    params = _params(seed=9, n=4)
+    store, svc = _fresh_job(params, num_workers=2)
+    w0 = RemoteAsyncWorker("127.0.0.1", svc.port, 0, params,
+                           bucket_bytes=1 << 11, pool_size=2)
+    w1 = RemoteAsyncWorker("127.0.0.1", svc.port, 1, params)
+    w0.pull_all()
+    w1.pull_all()
+    grads = {k: jnp.full_like(v, 0.005) for k, v in params.items()}
+    cycles = 6
+    errs = []
+
+    def serial_loop():
+        try:
+            for _ in range(cycles):
+                w1.push_pull(grads)
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    t = threading.Thread(target=serial_loop)
+    t.start()
+    for _ in range(cycles):
+        w0.push_pull_async(grads)
+    w0.flush()
+    t.join(timeout=60)
+    assert not t.is_alive() and not errs, errs
+    assert store._engine.version == 2 * cycles
+    w0.close()
+    w1.close()
+    svc.stop()
+    ps.shutdown()
+
+
+def test_sparse_bucketed_push_matches_serial():
+    """Sparse twin: a bucketed multi-table row push commits atomically and
+    matches the serial push bit-for-bit."""
+    from ps_tpu.backends.remote_sparse import (
+        RemoteSparseWorker,
+        SparsePSService,
+    )
+    from ps_tpu.kv.sparse import SparseEmbedding
+
+    ids = np.arange(0, 40, dtype=np.int32)
+    grads = np.ones((40, 8), np.float32) * 0.1
+    finals = []
+    for bucket_bytes in (None, 1 << 9):
+        ps.init(backend="tpu", mode="async", num_workers=1)
+        emb = SparseEmbedding(64, 8, optimizer="sgd", learning_rate=0.1)
+        emb.init(jax.random.key(1), scale=0.01)
+        svc = SparsePSService({"deep": emb}, bind="127.0.0.1")
+        w = RemoteSparseWorker([("127.0.0.1", svc.port)], 0,
+                               {"deep": (64, 8)}, bucket_bytes=bucket_bytes)
+        w.push({"deep": (ids, grads)})
+        h = None
+        if bucket_bytes is not None:  # and the async form
+            h = w.push_async({"deep": (ids, grads)})
+            w.flush()
+            assert h.done()
+        else:
+            w.push({"deep": (ids, grads)})
+        assert w.versions() == {"deep": 2}
+        finals.append(w.pull({"deep": np.arange(64, dtype=np.int32)})["deep"])
+        w.close()
+        svc.stop()
+        ps.shutdown()
+    np.testing.assert_array_equal(finals[0], finals[1])
+
+
+def test_metrics_surface_overlap_efficiency():
+    """TrainMetrics picks the transport stats off the worker (same counter
+    surface as the byte counters) and reports overlap_efficiency."""
+    from ps_tpu.utils.metrics import TrainMetrics
+
+    params = _params(seed=11, n=3)
+    store, svc = _fresh_job(params)
+    w = RemoteAsyncWorker("127.0.0.1", svc.port, 0, params,
+                          bucket_bytes=1 << 12)
+    w.pull_all()
+    m = TrainMetrics(w, batch_size=8, num_chips=1)
+    grads = {k: jnp.full_like(v, 0.01) for k, v in params.items()}
+    for _ in range(3):
+        w.push_pull_async(grads).wait()
+        m.step(0.0)
+    s = m.summary()
+    assert "overlap_efficiency" in s and 0.0 <= s["overlap_efficiency"] <= 1.0
+    assert "bucket_gbps" in s and s["bucket_gbps"] >= 0
+    assert s["push_pull_gbps"] > 0
+    w.close()
+    svc.stop()
+    ps.shutdown()
+
+
+def test_restarted_worker_pushes_past_stale_staged_epoch():
+    """A worker that died mid-push leaves an incomplete staged epoch on
+    the server; a restarted worker with the SAME id starts its epoch
+    counter over. Its pushes must supersede the stale staging (never be
+    refused as 'stale'), and the abandoned epoch must be dropped whole."""
+    params = _params(seed=13, n=3, shape=(64, 8))
+    store, svc = _fresh_job(params)
+    host = {k: np.full(np.asarray(v).shape, 9.0, np.float32)
+            for k, v in params.items()}
+    plan = BucketPlan.from_arrays(host, 1 << 9)
+    assert plan.nbuckets >= 2
+    ch = tv.Channel.connect("127.0.0.1", svc.port)
+    # "old incarnation" got to epoch 40 and died mid-push
+    kind, _, _, _ = tv.decode(ch.request(plan.encode_bucket(
+        tv.BUCKET_PUSH, 0, host, 0, extra={"epoch": 40})))
+    assert kind == tv.OK
+    ch.close()
+
+    # fresh incarnation, same worker id, epoch counter starts over
+    w = RemoteAsyncWorker("127.0.0.1", svc.port, 0, params,
+                          bucket_bytes=1 << 9, pool_size=2)
+    w.pull_all()
+    w.push_pull({k: jnp.full_like(v, 0.01) for k, v in params.items()})
+    assert store._engine.version == 1  # exactly the new push, nothing torn
+    w.close()
+    svc.stop()
+    ps.shutdown()
+
+
+def test_same_epoch_number_across_incarnations_never_merges():
+    """The nastiest tear: a worker dies during its FIRST push (epoch 1)
+    with later buckets staged; a restarted same-id worker pushes ITS epoch
+    1. Identical epoch numbers, different incarnations — the incarnation
+    nonce must make the server drop the dead push whole, never complete it
+    with the new worker's buckets (a silent cross-push merge)."""
+    params = _params(seed=16, n=3, shape=(64, 8))
+    store, svc = _fresh_job(params)
+    poison = {k: np.full(np.asarray(v).shape, 77.0, np.float32)
+              for k, v in params.items()}
+    plan = BucketPlan.from_arrays(poison, 1 << 9)
+    assert plan.nbuckets >= 3
+    ch = tv.Channel.connect("127.0.0.1", svc.port)
+    # dead incarnation staged its LATER buckets of epoch 1, then died
+    for b in range(1, plan.nbuckets):
+        kind, _, _, _ = tv.decode(ch.request(plan.encode_bucket(
+            tv.BUCKET_PUSH, 0, poison, b,
+            extra={"epoch": 1, "nonce": "dead-incarnation"})))
+        assert kind == tv.OK
+    ch.close()
+
+    # restarted worker: same id, its own epoch counter starts at 1
+    w = RemoteAsyncWorker("127.0.0.1", svc.port, 0, params,
+                          bucket_bytes=1 << 9, pool_size=2)
+    w.pull_all()
+    real = {k: jnp.full_like(v, 0.25) for k, v in params.items()}
+    w.push_pull(real)
+    assert store._engine.version == 1
+
+    # replay: the engine state must equal ONE pure apply of `real` — no
+    # poison slice may have survived into the committed tree
+    ref = ps.KVStore(optimizer="sgd", learning_rate=0.05, mode="async")
+    ref.init(params)
+    ref._engine.pull_tree(worker=0)
+    ref._engine.push_tree({k: np.asarray(v) for k, v in real.items()},
+                          worker=0)
+    want = {k: np.asarray(v)
+            for k, v in ref._engine.pull_tree(worker=0).items()}
+    got = _flat(w.pull_all())
+    for k in want:
+        np.testing.assert_array_equal(want[k], got[k], err_msg=k)
+    w.close()
+    svc.stop()
+    ps.shutdown()
+
+
+def test_reconnect_preserves_epoch_stream_and_cycles_flush():
+    """reconnect() on a bucketed worker: in-flight cycles are landed (or
+    failed) first — never left as forever-pending futures — and the push
+    epoch stream continues instead of resetting."""
+    params = _params(seed=14, n=3)
+    store, svc = _fresh_job(params)
+    w = RemoteAsyncWorker("127.0.0.1", svc.port, 0, params,
+                          bucket_bytes=1 << 12, pool_size=2)
+    w.pull_all()
+    grads = {k: jnp.full_like(v, 0.01) for k, v in params.items()}
+    w.push_pull_async(grads)
+    epoch_before = None
+    w.reconnect()  # flushes the in-flight cycle, then re-dials
+    epoch_before = w._push_epoch
+    assert store._engine.version == 1  # the background cycle landed
+    w.push_pull(grads)
+    assert w._push_epoch == epoch_before + 1  # stream continued, not reset
+    assert store._engine.version == 2
+    w.close()
+    svc.stop()
+    ps.shutdown()
+
+
+def test_pending_cycles_do_not_accumulate():
+    """Overlap-mode bookkeeping prunes resolved cycles: a long run that
+    never calls flush() must not pin one params tree per step."""
+    params = _params(seed=15, n=2, shape=(16, 4))
+    store, svc = _fresh_job(params)
+    w = RemoteAsyncWorker("127.0.0.1", svc.port, 0, params,
+                          bucket_bytes=1 << 12)
+    w.pull_all()
+    grads = {k: jnp.full_like(v, 0.001) for k, v in params.items()}
+    for _ in range(12):
+        w.push_pull_async(grads).wait()
+    assert len(w._pending_cycles) <= 2, len(w._pending_cycles)
+    w.flush()
+    assert store._engine.version == 12
+    w.close()
+    svc.stop()
+    ps.shutdown()
+
+
+def test_serial_worker_rejects_async_api():
+    params = _params(seed=12, n=2)
+    store, svc = _fresh_job(params)
+    w = RemoteAsyncWorker("127.0.0.1", svc.port, 0, params)
+    with pytest.raises(RuntimeError, match="bucket_bytes"):
+        w.push_pull_async({k: jnp.zeros_like(v) for k, v in params.items()})
+    w.close()
+    svc.stop()
+    ps.shutdown()

@@ -35,6 +35,28 @@ def test_bench_van_smoke():
     assert "concurrent_pull_2w_gbps" in out
 
 
+def test_bench_transport_smoke():
+    """bench.py --model transport: the tentpole's win condition probe —
+    must emit serial vs bucketed GB/s and an overlap-efficiency figure.
+    (Not marked slow: it is the acceptance gauge for the bucketed
+    transport and runs in seconds at this scale.)"""
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py"),
+         "--model", "transport", "--steps", "2", "--transport-mb", "8"],
+        env=env, capture_output=True, text=True, timeout=420,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["metric"] == "van_push_pull_gbps_bucketed"
+    d = out["detail"]
+    assert d["serial_gbps"] > 0 and d["bucketed_gbps"] > 0
+    assert d["overlap_efficiency"] is None or 0 <= d["overlap_efficiency"] <= 1
+    assert d["transport"]["transport_buckets"] > 0
+
+
 @pytest.mark.slow
 def test_bench_dc_asgd_smoke():
     out = _run("bench_dc_asgd.py", "--applies", "12", "--eval-every", "6",

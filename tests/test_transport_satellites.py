@@ -1,0 +1,439 @@
+"""Regression tests for the transport-PR satellite fixes (ADVICE r5):
+
+1. checkpoint pause ownership tokens — two coordinators cannot tear a
+   snapshot (remote_async.py / remote_sparse.py);
+2. reconnect() preserves cumulative wire counters and re-inits via
+   _init_multi (dense and sparse);
+3. ckpt_root confines CHECKPOINT saves (absolute / ``..`` paths refused);
+4. stop() short-circuits the drain grace for pause-blocked requests
+   (van_service.py).
+"""
+
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import ps_tpu as ps
+from ps_tpu.backends.remote_async import (
+    AsyncPSService,
+    RemoteAsyncWorker,
+    connect_async,
+)
+from ps_tpu.backends.van_service import resolve_ckpt_dir
+from ps_tpu.control import tensor_van as tv
+
+
+def _dense_job(params, num_workers=2, **svc_kw):
+    ps.init(backend="tpu", mode="async", num_workers=num_workers)
+    store = ps.KVStore(optimizer="sgd", learning_rate=0.1, mode="async")
+    store.init(params)
+    return store, AsyncPSService(store, bind="127.0.0.1", **svc_kw)
+
+
+def _ckpt(ch, worker, **extra):
+    kind, _, _, e = tv.decode(ch.request(
+        tv.encode(tv.CHECKPOINT, worker, None, extra=extra)))
+    return kind, e
+
+
+# -- 1. checkpoint pause tokens -----------------------------------------------
+
+
+def test_second_pause_refused_and_foreign_resume_rejected(tmp_path):
+    params = {"w": jnp.zeros((16, 16))}
+    store, svc = _dense_job(params)
+    ch = tv.Channel.connect("127.0.0.1", svc.port)
+
+    kind, e1 = _ckpt(ch, 0, phase="pause", dir="x")
+    assert kind == tv.OK and "token" in e1
+    # a second coordinator's pause is refused while one is outstanding
+    kind, e2 = _ckpt(ch, 1, phase="pause", dir="x")
+    assert kind == tv.ERR and "already in progress" in e2["error"]
+    # resume without / with a wrong token cannot unpause the first
+    kind, _ = _ckpt(ch, 1, phase="resume", dir="x")
+    assert kind == tv.ERR
+    kind, _ = _ckpt(ch, 1, phase="resume", dir="x", token=9999)
+    assert kind == tv.ERR
+    assert svc._paused
+    # save with a wrong token is refused too (the snapshot stays ours)
+    kind, _ = _ckpt(ch, 1, phase="save", dir=str(tmp_path / "evil"))
+    assert kind == tv.ERR
+    # the owner's token works end to end
+    kind, _ = _ckpt(ch, 0, phase="save", dir=str(tmp_path / "ok"),
+                    token=e1["token"])
+    assert kind == tv.OK
+    kind, _ = _ckpt(ch, 0, phase="resume", dir="x", token=e1["token"])
+    assert kind == tv.OK
+    assert not svc._paused and svc._ckpt_token is None
+    # and a fresh pause hands out a NEW token (stale tokens die)
+    kind, e3 = _ckpt(ch, 0, phase="pause", dir="x")
+    assert kind == tv.OK and e3["token"] != e1["token"]
+    kind, _ = _ckpt(ch, 0, phase="resume", dir="x", token=e3["token"])
+    assert kind == tv.OK
+    ch.close()
+    svc.stop()
+    ps.shutdown()
+
+
+def test_concurrent_checkpoint_all_coordinators_serialize(tmp_path):
+    """Two workers hammer checkpoint_all concurrently: losers get a typed
+    failure (never a torn snapshot), the fleet is never left paused, and
+    at least one coordinator succeeds per round."""
+    params = {f"p{i}/w": jnp.zeros((8, 8)) for i in range(4)}
+    store, svc = _dense_job(params, num_workers=2)
+    uri = f"127.0.0.1:{svc.port}"
+    w0 = connect_async(uri, 0, params)
+    w1 = connect_async(uri, 1, params)
+    results = {0: [], 1: []}
+
+    def coordinator(w, wid):
+        for i in range(4):
+            try:
+                w.checkpoint_all(str(tmp_path / f"c{wid}_{i}"))
+                results[wid].append("ok")
+            except RuntimeError as e:
+                assert ("already in progress" in str(e)
+                        or "invalid token" in str(e)), e
+                results[wid].append("refused")
+
+    ts = [threading.Thread(target=coordinator, args=(w, i))
+          for i, w in enumerate([w0, w1])]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(timeout=120)
+    assert all(not t.is_alive() for t in ts)
+    assert "ok" in results[0] + results[1]
+    # fleet not wedged: a later push succeeds and a clean pause is possible
+    w0.pull_all()
+    w0.push_all({k: jnp.full_like(v, 0.1) for k, v in params.items()})
+    w1.checkpoint_all(str(tmp_path / "final"))
+    w0.close()
+    w1.close()
+    svc.stop()
+    ps.shutdown()
+
+
+def test_sparse_pause_token_protocol():
+    from ps_tpu.backends.remote_sparse import SparsePSService
+    from ps_tpu.kv.sparse import SparseEmbedding
+
+    ps.init(backend="tpu", mode="async", num_workers=1)
+    emb = SparseEmbedding(32, 4, optimizer="sgd", learning_rate=0.1)
+    emb.init(jax.random.key(0), scale=0.01)
+    svc = SparsePSService({"t": emb}, bind="127.0.0.1")
+    ch = tv.Channel.connect("127.0.0.1", svc.port)
+    kind, e1 = _ckpt(ch, 0, phase="pause", dir="x")
+    assert kind == tv.OK and "token" in e1
+    kind, e2 = _ckpt(ch, 1, phase="pause", dir="x")
+    assert kind == tv.ERR and "already in progress" in e2["error"]
+    kind, _ = _ckpt(ch, 1, phase="resume", dir="x", token=12345)
+    assert kind == tv.ERR and svc._paused
+    kind, _ = _ckpt(ch, 0, phase="resume", dir="x", token=e1["token"])
+    assert kind == tv.OK and not svc._paused
+    ch.close()
+    svc.stop()
+    ps.shutdown()
+
+
+def test_force_resume_recovers_a_dead_coordinator(tmp_path):
+    """A coordinator that dies between pause and resume must not wedge the
+    fleet forever: the documented operator escape hatch
+    (checkpoint_resume_force / phase=resume force=True) overrides the lost
+    token; a normal (non-forced) foreign resume still cannot."""
+    params = {"w": jnp.zeros((8, 8))}
+    store, svc = _dense_job(params)
+    # the doomed coordinator pauses, then "dies" (channel closed, token lost)
+    ch = tv.Channel.connect("127.0.0.1", svc.port)
+    kind, _ = _ckpt(ch, 0, phase="pause", dir="x")
+    assert kind == tv.OK
+    ch.close()
+    assert svc._paused
+    # another worker recovers the fleet
+    w = RemoteAsyncWorker("127.0.0.1", svc.port, 1, params)
+    with pytest.raises(RuntimeError):  # plain resume is still refused
+        w._checkpoint_round({"phase": "resume"})
+    w.checkpoint_resume_force()
+    assert not svc._paused and svc._ckpt_token is None
+    w.pull_all()
+    w.push_all({"w": jnp.ones((8, 8))})  # pushes flow again
+    # and the next full checkpoint cycle works normally
+    w.checkpoint_all(str(tmp_path / "after"))
+    w.close()
+    svc.stop()
+    ps.shutdown()
+
+
+def test_bucket_bytes_zero_means_serial():
+    """bucket_bytes=0 is the documented serial spelling (PS_BUCKET_BYTES=0)
+    on every surface — it must never mean 1-byte fusion buckets."""
+    params = {"w": jnp.zeros((8, 8))}
+    store, svc = _dense_job(params, num_workers=1)
+    w = RemoteAsyncWorker("127.0.0.1", svc.port, 0, params, bucket_bytes=0)
+    assert w.bucket_bytes is None and not w._pumps
+    w.pull_all()
+    w.push_pull({"w": jnp.ones((8, 8))})
+    assert store._engine.version == 1
+    w.close()
+    svc.stop()
+    ps.shutdown()
+
+
+def test_observed_cycle_failure_surfaces_exactly_once():
+    """A background cycle failure delivered through wait() must not be
+    re-raised by a later flush()/entry-barrier call."""
+    params = {"w": jnp.zeros((16, 16))}
+    store, svc = _dense_job(params, num_workers=1)
+    w = RemoteAsyncWorker("127.0.0.1", svc.port, 0, params,
+                          bucket_bytes=1 << 12)
+    w.pull_all()
+    # one healthy background cycle plus one already-failed handle whose
+    # error the caller observes via wait()
+    pending = w.push_pull_async({"w": jnp.ones((16, 16))})
+    bad = object.__new__(type(pending))
+    bad.__dict__.update(_evt=threading.Event(), _params=None,
+                        _exc=RuntimeError("boom"), _observed=False,
+                        _stats=None)
+    bad._evt.set()
+    w._track_pending(bad)
+    pending.wait()
+    with pytest.raises(RuntimeError, match="boom"):
+        bad.wait()  # delivered once ...
+    w.flush()  # ... and never again
+    w.push_pull({"w": jnp.ones((16, 16))})  # healthy call is not poisoned
+    assert store._engine.version == 2
+    w.close()
+    svc.stop()
+    ps.shutdown()
+
+
+def test_sparse_pull_does_not_overtake_push_async():
+    """pull() is an ordering barrier like push()/push_pull(): rows read
+    after push_async always reflect the worker's own in-flight push."""
+    from ps_tpu.backends.remote_sparse import (
+        RemoteSparseWorker,
+        SparsePSService,
+    )
+    from ps_tpu.kv.sparse import SparseEmbedding
+
+    ps.init(backend="tpu", mode="async", num_workers=1)
+    emb = SparseEmbedding(32, 4, optimizer="sgd", learning_rate=1.0)
+    emb.init(jax.random.key(0), scale=0.0)  # rows start at exactly 0
+    svc = SparsePSService({"t": emb}, bind="127.0.0.1")
+    w = RemoteSparseWorker([("127.0.0.1", svc.port)], 0, {"t": (32, 4)},
+                           bucket_bytes=64, pool_size=2)
+    ids = np.arange(16, dtype=np.int32)
+    for _ in range(4):
+        w.push_async({"t": (ids, np.ones((16, 4), np.float32))})
+    rows = w.pull({"t": ids})["t"]  # barrier: all 4 pushes applied first
+    assert w.versions() == {"t": 4}
+    np.testing.assert_array_equal(rows, np.full((16, 4), -4.0, np.float32))
+    w.close()
+    svc.stop()
+    ps.shutdown()
+
+
+# -- 2. reconnect preserves counters ------------------------------------------
+
+
+def test_dense_reconnect_preserves_wire_counters():
+    params = {"w": jnp.zeros((64, 64))}
+    store, svc = _dense_job(params, num_workers=1)
+    w = RemoteAsyncWorker("127.0.0.1", svc.port, 0, params)
+    w.pull_all()
+    w.push_pull({"w": jnp.ones((64, 64))})
+    pushed, pulled = w.bytes_pushed, w.bytes_pulled
+    assert pushed > 0 and pulled > 0
+    w.reconnect()
+    assert (w.bytes_pushed, w.bytes_pulled) == (pushed, pulled)
+    w.push_pull({"w": jnp.ones((64, 64))})  # and the stream continues
+    assert w.bytes_pushed > pushed and w.bytes_pulled > pulled
+    assert store._engine.version == 2
+    w.close()
+    svc.stop()
+    ps.shutdown()
+
+
+def test_sparse_reconnect_preserves_counters_and_is_retryable():
+    from ps_tpu.backends.remote_sparse import RemoteSparseWorker
+    from ps_tpu.kv.sparse import SparseEmbedding
+
+    ps.init(backend="tpu", mode="async", num_workers=1)
+    emb = SparseEmbedding(32, 4, optimizer="sgd", learning_rate=0.1)
+    emb.init(jax.random.key(0), scale=0.01)
+    from ps_tpu.backends.remote_sparse import SparsePSService
+
+    svc = SparsePSService({"t": emb}, bind="127.0.0.1")
+    w = RemoteSparseWorker([("127.0.0.1", svc.port)], 0, {"t": (32, 4)})
+    ids = np.arange(8, dtype=np.int32)
+    w.push({"t": (ids, np.ones((8, 4), np.float32))})
+    w.pull({"t": ids})
+    pushed, pulled = w.bytes_pushed, w.bytes_pulled
+    assert pushed > 0 and pulled > 0
+
+    w.reconnect()
+    assert (w.bytes_pushed, w.bytes_pulled) == (pushed, pulled)
+    assert w.versions() == {"t": 1}  # re-seeded from the live server
+
+    # a failed re-dial leaves the worker retryable: reconnect again works
+    with pytest.raises(Exception):
+        w.reconnect([("127.0.0.1", 1)])  # nothing listens on port 1
+    w.reconnect([("127.0.0.1", svc.port)])
+    assert (w.bytes_pushed, w.bytes_pulled) == (pushed, pulled)
+    w.push({"t": (ids, np.ones((8, 4), np.float32))})
+    assert w.versions() == {"t": 2}
+    w.close()
+    svc.stop()
+    ps.shutdown()
+
+
+# -- 3. ckpt_root hardening ---------------------------------------------------
+
+
+def test_resolve_ckpt_dir_unit():
+    assert resolve_ckpt_dir(None, "/anywhere") == "/anywhere"
+    assert resolve_ckpt_dir("/root/ck", "runs/a") == "/root/ck/runs/a"
+    assert resolve_ckpt_dir("/root/ck", "a/../b") == "/root/ck/b"
+    with pytest.raises(ValueError, match="absolute"):
+        resolve_ckpt_dir("/root/ck", "/etc/passwd")
+    with pytest.raises(ValueError, match="escapes"):
+        resolve_ckpt_dir("/root/ck", "../outside")
+    with pytest.raises(ValueError, match="escapes"):
+        resolve_ckpt_dir("/root/ck", "a/../../outside")
+
+
+def test_ckpt_root_confines_saves(tmp_path):
+    params = {"w": jnp.zeros((8, 8))}
+    root = str(tmp_path / "root")
+    store, svc = _dense_job(params, num_workers=1, ckpt_root=root)
+    w = RemoteAsyncWorker("127.0.0.1", svc.port, 0, params)
+    w.pull_all()
+    w.checkpoint_all("runs/c1")
+    assert os.path.isdir(os.path.join(root, "runs", "c1"))
+    outside = tmp_path / "outside"
+    for bad in (str(outside), "../outside"):
+        with pytest.raises(RuntimeError):
+            w.checkpoint_all(bad)
+        assert not outside.exists()
+        # and the refusal resumed the fleet (push still lands)
+        w.push_all({"w": jnp.ones((8, 8))})
+    w.close()
+    svc.stop()
+    ps.shutdown()
+
+
+def test_sparse_ckpt_root_confines_saves(tmp_path):
+    from ps_tpu.backends.remote_sparse import (
+        RemoteSparseWorker,
+        SparsePSService,
+    )
+    from ps_tpu.kv.sparse import SparseEmbedding
+
+    ps.init(backend="tpu", mode="async", num_workers=1)
+    emb = SparseEmbedding(16, 4, optimizer="sgd", learning_rate=0.1)
+    emb.init(jax.random.key(0), scale=0.01)
+    root = str(tmp_path / "root")
+    svc = SparsePSService({"t": emb}, bind="127.0.0.1", ckpt_root=root)
+    w = RemoteSparseWorker([("127.0.0.1", svc.port)], 0, {"t": (16, 4)})
+    w.checkpoint_all("runs/s1")
+    assert os.path.isdir(os.path.join(root, "runs", "s1"))
+    with pytest.raises(RuntimeError):
+        w.checkpoint_all("/abs/elsewhere")
+    # fleet not wedged after the refusal
+    w.push({"t": (np.arange(4, dtype=np.int32),
+                  np.ones((4, 4), np.float32))})
+    w.close()
+    svc.stop()
+    ps.shutdown()
+
+
+# -- 4. stop() short-circuits pause-blocked requests --------------------------
+
+
+def test_stop_does_not_burn_grace_on_pause_blocked_pushes():
+    """A coordinator died between pause and resume; a worker's push is
+    parked on the pause condition. stop(grace=10) must NOT wait the full
+    grace for a request that can only finish once draining wakes it — it
+    returns promptly and the push is refused, not applied."""
+    params = {"w": jnp.zeros((16, 16))}
+    store, svc = _dense_job(params, num_workers=2)
+    w = RemoteAsyncWorker("127.0.0.1", svc.port, 0, params)
+    w.pull_all()
+    ch = tv.Channel.connect("127.0.0.1", svc.port)
+    kind, _ = _ckpt(ch, 1, phase="pause", dir="x")
+    assert kind == tv.OK
+
+    result = {}
+
+    def blocked_push():
+        try:
+            w.push_all({"w": jnp.ones((16, 16))})
+            result["applied"] = True
+        except Exception as e:  # noqa: BLE001 — asserted below
+            result["refused"] = e
+
+    t = threading.Thread(target=blocked_push)
+    t.start()
+    deadline = time.monotonic() + 10
+    while svc._pause_blocked == 0 and time.monotonic() < deadline:
+        time.sleep(0.02)  # wait until the push is parked on the pause
+    assert svc._pause_blocked == 1
+
+    t0 = time.monotonic()
+    svc.stop(grace=10.0)
+    elapsed = time.monotonic() - t0
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert elapsed < 5.0, f"stop burned {elapsed:.1f}s on a parked push"
+    assert "refused" in result and "applied" not in result
+    assert store._engine.version == 0  # nothing landed after stop
+    ch.close()
+    w.close()
+    ps.shutdown()
+
+
+def test_stop_still_waits_for_genuinely_executing_requests():
+    """The other half of the drain contract is unchanged: a request whose
+    apply is genuinely RUNNING (not pause-parked) still completes its
+    reply before the sever (the r4 flake regression)."""
+    params = {"w": jnp.zeros((64, 64))}
+    store, svc = _dense_job(params, num_workers=1)
+    w = RemoteAsyncWorker("127.0.0.1", svc.port, 0, params)
+    w.pull_all()
+    eng = store._engine
+    orig_push = eng.push_tree
+    in_apply, release = threading.Event(), threading.Event()
+
+    def slow_push(grads, worker=0):
+        in_apply.set()
+        release.wait(timeout=30)
+        return orig_push(grads, worker=worker)
+
+    eng.push_tree = slow_push
+    result = {}
+
+    def do_push():
+        try:
+            result["params"] = w.push_pull({"w": jnp.ones((64, 64))})
+        except Exception as e:  # noqa: BLE001
+            result["error"] = e
+
+    pusher = threading.Thread(target=do_push)
+    pusher.start()
+    assert in_apply.wait(timeout=30)
+    stopper = threading.Thread(target=svc.stop)
+    stopper.start()
+    time.sleep(0.3)
+    assert pusher.is_alive(), "reply torn while the apply was executing"
+    release.set()
+    pusher.join(timeout=30)
+    stopper.join(timeout=30)
+    assert "error" not in result, result.get("error")
+    assert eng.version == 1
+    w.close()
+    ps.shutdown()
