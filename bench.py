@@ -356,6 +356,10 @@ def bench_transport(args, retried: bool):
     """Van data-plane bench: serial vs bucketed/pipelined push_pull on the
     SAME server, same tree, same hardware — the tentpole's win condition —
     plus the overlap-efficiency of the background (push_pull_async) path.
+    ``--compress`` adds the codec subsystem (ps_tpu/compress) to the
+    bucketed workers: bytes-on-wire vs the raw payload is reported as
+    ``compress_ratio`` and the payload-level rate as ``effective_gbps``
+    (raw tree bytes moved per second, regardless of what traveled).
     Runs anywhere (pure host path: loopback TCP + the async engine on
     whatever platform jax picked)."""
     import numpy as np
@@ -374,7 +378,18 @@ def bench_transport(args, retried: bool):
             0, 1, (768, 768)).astype(np.float32)
         i += 1
     nbytes = sum(a.nbytes for a in tree.values())
-    grads = {k: np.zeros_like(v) for k, v in tree.items()}
+    # realistic grad magnitudes (NOT zeros: topk must rank something)
+    grads = {k: rng.normal(0, 1e-3, v.shape).astype(np.float32)
+             for k, v in tree.items()}
+
+    # codec spec for the bucketed/overlapped workers; pulls compress too
+    # for the stateless codecs (topk needs sender-side residuals, so its
+    # return path stays raw)
+    compress = None
+    if args.compress != "none":
+        compress = {"codec": args.compress, "topk": args.compress_topk,
+                    "min_bytes": args.compress_min_bytes,
+                    "pull": args.compress != "topk"}
 
     ps.init(backend="tpu", mode="async", num_workers=3)
     store = ps.KVStore(optimizer="sgd", learning_rate=0.01, mode="async")
@@ -388,9 +403,11 @@ def bench_transport(args, retried: bool):
         for _ in range(n):
             w.push_pull(grads)
         dt = max(time.monotonic() - t0, 1e-9)
-        return (w.bytes_pushed + w.bytes_pulled - b0) / dt / 1e9, dt
+        wire = w.bytes_pushed + w.bytes_pulled - b0
+        return wire / dt / 1e9, dt, wire
 
-    # serial path (one monolithic frame per cycle)
+    # serial path (one monolithic frame per cycle, never compressed —
+    # the raw baseline both ratios are against)
     ws = connect_async(uri, 0, tree)
     ws.pull_all()
     run_cycles(ws, 1)  # warm both sides' allocators
@@ -398,16 +415,24 @@ def bench_transport(args, retried: bool):
 
     # bucketed path (fusion buckets striped over the connection pool)
     wb = connect_async(uri, 1, tree, bucket_bytes=args.bucket_bytes,
-                       pool_size=args.pool)
+                       pool_size=args.pool, compress=compress)
     wb.pull_all()
     run_cycles(wb, 1)
-    bucketed_gbps = max(run_cycles(wb, cycles)[0] for _ in range(2))
+    reps = [run_cycles(wb, cycles) for _ in range(2)]
+    bucketed_gbps = max(r[0] for r in reps)
+    best = max(reps, key=lambda r: r[0])
+    wire_per_cycle = best[2] / cycles
+    # payload-level truth: raw bytes the application moved per cycle
+    # (grads out + params back), whatever traveled on the wire
+    payload_per_cycle = 2.0 * nbytes
+    effective_gbps = payload_per_cycle * cycles / best[1] / 1e9
+    wire_ratio = payload_per_cycle / wire_per_cycle
 
     # overlapped path: background cycles with host "compute" between them —
     # the overlap-efficiency metric is the fraction of transport wall time
     # hidden under that compute
     wo = connect_async(uri, 2, tree, bucket_bytes=args.bucket_bytes,
-                       pool_size=args.pool)
+                       pool_size=args.pool, compress=compress)
     wo.pull_all()
     h = np.zeros((1024, 1024), np.float32)
     t0 = time.monotonic()
@@ -444,6 +469,13 @@ def bench_transport(args, retried: bool):
             "bucket_bytes": args.bucket_bytes,
             "pool_size": args.pool,
             "default_bucket_bytes": DEFAULT_BUCKET_BYTES,
+            "compress": args.compress,
+            "compress_topk": (args.compress_topk
+                              if args.compress == "topk" else None),
+            "wire_bytes_per_cycle": int(wire_per_cycle),
+            "payload_bytes_per_cycle": int(payload_per_cycle),
+            "bytes_on_wire_ratio": round(wire_ratio, 3),
+            "effective_gbps": round(effective_gbps, 3),
             "overlap_efficiency": overlap_eff,
             "overlapped_wall_s": round(overlapped_dt, 3),
             "transport": ts,
@@ -452,7 +484,9 @@ def bench_transport(args, retried: bool):
                 "bucketed stripes BucketPlan fusion buckets over a "
                 "connection pool and pipelines encode/send/decode; "
                 "overlap_efficiency = fraction of transport wall time "
-                "hidden under host compute via push_pull_async"
+                "hidden under host compute via push_pull_async; with "
+                "--compress, bytes_on_wire_ratio = raw payload bytes / "
+                "wire bytes and effective_gbps is the payload-level rate"
             ),
         },
     }))
@@ -567,6 +601,16 @@ def main(argv=None, retried: bool = False):
                          "path")
     ap.add_argument("--pool", type=int, default=2,
                     help="(transport) striped connections per server")
+    ap.add_argument("--compress", default="none",
+                    choices=["none", "cast16", "int8", "topk"],
+                    help="(transport) gradient codec for the bucketed "
+                         "workers (ps_tpu/compress); pulls compress too "
+                         "for cast16/int8")
+    ap.add_argument("--compress-topk", type=float, default=0.01,
+                    help="(transport) kept fraction for --compress topk")
+    ap.add_argument("--compress-min-bytes", type=int, default=1 << 16,
+                    help="(transport) tensors under this size always "
+                         "travel raw")
     ap.add_argument("--per-chip-batch", type=int, default=None)
     ap.add_argument("--image-size", type=int, default=224)
     ap.add_argument("--seq-len", type=int, default=128)

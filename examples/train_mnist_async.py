@@ -99,6 +99,19 @@ def main():
                          "background (requires --bucket-bytes); gradients "
                          "are still computed against exactly the serial "
                          "step's params")
+    ap.add_argument("--compress", default=cfg.compress or "none",
+                    choices=["none", "cast16", "int8", "topk"],
+                    help="worker: gradient codec for the wire "
+                         "(ps_tpu/compress; env PS_COMPRESS). topk keeps "
+                         "--compress-topk of each tensor with error-"
+                         "feedback residuals")
+    ap.add_argument("--compress-topk", type=float, default=cfg.compress_topk,
+                    help="worker: kept fraction for --compress topk "
+                         "(env PS_COMPRESS_TOPK)")
+    ap.add_argument("--compress-min-bytes", type=int,
+                    default=cfg.compress_min_bytes,
+                    help="worker: tensors under this size always travel "
+                         "raw (env PS_COMPRESS_MIN_BYTES)")
     ap.add_argument("--shard", type=int, default=cfg.shard,
                     help="server: this server's index in an N-server key "
                          "partition (or env PS_SHARD)")
@@ -115,10 +128,17 @@ def main():
                              "(or PS_ASYNC_SERVER_URI)")
         from ps_tpu.utils import TrainMetrics
 
+        compress = None
+        if args.compress != "none":
+            compress = {"codec": args.compress,
+                        "topk": args.compress_topk,
+                        "min_bytes": args.compress_min_bytes,
+                        "pull": cfg.compress_pull}
         w = ps.connect_async(
             uri, args.worker_id, params,
             bucket_bytes=args.bucket_bytes or None,
             pool_size=args.pool if args.bucket_bytes else None,
+            compress=compress,
         )
         run = w.make_async_step(loss_fn, overlap=args.overlap)
         log = StepLogger(every=10)
@@ -150,6 +170,12 @@ def main():
                   f"{s['overlap_efficiency']:.2f} "
                   f"({s['transport_hidden_s']:.2f}s of transport hidden "
                   f"under compute)")
+        if "compress_ratio" in s:
+            extra = (f", residual norm {s['residual_norm']:.4f}"
+                     if "residual_norm" in s else "")
+            print(f"worker {args.worker_id}: compression "
+                  f"{s['compress_ratio']:.2f}x raw/wire "
+                  f"({s['codec_s']:.2f}s in codecs{extra})")
         w.close()
         return
 

@@ -46,6 +46,7 @@ from ps_tpu.backends.remote_sparse import (
     serve_sparse,
 )
 from ps_tpu import checkpoint
+from ps_tpu import compress
 from ps_tpu import optim
 from ps_tpu.data.files import file_batches, write_dataset
 from ps_tpu.ops import flash_attention
@@ -69,6 +70,7 @@ __all__ = [
     "row_range",
     "ServerFailureError",
     "checkpoint",
+    "compress",
     "optim",
     "file_batches",
     "write_dataset",

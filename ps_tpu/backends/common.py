@@ -274,7 +274,8 @@ class BucketedTransportMixin:
     _failure_noun = "PS server"
 
     def _init_transport(self, bucket_bytes: Optional[int],
-                        pool_size: Optional[int]) -> None:
+                        pool_size: Optional[int],
+                        compress=None) -> None:
         import uuid
 
         # <= 0 selects the serial transport, matching the PS_BUCKET_BYTES=0
@@ -294,6 +295,42 @@ class BucketedTransportMixin:
         self._pumps: Dict[int, List[ChannelPump]] = {}
         self._bg_pool = None                    # background cycle orchestrator
         self._pending_cycles: List = []         # unobserved background handles
+        # gradient compression (ps_tpu/compress): normalized spec dict or
+        # None; the compressor holds the per-key policy AND the topk
+        # error-feedback residuals, so it must survive reconnects (it is
+        # part of _saved_transport_state)
+        from ps_tpu.compress import CompressPolicy, GradCompressor, resolve_spec
+
+        self.compress = resolve_spec(compress)
+        if self.compress is not None and "seed" not in self.compress:
+            # decorrelate int8 stochastic rounding across workers: with a
+            # shared default seed every worker would draw the SAME uniform
+            # sequence each step, so quantization errors add coherently and
+            # the server-side average keeps full single-worker noise
+            # variance instead of variance/N
+            self.compress = dict(self.compress,
+                                 seed=int(getattr(self, "worker", 0)))
+        policy = CompressPolicy.from_spec(self.compress)
+        self._compressor = (GradCompressor(policy, stats=self.transport)
+                            if policy is not None else None)
+
+    def _encode_push_tree(self, arrays: Dict[str, np.ndarray]
+                          ) -> Tuple[Dict[str, np.ndarray], List[str]]:
+        """Apply the compression policy to one server's push payload;
+        returns the wire tree and the packed-key list for the header."""
+        if self._compressor is None:
+            return arrays, []
+        return self._compressor.encode_tree(arrays)
+
+    def _pull_compress_spec(self) -> Optional[dict]:
+        """The codec spec pulls ask the server to apply to the return path
+        (None unless the spec opts in with ``pull: true``). Error-feedback
+        state lives at the SENDER, so pull compression is stateless by
+        construction — topk would silently drop mass forever and is
+        refused at connect time."""
+        if not self.compress or not self.compress.get("pull"):
+            return None
+        return {k: v for k, v in self.compress.items() if k != "pull"}
 
     def _open_pumps(self, indices) -> None:
         """Dial ``pool_size`` extra transport connections per server; the
@@ -380,13 +417,19 @@ class BucketedTransportMixin:
 
     def _saved_transport_state(self) -> tuple:
         """Snapshot the identity that must survive a reconnect: cumulative
-        wire counters, transport stats, and the push/pull epoch streams."""
+        wire counters, transport stats, the push/pull epoch streams, and
+        the compressor (its topk error-feedback residuals are unsent
+        gradient mass — dropping them on a re-dial would lose updates)."""
         return (self.bytes_pushed, self.bytes_pulled, self.collective_bytes,
-                self.transport, self._push_epoch, self._pull_epoch)
+                self.transport, self._push_epoch, self._pull_epoch,
+                self._compressor)
 
     def _restore_transport_state(self, saved: tuple) -> None:
         (self.bytes_pushed, self.bytes_pulled, self.collective_bytes,
-         self.transport, self._push_epoch, self._pull_epoch) = saved
+         self.transport, self._push_epoch, self._pull_epoch,
+         self._compressor) = saved
+        if self._compressor is not None:
+            self._compressor.stats = self.transport
 
 
 def make_jit_dc_apply_tree(opt: optax.GradientTransformation):

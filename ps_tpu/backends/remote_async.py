@@ -44,6 +44,7 @@ from ps_tpu.backends.common import (
     ServerFailureError,
 )
 from ps_tpu.backends.van_service import VanService, resolve_ckpt_dir
+from ps_tpu.compress import CompressPolicy, GradCompressor, decode_tree
 from ps_tpu.control import tensor_van as tv
 from ps_tpu.kv import keys as keymod
 from ps_tpu.utils.metrics import TransportStats
@@ -194,13 +195,23 @@ class AsyncPSService(VanService):
         return (self._applied.get(worker, 0)
                 < self._drain_targets.get(worker, 0))
 
+    def _decode_push(self, tensors, extra) -> Dict[str, np.ndarray]:
+        """Serial-path twin of the bucket decode: unpack codec-compressed
+        keys (``extra["enc"]``) before aggregation."""
+        enc = extra.get("enc")
+        if not enc:
+            return tensors
+        return decode_tree(dict(tensors), enc, stats=self.transport)
+
     # -- bucketed transport (server half) -------------------------------------
 
     def _bucket_push(self, worker: int, tensors, extra) -> bytes:
         """One bucket of a multi-bucket push. Incomplete epochs only stage
         (ack reply); the completing bucket applies the WHOLE assembled tree
         atomically under the engine lock — a torn push is never observable,
-        and the commit reply carries the advanced version."""
+        and the commit reply carries the advanced version. Codec-packed
+        keys (``extra["enc"]``, same list on every bucket of the epoch)
+        are decoded here, after assembly and before aggregation."""
         tree = self._stage_bucket_push(
             worker, int(extra["bucket"]), int(extra["nbuckets"]),
             int(extra["epoch"]), tensors["raw"], extra["slices"],
@@ -209,6 +220,7 @@ class AsyncPSService(VanService):
         if tree is None:
             return tv.encode(tv.OK, worker, None,
                              extra={"staged": int(extra["bucket"])})
+        tree = decode_tree(tree, extra.get("enc"), stats=self.transport)
         self._apply_push(worker, tree, copy=False)
         return tv.encode(tv.OK, worker, None, extra={
             "version": self._engine.version, "committed": True,
@@ -233,18 +245,38 @@ class AsyncPSService(VanService):
             # it zero-copy (jax arrays convert contiguous, but be explicit)
             host = {k: np.ascontiguousarray(np.asarray(v))
                     for k, v in kv.items()}
+            # return-path compression, negotiated per request: the worker
+            # names the codec spec; the server applies the same per-key
+            # policy it would and labels the packed keys in every reply
+            # bucket's header. Stateless codecs only (checked worker-side).
+            enc: List[str] = []
+            spec = extra.get("compress")
+            if spec:
+                # fresh, decorrelated quantization noise per (worker,
+                # pull epoch): rebuilding the codec from a FIXED seed would
+                # replay the same uniform draw every pull, turning
+                # stochastic rounding into a persistent position-dependent
+                # bias that never averages away
+                spec = dict(spec)
+                spec["seed"] = ((int(spec.get("seed", 0)) * 1000003
+                                 + worker * 9176 + epoch) & 0x7FFFFFFF)
+                comp = GradCompressor(CompressPolicy.from_spec(spec),
+                                      stats=self.transport)
+                host, enc = comp.encode_tree(host)
+                host = {k: np.ascontiguousarray(v)
+                        for k, v in host.items()}
             plan = BucketPlan.from_arrays(host, bb, order=self._key_order)
             with self._stage_lock:
                 if plan.nbuckets > 1:
                     self._pull_cache[worker] = {
                         "epoch": epoch, "host": host, "plan": plan,
-                        "version": version,
+                        "version": version, "enc": enc,
                         "left": set(range(1, plan.nbuckets)),
                     }
                 else:
                     self._pull_cache.pop(worker, None)
             return plan.encode_bucket(tv.OK, worker, host, 0, extra={
-                "epoch": epoch, "version": version,
+                "epoch": epoch, "version": version, "enc": enc,
             })
         with self._stage_lock:
             entry = self._pull_cache.get(worker)
@@ -259,7 +291,8 @@ class AsyncPSService(VanService):
                 self._pull_cache.pop(worker, None)
         return entry["plan"].encode_bucket(
             tv.OK, worker, entry["host"], b,
-            extra={"epoch": epoch, "version": entry["version"]},
+            extra={"epoch": epoch, "version": entry["version"],
+                   "enc": entry["enc"]},
         )
 
     def _handle(self, kind: int, worker: int, tensors, extra) -> bytes:
@@ -274,12 +307,12 @@ class AsyncPSService(VanService):
         elif kind == tv.PULL:
             return self._params_payload(worker)
         elif kind == tv.PUSH:
-            self._apply_push(worker, tensors)
+            self._apply_push(worker, self._decode_push(tensors, extra))
             return tv.encode(tv.OK, worker, None, extra={
                 "version": self._engine.version,
             })
         elif kind == tv.PUSH_PULL:
-            self._apply_push(worker, tensors)
+            self._apply_push(worker, self._decode_push(tensors, extra))
             return self._params_payload(worker)
         elif kind == tv.BUCKET_PUSH:
             return self._bucket_push(worker, tensors, extra)
@@ -299,6 +332,10 @@ class AsyncPSService(VanService):
                     str(w): v for w, v in
                     self._engine._worker_version.items()
                 },
+                # stale-epoch staging drops, observable fleet-wide instead
+                # of only in server stderr (codec-PR satellite)
+                "stale_epochs": self.transport.stale_epochs,
+                "stale_epoch_buckets": self.transport.stale_epoch_buckets,
             })
         elif kind == tv.CHECKPOINT:
             return self._checkpoint(worker, extra)
@@ -440,7 +477,8 @@ def serve_async(store, port: int = 0, bind: str = "127.0.0.1",
 
 def connect_async(uri: str, worker: int, params_like,
                   bucket_bytes: Optional[int] = None,
-                  pool_size: Optional[int] = None) -> "RemoteAsyncWorker":
+                  pool_size: Optional[int] = None,
+                  compress=None) -> "RemoteAsyncWorker":
     """Join a cross-process async job as worker ``worker``.
 
     ``uri`` is ``host:port`` of the :func:`serve_async` process, or a
@@ -454,14 +492,22 @@ def connect_async(uri: str, worker: int, params_like,
     transport (~4 MiB fusion buckets striped over ``pool_size`` persistent
     connections per server; enables :meth:`RemoteAsyncWorker.
     push_pull_async` compute/comm overlap). None keeps the serial
-    one-frame-per-cycle transport."""
+    one-frame-per-cycle transport.
+
+    ``compress`` selects a gradient codec for the wire (``ps_tpu.compress``):
+    a codec name (``"cast16"``/``"int8"``/``"topk"``) or a spec dict such as
+    ``{"codec": "topk", "topk": 0.02, "min_bytes": 65536, "pull": True}``
+    (the env spelling is PS_COMPRESS / PS_COMPRESS_TOPK /
+    PS_COMPRESS_MIN_BYTES / PS_COMPRESS_PULL). None/"none" ships raw
+    float32 — the previous behavior."""
     addrs = []
     for part in uri.split(","):
         host, port = part.strip().rsplit(":", 1)
         addrs.append((host, int(port)))
     return RemoteAsyncWorker.connect_many(addrs, worker, params_like,
                                           bucket_bytes=bucket_bytes,
-                                          pool_size=pool_size)
+                                          pool_size=pool_size,
+                                          compress=compress)
 
 
 class CheckpointRoundError(RuntimeError):
@@ -593,22 +639,27 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
 
     def __init__(self, host: str, port: int, worker: int, params_like,
                  bucket_bytes: Optional[int] = None,
-                 pool_size: Optional[int] = None):
+                 pool_size: Optional[int] = None,
+                 compress=None):
         self._init_multi([(host, int(port))], worker, params_like,
-                         bucket_bytes=bucket_bytes, pool_size=pool_size)
+                         bucket_bytes=bucket_bytes, pool_size=pool_size,
+                         compress=compress)
 
     @classmethod
     def connect_many(cls, addrs: Sequence[Tuple[str, int]], worker: int,
                      params_like, bucket_bytes: Optional[int] = None,
-                     pool_size: Optional[int] = None) -> "RemoteAsyncWorker":
+                     pool_size: Optional[int] = None,
+                     compress=None) -> "RemoteAsyncWorker":
         self = cls.__new__(cls)
         self._init_multi(list(addrs), worker, params_like,
-                         bucket_bytes=bucket_bytes, pool_size=pool_size)
+                         bucket_bytes=bucket_bytes, pool_size=pool_size,
+                         compress=compress)
         return self
 
     def _init_multi(self, addrs: List[Tuple[str, int]], worker: int,
                     params_like, bucket_bytes: Optional[int] = None,
-                    pool_size: Optional[int] = None) -> None:
+                    pool_size: Optional[int] = None,
+                    compress=None) -> None:
         self.worker = worker
         kv, self._treedef = keymod.flatten_with_keys(params_like)
         # placeholders, not the arrays: reconnect() only needs keys +
@@ -631,7 +682,15 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         self.collective_bytes = 0  # no ICI on the van path, by definition
         self._bytes_lock = threading.Lock()  # _fanout drives _request concurrently
         # bucketed transport config (None bucket_bytes = serial transport)
-        self._init_transport(bucket_bytes, pool_size)
+        self._init_transport(bucket_bytes, pool_size, compress=compress)
+        if self.compress and self.compress.get("pull") \
+                and self.compress.get("codec") == "topk":
+            raise ValueError(
+                "topk cannot compress the pull return path: its error-"
+                "feedback residuals live at the sender, and a server has "
+                "no per-worker residual state — dropped params mass would "
+                "be lost forever. Use cast16/int8 for pull compression."
+            )
         try:
             self._connect_and_validate(addrs, worker, kv)
         except Exception:
@@ -796,7 +855,7 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
             self._push_buckets_sync(self._split_by_owner(grads))
             return
         msgs = self._fanout({
-            i: tv.encode(tv.PUSH, self.worker, sub)
+            i: self._encode_serial_push(tv.PUSH, sub)
             for i, sub in self._split_by_owner(grads).items()
         })
         for i, msg in msgs.items():
@@ -816,11 +875,19 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
             self._push_buckets_sync(self._split_by_owner(grads))
             return self._merge_host_params(self._pull_buckets())
         return self._merge_params(self._fanout({
-            i: tv.encode(tv.PUSH_PULL, self.worker, sub)
+            i: self._encode_serial_push(tv.PUSH_PULL, sub)
             for i, sub in self._split_by_owner(grads).items()
         }))
 
     # -- bucketed, pipelined transport (worker half) --------------------------
+
+    def _encode_serial_push(self, kind: int, sub: Dict[str, np.ndarray]
+                            ) -> bytearray:
+        """One serial push frame, compressed per the policy (the packed-key
+        list rides the frame's extra, as on the bucketed path)."""
+        sub, enc = self._encode_push_tree(sub)
+        return tv.encode(kind, self.worker, sub,
+                         extra={"enc": enc} if enc else None)
 
     def _require_bucketed(self) -> None:
         if self.bucket_bytes is None:
@@ -840,6 +907,9 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         epoch = self._push_epoch
         futs: List[Tuple[int, Any]] = []
         for i, sub in by_owner.items():
+            # codec pass first: what buckets is the WIRE form of each key
+            # (packed uint8 for compressed keys, raw tensors otherwise)
+            sub, enc = self._encode_push_tree(sub)
             # contiguous-normalize ONCE per subtree: encode_bucket takes
             # memoryview slices, and a non-contiguous source would
             # otherwise be re-copied whole for every bucket it spans
@@ -850,7 +920,8 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                 payload = plan.encode_bucket(
                     tv.BUCKET_PUSH, self.worker, sub, b,
                     extra={"epoch": epoch,
-                           "nonce": self._transport_nonce},
+                           "nonce": self._transport_nonce,
+                           "enc": enc},
                 )
                 futs.append((i, pumps[b % len(pumps)].submit(payload)))
         for i, fut in futs:
@@ -867,15 +938,18 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         are the first bytes on the wire."""
         self._pull_epoch += 1
         epoch = self._pull_epoch
+        pull_spec = self._pull_compress_spec()
         first = {
             i: self._pumps[i][0].submit(tv.encode(
                 tv.BUCKET_PULL, self.worker, None,
                 extra={"epoch": epoch, "bucket": 0,
-                       "bucket_bytes": self.bucket_bytes},
+                       "bucket_bytes": self.bucket_bytes,
+                       "compress": pull_spec},
             ))
             for i in self._active
         }
         kv: Dict[str, np.ndarray] = {}
+        enc_keys: List[str] = []
         rest: List[Tuple[int, Any]] = []
         assemblers: Dict[int, Any] = {}
         for i, fut in first.items():
@@ -883,6 +957,7 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
             if kind != tv.OK:
                 raise RuntimeError(f"server {i} error: {extra.get('error')}")
             self.versions[i] = int(extra["version"])
+            enc_keys.extend(extra.get("enc") or [])
             n = int(extra["nbuckets"])
             asm = BucketAssembler(epoch, n)
             if asm.add(0, tensors["raw"], extra["slices"], epoch):
@@ -901,7 +976,7 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
             if assemblers[i].add(int(extra["bucket"]), tensors["raw"],
                                  extra["slices"], epoch):
                 kv.update(assemblers[i].finish())
-        return kv
+        return decode_tree(kv, enc_keys, stats=self.transport)
 
     def _merge_host_params(self, kv: Dict[str, np.ndarray]) -> Any:
         import jax.numpy as jnp
@@ -1047,8 +1122,11 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                 list(addrs) if addrs is not None else self._addrs,
                 self.worker, keymod.unflatten(
                     self._treedef, self._kv_like, self._key_order),
-                bucket_bytes=self.bucket_bytes, pool_size=self.pool_size)
+                bucket_bytes=self.bucket_bytes, pool_size=self.pool_size,
+                compress=self.compress)
         finally:
+            # restores the compressor too: topk error-feedback residuals
+            # are unsent gradient mass and must survive the re-dial
             self._restore_transport_state(saved)
 
     def make_async_step(self, loss_fn, has_aux: bool = False,

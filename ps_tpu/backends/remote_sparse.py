@@ -48,6 +48,7 @@ from ps_tpu.backends.remote_async import (
     PendingCycle,
 )
 from ps_tpu.backends.van_service import VanService, resolve_ckpt_dir
+from ps_tpu.compress import decode_tree, resolve_spec
 from ps_tpu.control import tensor_van as tv
 
 
@@ -234,11 +235,15 @@ class SparsePSService(VanService):
         elif kind == tv.ROW_PULL:
             return self._rows_payload(worker, self._split(tensors))
         elif kind == tv.ROW_PUSH:
+            tensors = decode_tree(dict(tensors), extra.get("enc"),
+                                  stats=self.transport)
             self._apply_push(worker, self._split(tensors))
             return tv.encode(tv.OK, worker, None, extra={
                 "versions": dict(self.versions),
             })
         elif kind == tv.ROW_PUSH_PULL:
+            tensors = decode_tree(dict(tensors), extra.get("enc"),
+                                  stats=self.transport)
             per = self._split(tensors)
             push = {n: t for n, t in per.items() if "grads" in t}
             pull = {n: {"ids": t["pull_ids"]}
@@ -259,6 +264,7 @@ class SparsePSService(VanService):
             if tree is None:
                 return tv.encode(tv.OK, worker, None,
                                  extra={"staged": int(extra["bucket"])})
+            tree = decode_tree(tree, extra.get("enc"), stats=self.transport)
             self._apply_push(worker, self._split(tree), copy=False)
             return tv.encode(tv.OK, worker, None, extra={
                 "versions": dict(self.versions), "committed": True,
@@ -270,6 +276,8 @@ class SparsePSService(VanService):
                 "versions": dict(self.versions),
                 "rows_applied": dict(self.rows_applied),
                 "apply_log": log,
+                "stale_epochs": self.transport.stale_epochs,
+                "stale_epoch_buckets": self.transport.stale_epoch_buckets,
             })
         elif kind == tv.CHECKPOINT:
             return self._checkpoint(worker, extra)
@@ -365,21 +373,28 @@ def serve_sparse(tables: Dict[str, Any], port: int = 0,
 def connect_sparse(uri: str, worker: int,
                    tables: Dict[str, Tuple[int, int]],
                    bucket_bytes: Optional[int] = None,
-                   pool_size: Optional[int] = None
-                   ) -> "RemoteSparseWorker":
+                   pool_size: Optional[int] = None,
+                   compress=None) -> "RemoteSparseWorker":
     """Join a cross-process sparse PS as worker ``worker``.
 
     ``uri`` is ``host:port`` or a comma-separated list naming every server
     of the row partition; ``tables`` is ``{name: (total_rows, dim)}`` — the
     worker-side expectation validated against what the servers advertise
     (coverage must be exact and disjoint). ``bucket_bytes`` enables the
-    bucketed transport and :meth:`RemoteSparseWorker.push_async`."""
+    bucketed transport and :meth:`RemoteSparseWorker.push_async`.
+
+    ``compress`` (a codec name or spec dict, see ``ps_tpu.compress``)
+    quantizes the ``<table>/grads`` payloads on the wire; ids always travel
+    raw (they are int32 — the policy's dtype gate). ``topk`` is refused
+    here: row pushes already ARE a sparsification, and error-feedback
+    residuals keyed by table would mix different row sets."""
     addrs = []
     for part in uri.split(","):
         host, port = part.strip().rsplit(":", 1)
         addrs.append((host, int(port)))
     return RemoteSparseWorker(addrs, worker, tables,
-                              bucket_bytes=bucket_bytes, pool_size=pool_size)
+                              bucket_bytes=bucket_bytes, pool_size=pool_size,
+                              compress=compress)
 
 
 class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
@@ -401,14 +416,17 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
     def __init__(self, addrs: Sequence[Tuple[str, int]], worker: int,
                  tables: Dict[str, Tuple[int, int]],
                  bucket_bytes: Optional[int] = None,
-                 pool_size: Optional[int] = None):
+                 pool_size: Optional[int] = None,
+                 compress=None):
         self._init_multi(list(addrs), worker, tables,
-                         bucket_bytes=bucket_bytes, pool_size=pool_size)
+                         bucket_bytes=bucket_bytes, pool_size=pool_size,
+                         compress=compress)
 
     def _init_multi(self, addrs: List[Tuple[str, int]], worker: int,
                     tables: Dict[str, Tuple[int, int]],
                     bucket_bytes: Optional[int] = None,
-                    pool_size: Optional[int] = None) -> None:
+                    pool_size: Optional[int] = None,
+                    compress=None) -> None:
         """Fresh dial + validation — ``__init__``'s whole body, factored so
         :meth:`reconnect` re-inits without re-running ``__init__`` on a
         live instance (and so a failed re-dial leaves the identity fields
@@ -432,7 +450,14 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         self.bytes_pulled = 0
         self.collective_bytes = 0
         self._bytes_lock = threading.Lock()
-        self._init_transport(bucket_bytes, pool_size)
+        spec = resolve_spec(compress)
+        if spec is not None and spec.get("codec") == "topk":
+            raise ValueError(
+                "topk is not a sparse-push codec: row pushes already "
+                "sparsify, and per-table error-feedback residuals would "
+                "mix different row sets across steps — use cast16 or int8"
+            )
+        self._init_transport(bucket_bytes, pool_size, compress=spec)
         try:
             self._connect_and_validate(worker)
         except Exception:
@@ -636,11 +661,18 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
             self._push_buckets_sync(self._build_push(pushes, dedupe))
             return
         msgs = self._fanout({
-            i: tv.encode(tv.ROW_PUSH, self.worker, t)
+            i: self._encode_serial_push(tv.ROW_PUSH, t)
             for i, t in self._build_push(pushes, dedupe).items()
         })
         for i, m in msgs.items():
             self._check(i, m)
+
+    def _encode_serial_push(self, kind: int, t: Dict[str, np.ndarray]
+                            ) -> bytearray:
+        """One serial row-push frame, grads compressed per the policy."""
+        t, enc = self._encode_push_tree(t)
+        return tv.encode(kind, self.worker, t,
+                         extra={"enc": enc} if enc else None)
 
     # -- bucketed, non-blocking push (the pipelined transport) ----------------
 
@@ -653,7 +685,10 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         epoch = self._push_epoch
         futs: List[Tuple[int, Any]] = []
         for i, t in reqs.items():
-            # contiguous-normalize once per payload (see the dense twin)
+            # codec pass first (grads compress, int32 ids pass the policy's
+            # dtype gate untouched), then contiguous-normalize once per
+            # payload (see the dense twin)
+            t, enc = self._encode_push_tree(t)
             t = {k: np.ascontiguousarray(v) for k, v in t.items()}
             plan = BucketPlan.from_arrays(t, self.bucket_bytes)
             pumps = self._pumps[i]
@@ -661,7 +696,8 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                 payload = plan.encode_bucket(
                     tv.ROW_BUCKET_PUSH, self.worker, t, b,
                     extra={"epoch": epoch,
-                           "nonce": self._transport_nonce},
+                           "nonce": self._transport_nonce,
+                           "enc": enc},
                 )
                 futs.append((i, pumps[b % len(pumps)].submit(payload)))
         for i, fut in futs:
@@ -714,7 +750,7 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                 name = name_ids.split("/")[0]
                 reqs.setdefault(i, {})[f"{name}/pull_ids"] = v
         msgs = self._fanout({
-            i: tv.encode(tv.ROW_PUSH_PULL, self.worker, t)
+            i: self._encode_serial_push(tv.ROW_PUSH_PULL, t)
             for i, t in reqs.items()
         })
         return self._merge_rows(requests, routes, msgs)
@@ -783,7 +819,8 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
             self._init_multi(
                 list(addrs) if addrs is not None else self._addrs,
                 self.worker, dict(self._spec),
-                bucket_bytes=self.bucket_bytes, pool_size=self.pool_size)
+                bucket_bytes=self.bucket_bytes, pool_size=self.pool_size,
+                compress=self.compress)
         finally:
             self._restore_transport_state(saved)
 

@@ -442,6 +442,45 @@ class TpuServer(PeekMixin, CheckpointMixin):
 _LEAKED_SERVICES: list = []
 
 
+def _coordination_seam():
+    """Resolve the module object holding jax's distributed-runtime-client
+    factory across the jax versions supported here: jax >= 0.5 exposes it
+    as ``jax._src.distributed._jax``; jax 0.4.x as the ``xla_extension``
+    import inside the same module. Returns ``(owner, factory)``; raises
+    AttributeError when the seam moved again (the tests turn that into a
+    loud failure)."""
+    from jax._src import distributed as _dist
+
+    for attr in ("_jax", "xla_extension"):
+        owner = getattr(_dist, attr, None)
+        if owner is not None and hasattr(owner,
+                                         "get_distributed_runtime_client"):
+            return owner, owner.get_distributed_runtime_client
+    raise AttributeError(
+        "jax._src.distributed exposes no get_distributed_runtime_client "
+        "(checked _jax and xla_extension)"
+    )
+
+
+#: the recoverable-task client options and their values
+_RECOVERABLE_OPTS = {"recoverable": True, "shutdown_on_destruction": False}
+
+
+def _client_factory_kwargs(factory):
+    """Which recoverable-semantics kwargs this factory accepts, probed
+    from its nanobind docstring signature (``inspect.signature`` cannot
+    introspect nanobind functions). jax 0.4.x accepts
+    ``shutdown_on_destruction`` but predates ``recoverable``. Returns
+    ``None`` when the docstring does not carry the signature text at all
+    (stripped docs, a renamed wrapper): the caller must then fall back to
+    optimistically trying every kwarg — a probe false-negative must not
+    silently strip semantics the factory actually supports."""
+    doc = factory.__doc__ or ""
+    if "(" not in doc:
+        return None  # unparseable: capability unknown
+    return [k for k in _RECOVERABLE_OPTS if k in doc]
+
+
 @contextlib.contextmanager
 def _coordination_client_options():
     """Within the block, ``jax.distributed.initialize`` builds its
@@ -452,17 +491,19 @@ def _coordination_client_options():
     survivors our failure detector is trying to hand a typed error), and the
     distributed shutdown barrier no longer blocks on dead peers. Dropping
     the client handle is barrier-free, which is what ``shutdown(abort=True)``
-    relies on. Wraps a private jax seam; if the seam moves or the factory
-    stops accepting the kwargs, initialization falls back to jax's defaults
-    with a warning — and
+    relies on. Wraps a private jax seam (:func:`_coordination_seam` — it
+    moved once already, in the 0.4→0.5 transition), passing only the
+    kwargs the resolved factory advertises: on jax 0.4.x that is
+    ``shutdown_on_destruction`` alone (``recoverable`` tasks arrived with
+    0.5 — a warning notes the partial semantics). If the seam moves or a
+    supposedly-supported kwarg is refused, initialization falls back to
+    jax's defaults with a warning — and
     ``tests/test_failure.py::test_coordination_seam_accepts_recoverable_kwargs``
     / ``::test_coordination_client_options_inject_without_degrading``
     construct a client through this exact path so the degradation is a loud
     CI failure, not only a runtime warning."""
     try:
-        from jax._src import distributed as _dist
-
-        orig = _dist._jax.get_distributed_runtime_client
+        owner, orig = _coordination_seam()
     except (ImportError, AttributeError) as e:
         import warnings
 
@@ -474,9 +515,23 @@ def _coordination_client_options():
         yield
         return
 
+    supported = _client_factory_kwargs(orig)
+    if supported is not None and "recoverable" not in supported:
+        import warnings
+
+        warnings.warn(
+            "this jax's coordination client predates 'recoverable' tasks "
+            "(jax<0.5): peer death may still LOG(FATAL) survivors; "
+            "shutdown_on_destruction=False is applied so aborts stay "
+            "barrier-free"
+        )
+    # unknown capability (unparseable docstring): try everything and let
+    # the TypeError fallback below sort it out — the pre-probe behavior
+    inject = supported if supported is not None else list(_RECOVERABLE_OPTS)
+
     def patched(*args, **kwargs):
-        kwargs["recoverable"] = True
-        kwargs["shutdown_on_destruction"] = False
+        for k in inject:
+            kwargs[k] = _RECOVERABLE_OPTS[k]
         try:
             return orig(*args, **kwargs)
         except TypeError:
@@ -487,15 +542,15 @@ def _coordination_client_options():
                 "shutdown_on_destruction; clean aborts will degrade to "
                 "jax defaults (LOG(FATAL) on peer death)"
             )
-            kwargs.pop("recoverable", None)
-            kwargs.pop("shutdown_on_destruction", None)
+            for k in _RECOVERABLE_OPTS:
+                kwargs.pop(k, None)
             return orig(*args, **kwargs)
 
-    _dist._jax.get_distributed_runtime_client = patched
+    owner.get_distributed_runtime_client = patched
     try:
         yield
     finally:
-        _dist._jax.get_distributed_runtime_client = orig
+        owner.get_distributed_runtime_client = orig
 
 
 class TpuBackend:

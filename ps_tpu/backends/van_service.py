@@ -42,6 +42,7 @@ from typing import Dict, List, Optional
 
 from ps_tpu.backends.common import BucketAssembler
 from ps_tpu.control import tensor_van as tv
+from ps_tpu.utils.metrics import TransportStats
 
 
 def resolve_ckpt_dir(root: Optional[str], client_dir: str) -> str:
@@ -102,6 +103,10 @@ class VanService:
         # subclass's apply, so a torn multi-bucket push is never observable
         self._stage_lock = threading.Lock()
         self._push_stage: Dict[int, BucketAssembler] = {}
+        # server-side transport accounting: stale-epoch drops (observable
+        # via STATS and the worker's StepLogger line) and codec seconds for
+        # compressed pushes/pulls
+        self.transport = TransportStats()
         # checkpoint ownership token (issued at pause, validated by every
         # later phase, cleared at resume) — shared bookkeeping for both
         # concrete services; mutated only under the subclass's apply lock
@@ -153,6 +158,10 @@ class VanService:
             asm = self._push_stage.get(worker)
             if asm is not None and (asm.epoch != epoch
                                     or getattr(asm, "nonce", None) != nonce):
+                # observable, not just a log line: STATS carries the counts
+                # so a fleet-wide rash of abandoned pushes shows up in the
+                # worker's StepLogger instead of only in server stderr
+                self.transport.record_stale_epoch(len(asm._seen))
                 logging.getLogger(__name__).warning(
                     "worker %d abandoned push epoch %d (%d/%d buckets); "
                     "superseded by epoch %d", worker, asm.epoch,

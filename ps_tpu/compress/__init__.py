@@ -1,0 +1,45 @@
+"""Pluggable gradient-compression codecs for the van transport.
+
+The reference family treats worker↔server bandwidth as the scaling
+bottleneck (PAPER.md §2); this subsystem cuts push/pull bytes 2–16× with
+the math preserved: ``cast16`` (bf16/fp16 downcast), ``int8`` (per-chunk
+stochastic scale-quantization, QSGD-style), and ``topk`` (per-tensor
+top-k sparsification with worker-local error-feedback residuals,
+Deep-Gradient-Compression-style) — all behind one :class:`Codec`
+``encode(key, ndarray) -> frames / decode(frames) -> ndarray`` contract.
+
+Wire shape: an encoded tensor travels as ONE packed uint8 buffer
+(:func:`pack_frames` — self-describing: codec id + per-frame dtype/shape
+in a json header), so it rides the existing bucketed transport unchanged;
+the list of packed keys rides the bucket header (``extra["enc"]``) and the
+server decodes with :func:`decode_tree` before aggregation. Which keys get
+which codec is the :class:`CompressPolicy`'s call (compress large dense
+float grads; never small / integer / excluded tensors), applied worker-
+side by :class:`GradCompressor`.
+"""
+
+from ps_tpu.compress.codecs import (
+    Cast16Codec,
+    Codec,
+    Int8Codec,
+    NoneCodec,
+    TopKCodec,
+    available_codecs,
+    make_codec,
+)
+from ps_tpu.compress.policy import CompressPolicy, resolve_spec
+from ps_tpu.compress.wire import (
+    GradCompressor,
+    decode_packed,
+    decode_tree,
+    pack_frames,
+    unpack_frames,
+)
+
+__all__ = [
+    "Codec", "NoneCodec", "Cast16Codec", "Int8Codec", "TopKCodec",
+    "available_codecs", "make_codec",
+    "CompressPolicy", "resolve_spec",
+    "GradCompressor", "decode_tree", "decode_packed",
+    "pack_frames", "unpack_frames",
+]

@@ -1,0 +1,276 @@
+"""Gradient codecs: one ``encode/decode`` contract, four implementations.
+
+A codec turns one tensor into a dict of named numpy ``frames`` that fully
+determine the decoded tensor (self-describing — decode needs no out-of-band
+state), and back. Lossy codecs bound their error per encode; ``topk``
+additionally keeps worker-local error-feedback residuals so what is not
+sent this step is sent later instead of lost — the property that keeps
+asynchronous training convergent under aggressive sparsification.
+
+Every codec passes through (frame ``"raw"``) any tensor it cannot
+represent — non-float dtypes, and for ``cast16``/``int8``/``topk``
+anything but float32 — so a codec is always safe to apply; the
+:class:`~ps_tpu.compress.policy.CompressPolicy` merely decides where it is
+*worth* applying.
+
+Non-finite payloads: ``cast16`` preserves NaN/Inf exactly (IEEE subsets);
+``int8`` saturates ±Inf to the chunk's ±max and maps NaN to 0 (scales are
+computed over the finite entries only, so one NaN cannot poison a chunk);
+``topk`` ranks by magnitude with NaN treated as 0. Gradients with NaN/Inf
+mean the run is already broken — the codecs just guarantee they never
+crash or corrupt framing.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, Optional
+
+import numpy as np
+
+try:  # jax always ships ml_dtypes; guard anyway so the codec core is pure
+    import ml_dtypes
+
+    _BF16 = np.dtype(ml_dtypes.bfloat16)
+except Exception:  # pragma: no cover - ml_dtypes is a jax dependency
+    _BF16 = None
+
+
+def _contig(arr) -> np.ndarray:
+    # ascontiguousarray alone would promote 0-d scalars to 1-d
+    a = np.asarray(arr)
+    return np.ascontiguousarray(a).reshape(a.shape)
+
+
+class Codec:
+    """One gradient codec: ``encode(key, ndarray) -> frames`` and
+    ``decode(frames) -> ndarray``.
+
+    ``frames`` is ``{name: np.ndarray}`` and is self-describing: the frame
+    set alone reconstructs the tensor (dtype, shape, values). ``key`` lets
+    stateful codecs (``topk`` error feedback) keep per-tensor state;
+    ``decode`` is stateless for every codec, so the receiving side needs
+    only the codec registry, never the sender's state.
+    """
+
+    name = "?"
+    #: True when decode(encode(x)) == x exactly for every input
+    lossless = False
+
+    def encode(self, key: str, arr) -> Dict[str, np.ndarray]:
+        raise NotImplementedError
+
+    def decode(self, frames: Dict[str, np.ndarray]) -> np.ndarray:
+        raise NotImplementedError
+
+    def residual_norm(self) -> float:
+        """L2 norm of this codec's error-feedback state (0 if stateless)."""
+        return 0.0
+
+    # -- shared passthrough (any codec may fall back to it) -------------------
+
+    def _raw(self, arr) -> Dict[str, np.ndarray]:
+        return {"raw": _contig(arr)}
+
+    def _is_raw(self, frames) -> Optional[np.ndarray]:
+        return frames.get("raw")
+
+
+class NoneCodec(Codec):
+    """Identity codec — the explicit 'do not compress' spelling, and the
+    fallback every lossy codec uses for dtypes it cannot represent."""
+
+    name = "none"
+    lossless = True
+
+    def encode(self, key: str, arr) -> Dict[str, np.ndarray]:
+        return self._raw(arr)
+
+    def decode(self, frames: Dict[str, np.ndarray]) -> np.ndarray:
+        return frames["raw"]
+
+
+class Cast16Codec(Codec):
+    """Float32 → 16-bit downcast (2×). ``mode='bf16'`` (default: same
+    exponent range as f32 — the safe choice for grads) or ``'fp16'``.
+
+    bf16 payloads travel as uint16 bit patterns (the bf16 dtype string does
+    not round-trip through plain numpy); fp16 is a native numpy dtype.
+    Lossless whenever the values already lie on the 16-bit grid — which is
+    exactly the case for grads produced by bf16 compute.
+    """
+
+    name = "cast16"
+
+    def __init__(self, mode: str = "bf16"):
+        if mode not in ("bf16", "fp16"):
+            raise ValueError(f"cast16 mode {mode!r}; use 'bf16' or 'fp16'")
+        if mode == "bf16" and _BF16 is None:  # pragma: no cover
+            mode = "fp16"
+        self.mode = mode
+
+    def encode(self, key: str, arr) -> Dict[str, np.ndarray]:
+        arr = _contig(arr)
+        if arr.dtype != np.float32:
+            return self._raw(arr)
+        if self.mode == "bf16":
+            # astype rounds to nearest-even; ship the bit pattern
+            return {"bf16": arr.astype(_BF16).view(np.uint16)}
+        return {"fp16": arr.astype(np.float16)}
+
+    def decode(self, frames: Dict[str, np.ndarray]) -> np.ndarray:
+        raw = self._is_raw(frames)
+        if raw is not None:
+            return raw
+        if "bf16" in frames:
+            return frames["bf16"].view(_BF16).astype(np.float32)
+        return frames["fp16"].astype(np.float32)
+
+
+class Int8Codec(Codec):
+    """Per-chunk scale quantization to int8 (~4×), QSGD-style.
+
+    Each ``chunk``-element run gets its own scale ``max|x| / 127``; values
+    quantize stochastically (``floor(x/scale + u)``, ``u ~ U[0,1)``) so the
+    quantizer is unbiased — E[decode] == x — which is what lets SGD average
+    the noise away across steps and workers. Per-encode error is bounded by
+    one quantization step: ``|x - decode(encode(x))| <= max|chunk| / 127``.
+    Frames: int8 values + one f32 scale per chunk + shape/chunk meta.
+    """
+
+    name = "int8"
+
+    def __init__(self, chunk: int = 1024, stochastic: bool = True,
+                 seed: int = 0):
+        self.chunk = max(int(chunk), 1)
+        self.stochastic = bool(stochastic)
+        self._rng = np.random.default_rng(seed)
+
+    def encode(self, key: str, arr) -> Dict[str, np.ndarray]:
+        arr = _contig(arr)
+        if arr.dtype != np.float32:
+            return self._raw(arr)
+        flat = arr.reshape(-1)
+        n = flat.size
+        nchunks = -(-n // self.chunk) if n else 0
+        if nchunks:
+            pad = np.zeros(nchunks * self.chunk, np.float32)
+            np.absolute(flat, out=pad[:n], where=np.isfinite(flat))
+            scales = (pad.reshape(nchunks, self.chunk).max(axis=1)
+                      / 127.0).astype(np.float32)
+        else:
+            scales = np.zeros(0, np.float32)
+        safe = np.where(scales > 0, scales, 1.0)
+        r = flat / np.repeat(safe, self.chunk)[:n]
+        r = np.nan_to_num(r, nan=0.0, posinf=127.0, neginf=-127.0)
+        if self.stochastic and n:
+            q = np.floor(r + self._rng.random(n, dtype=np.float32))
+        else:
+            q = np.rint(r)
+        q = np.clip(q, -127, 127).astype(np.int8)
+        return {
+            "q8": q,
+            "scale": scales,
+            "shape": np.asarray(arr.shape, np.int64),
+            "chunk": np.asarray([self.chunk], np.int64),
+        }
+
+    def decode(self, frames: Dict[str, np.ndarray]) -> np.ndarray:
+        raw = self._is_raw(frames)
+        if raw is not None:
+            return raw
+        q = frames["q8"]
+        scales = frames["scale"].astype(np.float32)
+        chunk = int(frames["chunk"][0])
+        shape = tuple(int(s) for s in frames["shape"])
+        n = q.size
+        x = q.astype(np.float32) * np.repeat(scales, chunk)[:n]
+        return x.reshape(shape)
+
+
+class TopKCodec(Codec):
+    """Per-tensor top-k sparsification with error feedback (DGC-style).
+
+    Sends only the ``k = ceil(fraction * n)`` largest-magnitude entries
+    (exact values — support-exact: what is sent arrives bit-for-bit); the
+    rest accumulate in a worker-local per-key residual that is added to the
+    next gradient before selection, so every coordinate's mass is
+    eventually transmitted — the property that keeps training convergent
+    at fractions far below 1. Disable with ``error_feedback=False`` for a
+    pure (lossy-forever) sparsifier. Wire cost ≈ ``fraction * 2`` of raw
+    (int32 index + f32 value per kept entry).
+    """
+
+    name = "topk"
+
+    def __init__(self, fraction: float = 0.01, error_feedback: bool = True):
+        if not (0.0 < fraction <= 1.0):
+            raise ValueError(f"topk fraction {fraction} outside (0, 1]")
+        self.fraction = float(fraction)
+        self.error_feedback = bool(error_feedback)
+        self._residual: Dict[str, np.ndarray] = {}
+
+    def encode(self, key: str, arr) -> Dict[str, np.ndarray]:
+        arr = _contig(arr)
+        if arr.dtype != np.float32 or arr.size >= 2 ** 31:
+            return self._raw(arr)
+        flat = arr.reshape(-1).copy()
+        res = self._residual.get(key)
+        if self.error_feedback and res is not None and res.size == flat.size:
+            flat += res
+        n = flat.size
+        k = min(n, max(1, math.ceil(self.fraction * n))) if n else 0
+        if k and k < n:
+            mag = np.abs(np.nan_to_num(flat, nan=0.0))
+            idx = np.argpartition(mag, n - k)[n - k:]
+            idx.sort()  # deterministic order; also friendlier to scatter
+        else:
+            idx = np.arange(n)
+        val = flat[idx]
+        if self.error_feedback:
+            flat[idx] = 0.0
+            self._residual[key] = flat
+        return {
+            "idx": idx.astype(np.int32),
+            "val": val,
+            "shape": np.asarray(arr.shape, np.int64),
+        }
+
+    def decode(self, frames: Dict[str, np.ndarray]) -> np.ndarray:
+        raw = self._is_raw(frames)
+        if raw is not None:
+            return raw
+        shape = tuple(int(s) for s in frames["shape"])
+        out = np.zeros(int(np.prod(shape, dtype=np.int64)), np.float32)
+        out[frames["idx"]] = frames["val"]
+        return out.reshape(shape)
+
+    def residual_norm(self) -> float:
+        if not self._residual:
+            return 0.0
+        return float(math.sqrt(sum(
+            float(np.dot(r, r)) for r in self._residual.values()
+        )))
+
+
+_REGISTRY = {
+    "none": NoneCodec,
+    "cast16": Cast16Codec,
+    "int8": Int8Codec,
+    "topk": TopKCodec,
+}
+
+
+def available_codecs():
+    return sorted(_REGISTRY)
+
+
+def make_codec(name: str, **kwargs) -> Codec:
+    """Instantiate a codec by wire name (kwargs go to its constructor)."""
+    try:
+        cls = _REGISTRY[name]
+    except KeyError:
+        raise ValueError(
+            f"unknown codec {name!r}; available: {available_codecs()}"
+        ) from None
+    return cls(**kwargs)

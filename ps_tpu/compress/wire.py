@@ -1,0 +1,164 @@
+"""Codec frames ⇄ one contiguous uint8 buffer — the transport adapter.
+
+The bucketed transport (``backends/common.py``) moves ``{key: ndarray}``
+dicts as byte-sliced fusion buckets; it neither knows nor cares what the
+bytes mean. This module makes an encoded tensor LOOK like a plain tensor:
+:func:`pack_frames` serializes a codec's frame dict into one uint8 array
+(magic + json header naming the codec and each frame's dtype/shape + raw
+buffers), so it buckets/stripes/reassembles exactly like raw data. The
+list of packed keys travels in the bucket header (``extra["enc"]``) and
+:func:`decode_tree` reverses the whole thing on the receiving side.
+
+:class:`GradCompressor` is the worker-side driver: policy selection,
+packing, and the codec accounting (ratio / seconds / residual norm) that
+TrainMetrics and StepLogger surface.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ps_tpu.compress.codecs import make_codec
+from ps_tpu.compress.policy import CompressPolicy
+
+_MAGIC = b"PSC1"
+_HDR = struct.Struct("<4sI")  # magic, meta_len
+
+
+def _dtype_token(dt: np.dtype) -> str:
+    """A dtype spelling that survives json + ``np.dtype(...)`` — custom
+    ml_dtypes (bfloat16 et al) stringify to void under ``.str``, but their
+    NAME round-trips once ml_dtypes is imported."""
+    return dt.name if dt.str.lstrip("<>|=").startswith("V") else dt.str
+
+
+def pack_frames(codec: str, frames: Dict[str, np.ndarray]) -> np.ndarray:
+    """Serialize one codec's frame dict into a single uint8 array."""
+    names = sorted(frames)
+    # reshape preserves 0-d shapes that ascontiguousarray would promote
+    arrays = [np.ascontiguousarray(np.asarray(frames[n])).reshape(
+        np.asarray(frames[n]).shape) for n in names]
+    meta = {
+        "codec": codec,
+        "frames": [
+            {"name": n, "dtype": _dtype_token(a.dtype),
+             "shape": list(a.shape)}
+            for n, a in zip(names, arrays)
+        ],
+    }
+    mj = json.dumps(meta).encode()
+    buf = np.empty(_HDR.size + len(mj) + sum(a.nbytes for a in arrays),
+                   np.uint8)
+    _HDR.pack_into(buf, 0, _MAGIC, len(mj))
+    off = _HDR.size
+    buf[off:off + len(mj)] = np.frombuffer(mj, np.uint8)
+    off += len(mj)
+    for a in arrays:
+        n = a.nbytes
+        # ndarray.view sidesteps the buffer protocol, which cannot express
+        # custom dtypes (ml_dtypes bfloat16)
+        buf[off:off + n] = a.reshape(-1).view(np.uint8)
+        off += n
+    return buf
+
+
+def unpack_frames(buf) -> Tuple[str, Dict[str, np.ndarray]]:
+    """Inverse of :func:`pack_frames`; frame buffers are zero-copy views."""
+    buf = np.asarray(buf).reshape(-1).view(np.uint8)
+    magic, mlen = _HDR.unpack_from(buf, 0)
+    if magic != _MAGIC:
+        raise ValueError("not a packed codec buffer (bad magic)")
+    off = _HDR.size
+    meta = json.loads(bytes(buf[off:off + mlen]))
+    off += mlen
+    frames: Dict[str, np.ndarray] = {}
+    for f in meta["frames"]:
+        dt = np.dtype(f["dtype"])
+        n = int(np.prod(f["shape"], dtype=np.int64)) * dt.itemsize
+        frames[f["name"]] = (buf[off:off + n].view(dt)
+                             .reshape(f["shape"]))
+        off += n
+    return meta["codec"], frames
+
+
+# stateless decoder singletons, keyed by wire name — decode never needs
+# the sender's construction params (frames are self-describing)
+_DECODERS: Dict[str, object] = {}
+
+
+def decode_packed(buf) -> np.ndarray:
+    """Packed uint8 buffer -> the original tensor."""
+    name, frames = unpack_frames(buf)
+    codec = _DECODERS.get(name)
+    if codec is None:
+        codec = _DECODERS[name] = make_codec(name)
+    return codec.decode(frames)
+
+
+def decode_tree(arrays: Dict[str, np.ndarray], enc_keys,
+                stats=None) -> Dict[str, np.ndarray]:
+    """Decode the ``enc_keys`` entries of a received ``{key: tensor}`` tree
+    in place (unlisted keys pass through untouched). The server half of the
+    wire negotiation: ``enc_keys`` is the bucket header's ``extra["enc"]``.
+    """
+    if not enc_keys:
+        return arrays
+    t0 = time.perf_counter()
+    enc_bytes = 0
+    raw_bytes = 0
+    for k in enc_keys:
+        if k not in arrays:
+            raise KeyError(f"enc key {k!r} absent from the received tree")
+        enc_bytes += arrays[k].nbytes
+        arrays[k] = decode_packed(arrays[k])
+        raw_bytes += arrays[k].nbytes
+    if stats is not None:
+        stats.record_codec(raw_bytes, enc_bytes, time.perf_counter() - t0)
+    return arrays
+
+
+class GradCompressor:
+    """Worker-side tree encoder: apply the policy key-by-key, pack what
+    compresses, account for it.
+
+    ``stats`` (a :class:`~ps_tpu.utils.metrics.TransportStats`) receives
+    raw/encoded byte counts, codec seconds, and the error-feedback residual
+    norm — the numbers TrainMetrics reports as ``compress_ratio`` /
+    ``codec_s`` / ``residual_norm``.
+    """
+
+    def __init__(self, policy: CompressPolicy, stats=None):
+        self.policy = policy
+        self.stats = stats
+
+    def encode_tree(self, arrays: Dict[str, np.ndarray]
+                    ) -> Tuple[Dict[str, np.ndarray], List[str]]:
+        """``{key: tensor}`` -> (wire tree, keys that were packed)."""
+        if not self.policy.enabled:
+            return arrays, []
+        t0 = time.perf_counter()
+        out: Dict[str, np.ndarray] = {}
+        enc: List[str] = []
+        raw_bytes = 0
+        enc_bytes = 0
+        for k, a in arrays.items():
+            codec = self.policy.select(k, a)
+            if codec.name == "none":
+                out[k] = a
+                continue
+            a = np.asarray(a)
+            packed = pack_frames(codec.name, codec.encode(k, a))
+            out[k] = packed
+            enc.append(k)
+            raw_bytes += a.nbytes
+            enc_bytes += packed.nbytes
+        if enc and self.stats is not None:
+            self.stats.record_codec(raw_bytes, enc_bytes,
+                                    time.perf_counter() - t0)
+            self.stats.record_residual_norm(self.policy.residual_norm())
+        return out, enc

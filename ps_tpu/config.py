@@ -23,6 +23,13 @@ Environment variables honored by :meth:`Config.from_env`:
 - ``PS_BUCKET_BYTES``       — bucketed van transport: fusion-bucket size in
   bytes (0/unset = serial one-frame-per-cycle transport)
 - ``PS_TRANSPORT_POOL``     — connections per server for bucket striping
+- ``PS_COMPRESS``           — gradient codec for the van wire: 'none'
+  (default), 'cast16', 'int8', or 'topk' (ps_tpu/compress)
+- ``PS_COMPRESS_TOPK``      — kept fraction for the topk codec (default 0.01)
+- ``PS_COMPRESS_MIN_BYTES`` — tensors under this many bytes always travel
+  raw (default 65536 — protects optimizer-critical small tensors)
+- ``PS_COMPRESS_PULL``      — '1' also compresses the pull return path on
+  the bucketed transport (cast16/int8 only)
 - ``PS_CKPT_ROOT``          — server side: confine CHECKPOINT saves under
   this root (client paths relative-only, ``..`` refused)
 - ``DMLC_ROLE``, ``DMLC_NUM_WORKER``, ``DMLC_NUM_SERVER``,
@@ -57,6 +64,18 @@ class Config:
       mode: 'sync' or 'async' (async = stale apply with delay compensation).
       dc_lambda: DC-ASGD delay-compensation coefficient (async mode).
       seed: global PRNG seed.
+      bucket_bytes / transport_pool: bucketed van transport — fusion-bucket
+        size (None = serial one-frame-per-cycle) and striped connections
+        per server.
+      compress: gradient codec for the van wire ('cast16', 'int8', 'topk';
+        None/'none' = raw float32). See ps_tpu/compress and the README's
+        "Gradient compression" section.
+      compress_topk: kept fraction for the topk codec (default 0.01).
+      compress_min_bytes: tensors under this many bytes always travel raw
+        (default 65536 — protects optimizer-critical small tensors).
+      compress_pull: also compress the bucketed pull return path
+        (cast16/int8 only; topk is refused — its error-feedback residuals
+        live at the sender).
       heartbeat_base_port: enable the control-plane failure detector for
         multi-process runs. Without ``peer_hosts``, process i's monitor binds
         base_port+i on this host (single-host/localhost topology). With
@@ -100,6 +119,15 @@ class Config:
     # compute/comm overlap (push_pull_async / push_async + flush)
     bucket_bytes: Optional[int] = None
     transport_pool: int = 2
+    # gradient compression on the van wire (ps_tpu/compress): codec name
+    # (None/'none' = raw float32), topk kept-fraction, the size floor under
+    # which tensors always travel raw, and whether bucketed pulls compress
+    # the return path too (cast16/int8 only — topk needs sender-side
+    # error-feedback state a server doesn't have)
+    compress: Optional[str] = None
+    compress_topk: float = 0.01
+    compress_min_bytes: int = 1 << 16
+    compress_pull: bool = False
     # server: confine CHECKPOINT saves under this root (client paths must
     # be relative, '..' escapes refused). None = legacy client-names-path.
     ckpt_root: Optional[str] = None
@@ -176,6 +204,35 @@ class Config:
                              "serial transport)")
         if self.transport_pool < 1:
             raise ValueError("transport_pool must be >= 1")
+        if self.compress not in (None, "none", "cast16", "int8", "topk"):
+            raise ValueError(
+                f"unknown compress codec {self.compress!r}; use 'none', "
+                "'cast16', 'int8' or 'topk'"
+            )
+        if not (0.0 < self.compress_topk <= 1.0):
+            raise ValueError(
+                f"compress_topk {self.compress_topk} outside (0, 1]"
+            )
+        if self.compress_min_bytes < 0:
+            raise ValueError("compress_min_bytes must be >= 0")
+        if self.compress_pull and self.compress == "topk":
+            raise ValueError(
+                "compress_pull cannot use topk (error-feedback residuals "
+                "live at the sender); use cast16 or int8"
+            )
+
+    def compress_spec(self) -> Optional[dict]:
+        """The normalized codec spec dict workers pass to
+        ``connect_async``/``connect_sparse`` (None when compression is off).
+        """
+        if self.compress in (None, "none"):
+            return None
+        return {
+            "codec": self.compress,
+            "topk": self.compress_topk,
+            "min_bytes": self.compress_min_bytes,
+            "pull": self.compress_pull,
+        }
 
     @classmethod
     def from_env(cls, **overrides) -> "Config":
@@ -227,6 +284,18 @@ class Config:
             kwargs["bucket_bytes"] = bb if bb > 0 else None
         if "PS_TRANSPORT_POOL" in env:
             kwargs["transport_pool"] = int(env["PS_TRANSPORT_POOL"])
+        if "PS_COMPRESS" in env:
+            # "" / "none" explicitly selects the raw wire
+            kwargs["compress"] = env["PS_COMPRESS"] or None
+            if kwargs["compress"] == "none":
+                kwargs["compress"] = None
+        if "PS_COMPRESS_TOPK" in env:
+            kwargs["compress_topk"] = float(env["PS_COMPRESS_TOPK"])
+        if "PS_COMPRESS_MIN_BYTES" in env:
+            kwargs["compress_min_bytes"] = int(env["PS_COMPRESS_MIN_BYTES"])
+        if "PS_COMPRESS_PULL" in env:
+            kwargs["compress_pull"] = env["PS_COMPRESS_PULL"].lower() in (
+                "1", "true", "yes", "on")
         if "PS_CKPT_ROOT" in env:
             kwargs["ckpt_root"] = env["PS_CKPT_ROOT"] or None
         if "PS_HEARTBEAT_BASE_PORT" in env:

