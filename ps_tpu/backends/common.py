@@ -35,6 +35,30 @@ DEFAULT_BUCKET_BYTES = 4 << 20
 Slice = Tuple[str, str, list, int, int]
 
 
+def payload_nbytes(payload) -> int:
+    """Wire payload size of a frame in either form: a contiguous
+    bytes/bytearray, or the zero-copy ``(header, chunks)`` parts tuple."""
+    if isinstance(payload, tuple):
+        header, chunks = payload
+        return len(header) + sum(len(c) for c in chunks)
+    return len(payload)
+
+
+def send_payload(ch, payload) -> None:
+    """Send either payload form on ``ch`` (vectored for parts)."""
+    if isinstance(payload, tuple):
+        ch.send_parts(*payload)
+    else:
+        ch.send(payload)
+
+
+def request_payload(ch, payload):
+    """``ch.request`` for either payload form; returns the reply frame."""
+    if isinstance(payload, tuple):
+        return ch.request_parts(*payload)
+    return ch.request(payload)
+
+
 class BucketPlan:
     """Slice a flat ``{key: tensor}`` payload into fixed-size fusion buckets.
 
@@ -94,12 +118,8 @@ class BucketPlan:
     def nbuckets(self) -> int:
         return len(self.buckets)
 
-    def encode_bucket(self, kind: int, worker: int,
-                      arrays: Dict[str, np.ndarray], b: int,
-                      extra: Optional[dict] = None) -> bytearray:
-        """Frame bucket ``b``: each slice's bytes are a ``memoryview`` of
-        the live tensor, copied exactly once into the frame
-        (:func:`~ps_tpu.control.tensor_van.encode_chunks`)."""
+    def _bucket_chunks_meta(self, arrays: Dict[str, np.ndarray], b: int,
+                            extra: Optional[dict]):
         chunks = []
         slices = self.buckets[b]
         for key, _, _, lo, hi in slices:
@@ -109,7 +129,32 @@ class BucketPlan:
                 "bucket": b, "nbuckets": self.nbuckets,
                 "slices": [[k, dt, shape, lo, hi]
                            for k, dt, shape, lo, hi in slices]}
+        return chunks, meta
+
+    def encode_bucket(self, kind: int, worker: int,
+                      arrays: Dict[str, np.ndarray], b: int,
+                      extra: Optional[dict] = None) -> bytearray:
+        """Frame bucket ``b``: each slice's bytes are a ``memoryview`` of
+        the live tensor, copied exactly once into the frame
+        (:func:`~ps_tpu.control.tensor_van.encode_chunks`)."""
+        chunks, meta = self._bucket_chunks_meta(arrays, b, extra)
         return tv.encode_chunks(kind, worker, chunks, meta)
+
+    def encode_bucket_parts(self, kind: int, worker: int,
+                            arrays: Dict[str, np.ndarray], b: int,
+                            extra: Optional[dict] = None):
+        """Zero-copy form of :meth:`encode_bucket`: ``(header, chunks)``
+        with the slice views passed through UNstaged — the channel's
+        vectored send (or the shm ring write) is the only copy the bucket's
+        bytes ever see. The views pin their tensors until sent."""
+        chunks, meta = self._bucket_chunks_meta(arrays, b, extra)
+        return tv.encode_chunks_parts(kind, worker, chunks, meta)
+
+    def bucket_encoder(self, writev: bool):
+        """The ONE lane-selection point for bucket frames: zero-copy parts
+        when ``writev`` is on, the staged legacy frame otherwise. Every
+        sender resolves through here so the rule cannot drift per site."""
+        return self.encode_bucket_parts if writev else self.encode_bucket
 
 
 class BucketAssembler:
@@ -231,14 +276,16 @@ class ChannelPump:
                 continue
             t0 = time.perf_counter()
             try:
-                reply = self._ch.request(payload)
+                # parts tuples ride the vectored/shm zero-copy send;
+                # contiguous frames keep the legacy path
+                reply = request_payload(self._ch, payload)
             except BaseException as e:  # surfaced at the caller's wait
                 fut.set_exception(e)
                 continue
             dt = time.perf_counter() - t0
             if self._on_io is not None:
                 try:
-                    self._on_io(len(payload), len(reply), dt)
+                    self._on_io(payload_nbytes(payload), len(reply), dt)
                 except Exception:
                     pass  # accounting must never fail the transport
             fut.set_result(reply)
@@ -275,13 +322,30 @@ class BucketedTransportMixin:
 
     def _init_transport(self, bucket_bytes: Optional[int],
                         pool_size: Optional[int],
-                        compress=None) -> None:
+                        compress=None, writev: Optional[bool] = None,
+                        shm: Optional[bool] = None,
+                        shm_bytes: Optional[int] = None) -> None:
+        import os
         import uuid
+
+        from ps_tpu.config import env_flag
+        from ps_tpu.control.shm_lane import DEFAULT_SHM_BYTES
 
         # <= 0 selects the serial transport, matching the PS_BUCKET_BYTES=0
         # convention everywhere (a literal 0 must never mean 1-byte buckets)
         self.bucket_bytes = (None if bucket_bytes is None
                              or int(bucket_bytes) <= 0 else int(bucket_bytes))
+        # transport lanes (None = the PS_WRITEV / PS_SHM env defaults):
+        # writev sends frames as kernel scatter-gather iovecs of the live
+        # tensors (no staging bytearray); shm negotiates the same-host
+        # shared-memory ring lane per connection, falling back to TCP
+        # whenever negotiation fails
+        self.writev = (env_flag("PS_WRITEV", True)
+                       if writev is None else bool(writev))
+        self.shm = env_flag("PS_SHM", False) if shm is None else bool(shm)
+        self.shm_bytes = (int(os.environ.get("PS_SHM_BYTES",
+                                             DEFAULT_SHM_BYTES))
+                          if shm_bytes is None else int(shm_bytes))
         # incarnation nonce, sent with every push bucket: a restarted (or
         # reconnected) worker reuses epoch NUMBERS from zero, so the server
         # must never complete a staged epoch of a dead incarnation with
@@ -290,6 +354,10 @@ class BucketedTransportMixin:
         self.pool_size = max(int(pool_size), 1) if pool_size is not None \
             else (2 if self.bucket_bytes is not None else 1)
         self.transport = TransportStats()
+        # reusable receive buffers for the hot pull path (frames whose
+        # lifetime this layer controls: pump replies are consumed —
+        # decoded + copied out — before the next borrow can alias them)
+        self._recv_pool = tv.RecvBufferPool(stats=self.transport)
         self._push_epoch = 0
         self._pull_epoch = 0
         self._pumps: Dict[int, List[ChannelPump]] = {}
@@ -332,16 +400,48 @@ class BucketedTransportMixin:
             return None
         return {k: v for k, v in self.compress.items() if k != "pull"}
 
+    def _maybe_upgrade(self, ch):
+        """Offer the peer the shared-memory lane for ``ch`` when the
+        worker's ``shm`` knob is on; any negotiation failure keeps the
+        plain TCP channel (identical semantics, slower bytes)."""
+        if not self.shm:
+            return ch
+        from ps_tpu.control import shm_lane
+
+        up = shm_lane.try_upgrade(ch, getattr(self, "worker", 0),
+                                  self.shm_bytes, stats=self.transport)
+        up.pool = getattr(ch, "pool", None)
+        return up
+
+    def _dial_transport_channel(self, host, port):
+        """One data-plane connection: dialed, accounted (per-lane stats +
+        receive pool), and shm-upgraded when negotiation succeeds."""
+        ch = tv.Channel.connect(host, port)
+        ch.stats = self.transport
+        ch.pool = self._recv_pool
+        try:
+            return self._maybe_upgrade(ch)
+        except tv.VanError:
+            ch.close()
+            raise
+
     def _open_pumps(self, indices) -> None:
         """Dial ``pool_size`` extra transport connections per server; the
         main channels stay free for control traffic (stats, checkpoints)."""
         for i in indices:
             host, port = self._addrs[i]
-            self._pumps[i] = [
-                ChannelPump(tv.Channel.connect(host, port),
-                            on_io=self._on_pump_io)
-                for _ in range(self.pool_size)
-            ]
+            # registered before filled so a failed dial mid-pool leaves
+            # the already-opened pumps reachable by _close_transport
+            self._pumps[i] = pumps = []
+            for _ in range(self.pool_size):
+                pumps.append(ChannelPump(
+                    self._dial_transport_channel(host, port),
+                    on_io=self._on_pump_io))
+
+    def _release_frame(self, frame) -> None:
+        """Return a fully-consumed reply frame's buffer to the receive
+        pool (no-op for frames the pool did not issue)."""
+        self._recv_pool.ret(frame)
 
     def _on_pump_io(self, sent: int, received: int, seconds: float) -> None:
         with self._bytes_lock:
@@ -430,6 +530,22 @@ class BucketedTransportMixin:
          self._compressor) = saved
         if self._compressor is not None:
             self._compressor.stats = self.transport
+        # the re-dial built fresh accounting sinks against the NEW stats
+        # object; re-point them at the restored one so lane/pool counters
+        # stay continuous across a reconnect
+        self._recv_pool.stats = self.transport
+
+        def repoint(ch):
+            while ch is not None:
+                if getattr(ch, "stats", None) is not None:
+                    ch.stats = self.transport
+                ch = getattr(ch, "_ch", None)  # shm lane wraps the TCP ch
+
+        for pumps in self._pumps.values():
+            for p in pumps:
+                repoint(p._ch)
+        for ch in getattr(self, "_chs", []):
+            repoint(ch)
 
 
 def make_jit_dc_apply_tree(opt: optax.GradientTransformation):

@@ -42,6 +42,8 @@ from ps_tpu.backends.common import (
     BucketedTransportMixin,
     BucketPlan,
     ServerFailureError,
+    payload_nbytes,
+    request_payload,
 )
 from ps_tpu.backends.van_service import VanService, resolve_ckpt_dir
 from ps_tpu.compress import CompressPolicy, GradCompressor, decode_tree
@@ -96,7 +98,9 @@ class AsyncPSService(VanService):
     def __init__(self, store, port: int = 0, bind: str = "127.0.0.1",
                  shard: Optional[int] = None,
                  num_shards: Optional[int] = None,
-                 ckpt_root: Optional[str] = None):
+                 ckpt_root: Optional[str] = None,
+                 writev: Optional[bool] = None,
+                 shm: Optional[bool] = None):
         engine = store._engine
         if getattr(engine, "mode", "sync") != "async":
             raise ValueError("AsyncPSService requires an async-mode KVStore")
@@ -143,7 +147,8 @@ class AsyncPSService(VanService):
         # the DC apply depends on WHAT each worker last pulled; replaying
         # this log through a threaded engine reproduces params bit-for-bit
         self.event_log: List[List] = []
-        super().__init__(port=port, bind=bind)  # starts accepting: state ready
+        # starts accepting: state ready
+        super().__init__(port=port, bind=bind, writev=writev, shm=shm)
 
     # -- server internals -----------------------------------------------------
 
@@ -161,6 +166,11 @@ class AsyncPSService(VanService):
             with self._log_lock:
                 self.event_log.append(["pull", worker])
         host = {k: np.asarray(v) for k, v in kv.items()}
+        if self.writev:
+            # vectored reply: the host tensors are sent as live views
+            # (pinned by the parts), never staged into a frame bytearray
+            return tv.encode_parts(tv.OK, worker, host,
+                                   extra={"version": version})
         return tv.encode(tv.OK, worker, host, extra={"version": version})
 
     def _apply_push(self, worker: int, grads: Dict[str, np.ndarray],
@@ -275,7 +285,13 @@ class AsyncPSService(VanService):
                     }
                 else:
                     self._pull_cache.pop(worker, None)
-            return plan.encode_bucket(tv.OK, worker, host, 0, extra={
+            # vectored reply: the snapshot's live views go straight to the
+            # send (writev iovecs, or one shm-ring write) — the reply's
+            # tensor bytes are never staged into a frame bytearray.
+            # `host` outlives the send: the views pin it, and multi-bucket
+            # snapshots sit in _pull_cache anyway.
+            enc_fn = plan.bucket_encoder(self.writev)
+            return enc_fn(tv.OK, worker, host, 0, extra={
                 "epoch": epoch, "version": version, "enc": enc,
             })
         with self._stage_lock:
@@ -289,7 +305,8 @@ class AsyncPSService(VanService):
             entry["left"].discard(b)
             if not entry["left"]:
                 self._pull_cache.pop(worker, None)
-        return entry["plan"].encode_bucket(
+        enc_fn = entry["plan"].bucket_encoder(self.writev)
+        return enc_fn(
             tv.OK, worker, entry["host"], b,
             extra={"epoch": epoch, "version": entry["version"],
                    "enc": entry["enc"]},
@@ -478,7 +495,9 @@ def serve_async(store, port: int = 0, bind: str = "127.0.0.1",
 def connect_async(uri: str, worker: int, params_like,
                   bucket_bytes: Optional[int] = None,
                   pool_size: Optional[int] = None,
-                  compress=None) -> "RemoteAsyncWorker":
+                  compress=None, writev: Optional[bool] = None,
+                  shm: Optional[bool] = None,
+                  shm_bytes: Optional[int] = None) -> "RemoteAsyncWorker":
     """Join a cross-process async job as worker ``worker``.
 
     ``uri`` is ``host:port`` of the :func:`serve_async` process, or a
@@ -499,7 +518,15 @@ def connect_async(uri: str, worker: int, params_like,
     ``{"codec": "topk", "topk": 0.02, "min_bytes": 65536, "pull": True}``
     (the env spelling is PS_COMPRESS / PS_COMPRESS_TOPK /
     PS_COMPRESS_MIN_BYTES / PS_COMPRESS_PULL). None/"none" ships raw
-    float32 — the previous behavior."""
+    float32 — the previous behavior.
+
+    Transport lanes (README "Transport lanes"): ``writev`` (default on,
+    env PS_WRITEV) sends each frame's tensor bytes as kernel scatter-
+    gather iovecs of the live arrays — no staging copy; ``shm`` (default
+    off, env PS_SHM) negotiates a same-host shared-memory ring lane per
+    connection at connect time — ``shm_bytes`` (env PS_SHM_BYTES) sizes
+    each ring — falling back to TCP whenever the peer is another host,
+    the segments cannot be created, or the server refuses."""
     addrs = []
     for part in uri.split(","):
         host, port = part.strip().rsplit(":", 1)
@@ -507,7 +534,8 @@ def connect_async(uri: str, worker: int, params_like,
     return RemoteAsyncWorker.connect_many(addrs, worker, params_like,
                                           bucket_bytes=bucket_bytes,
                                           pool_size=pool_size,
-                                          compress=compress)
+                                          compress=compress, writev=writev,
+                                          shm=shm, shm_bytes=shm_bytes)
 
 
 class CheckpointRoundError(RuntimeError):
@@ -640,26 +668,34 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
     def __init__(self, host: str, port: int, worker: int, params_like,
                  bucket_bytes: Optional[int] = None,
                  pool_size: Optional[int] = None,
-                 compress=None):
+                 compress=None, writev: Optional[bool] = None,
+                 shm: Optional[bool] = None,
+                 shm_bytes: Optional[int] = None):
         self._init_multi([(host, int(port))], worker, params_like,
                          bucket_bytes=bucket_bytes, pool_size=pool_size,
-                         compress=compress)
+                         compress=compress, writev=writev, shm=shm,
+                         shm_bytes=shm_bytes)
 
     @classmethod
     def connect_many(cls, addrs: Sequence[Tuple[str, int]], worker: int,
                      params_like, bucket_bytes: Optional[int] = None,
                      pool_size: Optional[int] = None,
-                     compress=None) -> "RemoteAsyncWorker":
+                     compress=None, writev: Optional[bool] = None,
+                     shm: Optional[bool] = None,
+                     shm_bytes: Optional[int] = None) -> "RemoteAsyncWorker":
         self = cls.__new__(cls)
         self._init_multi(list(addrs), worker, params_like,
                          bucket_bytes=bucket_bytes, pool_size=pool_size,
-                         compress=compress)
+                         compress=compress, writev=writev, shm=shm,
+                         shm_bytes=shm_bytes)
         return self
 
     def _init_multi(self, addrs: List[Tuple[str, int]], worker: int,
                     params_like, bucket_bytes: Optional[int] = None,
                     pool_size: Optional[int] = None,
-                    compress=None) -> None:
+                    compress=None, writev: Optional[bool] = None,
+                    shm: Optional[bool] = None,
+                    shm_bytes: Optional[int] = None) -> None:
         self.worker = worker
         kv, self._treedef = keymod.flatten_with_keys(params_like)
         # placeholders, not the arrays: reconnect() only needs keys +
@@ -682,7 +718,8 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         self.collective_bytes = 0  # no ICI on the van path, by definition
         self._bytes_lock = threading.Lock()  # _fanout drives _request concurrently
         # bucketed transport config (None bucket_bytes = serial transport)
-        self._init_transport(bucket_bytes, pool_size, compress=compress)
+        self._init_transport(bucket_bytes, pool_size, compress=compress,
+                             writev=writev, shm=shm, shm_bytes=shm_bytes)
         if self.compress and self.compress.get("pull") \
                 and self.compress.get("codec") == "topk":
             raise ValueError(
@@ -722,6 +759,7 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         n = len(addrs)
         for i, (host, port) in enumerate(addrs):
             ch = tv.Channel.connect(host, port)
+            ch.stats = self.transport
             self._chs.append(ch)
             _, _, _, extra = tv.decode(
                 ch.request(tv.encode(tv.HELLO, worker, None))
@@ -768,6 +806,9 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                     f"servers disagree on num_workers ({self.num_workers} "
                     f"vs {nw} at server {i})"
                 )
+            # the topology checked out: offer the same-host shm lane for
+            # this (serial/control) channel — fallback keeps plain TCP
+            self._chs[i] = self._maybe_upgrade(ch)
         missing = [k for k in self._key_order if k not in self._owner]
         if missing:
             raise ValueError(f"no server owns keys {missing[:3]}"
@@ -786,16 +827,16 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
 
     # -- protocol -------------------------------------------------------------
 
-    def _request(self, i: int, payload: bytes):
+    def _request(self, i: int, payload):
         try:
-            reply = self._chs[i].request(payload)
+            reply = request_payload(self._chs[i], payload)
         except tv.VanError as e:
             host, port = self._addrs[i]
             raise ServerFailureError(
                 f"async PS server {i} ({host}:{port}) failed mid-job: {e}"
             ) from e
         with self._bytes_lock:
-            self.bytes_pushed += len(payload)
+            self.bytes_pushed += payload_nbytes(payload)
             self.bytes_pulled += len(reply)
         return reply
 
@@ -881,13 +922,17 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
 
     # -- bucketed, pipelined transport (worker half) --------------------------
 
-    def _encode_serial_push(self, kind: int, sub: Dict[str, np.ndarray]
-                            ) -> bytearray:
+    def _encode_serial_push(self, kind: int, sub: Dict[str, np.ndarray]):
         """One serial push frame, compressed per the policy (the packed-key
-        list rides the frame's extra, as on the bucketed path)."""
+        list rides the frame's extra, as on the bucketed path). With
+        ``writev`` on, the frame travels as zero-copy parts — the grad
+        tensors go to the kernel as iovecs instead of through a staging
+        bytearray (the measurable serial-path win at BERT-size trees)."""
         sub, enc = self._encode_push_tree(sub)
-        return tv.encode(kind, self.worker, sub,
-                         extra={"enc": enc} if enc else None)
+        extra = {"enc": enc} if enc else None
+        if self.writev:
+            return tv.encode_parts(kind, self.worker, sub, extra)
+        return tv.encode(kind, self.worker, sub, extra)
 
     def _require_bucketed(self) -> None:
         if self.bucket_bytes is None:
@@ -916,8 +961,13 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
             sub = {k: np.ascontiguousarray(v) for k, v in sub.items()}
             plan = BucketPlan.from_arrays(sub, self.bucket_bytes)
             pumps = self._pumps[i]
+            # zero-copy frames when writev is on: the bucket's slice views
+            # ride to the pump as (header, chunks) parts and pin `sub`
+            # until sent — the grads' only copy is the kernel's (or the
+            # shm ring's)
+            enc_bucket = plan.bucket_encoder(self.writev)
             for b in range(plan.nbuckets):
-                payload = plan.encode_bucket(
+                payload = enc_bucket(
                     tv.BUCKET_PUSH, self.worker, sub, b,
                     extra={"epoch": epoch,
                            "nonce": self._transport_nonce,
@@ -925,7 +975,9 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                 )
                 futs.append((i, pumps[b % len(pumps)].submit(payload)))
         for i, fut in futs:
-            kind, _, _, extra = tv.decode(self._bucket_reply(i, fut))
+            reply = self._bucket_reply(i, fut)
+            kind, _, _, extra = tv.decode(reply)
+            self._release_frame(reply)  # extra is json-owned; frame done
             if kind != tv.OK:
                 raise RuntimeError(f"server {i} error: {extra.get('error')}")
             if extra.get("committed"):
@@ -953,14 +1005,18 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         rest: List[Tuple[int, Any]] = []
         assemblers: Dict[int, Any] = {}
         for i, fut in first.items():
-            kind, _, tensors, extra = tv.decode(self._bucket_reply(i, fut))
+            reply = self._bucket_reply(i, fut)
+            kind, _, tensors, extra = tv.decode(reply)
             if kind != tv.OK:
+                self._release_frame(reply)  # no borrow strands on errors
                 raise RuntimeError(f"server {i} error: {extra.get('error')}")
             self.versions[i] = int(extra["version"])
             enc_keys.extend(extra.get("enc") or [])
             n = int(extra["nbuckets"])
             asm = BucketAssembler(epoch, n)
-            if asm.add(0, tensors["raw"], extra["slices"], epoch):
+            done = asm.add(0, tensors["raw"], extra["slices"], epoch)
+            self._release_frame(reply)  # assembler copied; buffer reusable
+            if done:
                 kv.update(asm.finish())
                 continue
             assemblers[i] = asm
@@ -970,11 +1026,15 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                                     extra={"epoch": epoch, "bucket": b})
                 rest.append((i, pumps[b % len(pumps)].submit(payload)))
         for i, fut in rest:
-            kind, _, tensors, extra = tv.decode(self._bucket_reply(i, fut))
+            reply = self._bucket_reply(i, fut)
+            kind, _, tensors, extra = tv.decode(reply)
             if kind != tv.OK:
+                self._release_frame(reply)
                 raise RuntimeError(f"server {i} error: {extra.get('error')}")
-            if assemblers[i].add(int(extra["bucket"]), tensors["raw"],
-                                 extra["slices"], epoch):
+            done = assemblers[i].add(int(extra["bucket"]), tensors["raw"],
+                                     extra["slices"], epoch)
+            self._release_frame(reply)
+            if done:
                 kv.update(assemblers[i].finish())
         return decode_tree(kv, enc_keys, stats=self.transport)
 
@@ -1123,7 +1183,8 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                 self.worker, keymod.unflatten(
                     self._treedef, self._kv_like, self._key_order),
                 bucket_bytes=self.bucket_bytes, pool_size=self.pool_size,
-                compress=self.compress)
+                compress=self.compress, writev=self.writev, shm=self.shm,
+                shm_bytes=self.shm_bytes)
         finally:
             # restores the compressor too: topk error-feedback residuals
             # are unsent gradient mass and must survive the re-dial

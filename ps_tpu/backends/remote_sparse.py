@@ -41,6 +41,8 @@ from ps_tpu.backends.common import (
     BucketedTransportMixin,
     BucketPlan,
     ServerFailureError,
+    payload_nbytes,
+    request_payload,
 )
 from ps_tpu.backends.remote_async import (
     CheckpointRoundError,
@@ -103,7 +105,9 @@ class SparsePSService(VanService):
                  bind: str = "127.0.0.1", shard: Optional[int] = None,
                  num_shards: Optional[int] = None,
                  total_rows: Optional[Dict[str, int]] = None,
-                 ckpt_root: Optional[str] = None):
+                 ckpt_root: Optional[str] = None,
+                 writev: Optional[bool] = None,
+                 shm: Optional[bool] = None):
         if not tables:
             raise ValueError("no tables to serve")
         if (shard is None) != (num_shards is None):
@@ -156,7 +160,8 @@ class SparsePSService(VanService):
         }
         self._log_lock = threading.Lock()
         self.apply_log: List[int] = []  # worker id per applied push message
-        super().__init__(port=port, bind=bind)  # starts accepting: state ready
+        # starts accepting: state ready
+        super().__init__(port=port, bind=bind, writev=writev, shm=shm)
 
     # -- server internals -----------------------------------------------------
 
@@ -227,6 +232,10 @@ class SparsePSService(VanService):
                 ids = self._localize(name, t["ids"])
                 out[f"{name}/rows"] = np.asarray(self._tables[name].pull(ids))
             versions = dict(self.versions)
+        if self.writev:
+            # vectored reply: pulled rows go out as live views, unstaged
+            return tv.encode_parts(tv.OK, worker, out,
+                                   extra={"versions": versions})
         return tv.encode(tv.OK, worker, out, extra={"versions": versions})
 
     def _handle(self, kind: int, worker: int, tensors, extra) -> bytes:
@@ -374,7 +383,9 @@ def connect_sparse(uri: str, worker: int,
                    tables: Dict[str, Tuple[int, int]],
                    bucket_bytes: Optional[int] = None,
                    pool_size: Optional[int] = None,
-                   compress=None) -> "RemoteSparseWorker":
+                   compress=None, writev: Optional[bool] = None,
+                   shm: Optional[bool] = None,
+                   shm_bytes: Optional[int] = None) -> "RemoteSparseWorker":
     """Join a cross-process sparse PS as worker ``worker``.
 
     ``uri`` is ``host:port`` or a comma-separated list naming every server
@@ -387,14 +398,19 @@ def connect_sparse(uri: str, worker: int,
     quantizes the ``<table>/grads`` payloads on the wire; ids always travel
     raw (they are int32 — the policy's dtype gate). ``topk`` is refused
     here: row pushes already ARE a sparsification, and error-feedback
-    residuals keyed by table would mix different row sets."""
+    residuals keyed by table would mix different row sets.
+
+    ``writev``/``shm``/``shm_bytes`` select the zero-copy transport lanes
+    exactly as in :func:`~ps_tpu.backends.remote_async.connect_async`
+    (README "Transport lanes"; env PS_WRITEV / PS_SHM / PS_SHM_BYTES)."""
     addrs = []
     for part in uri.split(","):
         host, port = part.strip().rsplit(":", 1)
         addrs.append((host, int(port)))
     return RemoteSparseWorker(addrs, worker, tables,
                               bucket_bytes=bucket_bytes, pool_size=pool_size,
-                              compress=compress)
+                              compress=compress, writev=writev, shm=shm,
+                              shm_bytes=shm_bytes)
 
 
 class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
@@ -417,16 +433,21 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                  tables: Dict[str, Tuple[int, int]],
                  bucket_bytes: Optional[int] = None,
                  pool_size: Optional[int] = None,
-                 compress=None):
+                 compress=None, writev: Optional[bool] = None,
+                 shm: Optional[bool] = None,
+                 shm_bytes: Optional[int] = None):
         self._init_multi(list(addrs), worker, tables,
                          bucket_bytes=bucket_bytes, pool_size=pool_size,
-                         compress=compress)
+                         compress=compress, writev=writev, shm=shm,
+                         shm_bytes=shm_bytes)
 
     def _init_multi(self, addrs: List[Tuple[str, int]], worker: int,
                     tables: Dict[str, Tuple[int, int]],
                     bucket_bytes: Optional[int] = None,
                     pool_size: Optional[int] = None,
-                    compress=None) -> None:
+                    compress=None, writev: Optional[bool] = None,
+                    shm: Optional[bool] = None,
+                    shm_bytes: Optional[int] = None) -> None:
         """Fresh dial + validation — ``__init__``'s whole body, factored so
         :meth:`reconnect` re-inits without re-running ``__init__`` on a
         live instance (and so a failed re-dial leaves the identity fields
@@ -457,7 +478,8 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                 "sparsify, and per-table error-feedback residuals would "
                 "mix different row sets across steps — use cast16 or int8"
             )
-        self._init_transport(bucket_bytes, pool_size, compress=spec)
+        self._init_transport(bucket_bytes, pool_size, compress=spec,
+                             writev=writev, shm=shm, shm_bytes=shm_bytes)
         try:
             self._connect_and_validate(worker)
         except Exception:
@@ -482,6 +504,7 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         n = len(self._addrs)
         for i, (host, port) in enumerate(self._addrs):
             ch = tv.Channel.connect(host, port)
+            ch.stats = self.transport
             self._chs.append(ch)
             _, _, _, extra = tv.decode(
                 ch.request(tv.encode(tv.HELLO, worker, None))
@@ -515,6 +538,8 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
             # server restarted from a checkpoint), like the dense worker
             for name, v in extra.get("versions", {}).items():
                 self._versions[name][i] = int(v)
+            # validated: offer the same-host shm lane (TCP on any failure)
+            self._chs[i] = self._maybe_upgrade(ch)
         for name, ranges in self._ranges.items():
             ranges.sort()
             total = self._spec[name][0]
@@ -548,16 +573,16 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
 
     # -- protocol -------------------------------------------------------------
 
-    def _request(self, i: int, payload: bytes):
+    def _request(self, i: int, payload):
         try:
-            reply = self._chs[i].request(payload)
+            reply = request_payload(self._chs[i], payload)
         except tv.VanError as e:
             host, port = self._addrs[i]
             raise ServerFailureError(
                 f"sparse PS server {i} ({host}:{port}) failed mid-job: {e}"
             ) from e
         with self._bytes_lock:
-            self.bytes_pushed += len(payload)
+            self.bytes_pushed += payload_nbytes(payload)
             self.bytes_pulled += len(reply)
         return reply
 
@@ -667,12 +692,14 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         for i, m in msgs.items():
             self._check(i, m)
 
-    def _encode_serial_push(self, kind: int, t: Dict[str, np.ndarray]
-                            ) -> bytearray:
-        """One serial row-push frame, grads compressed per the policy."""
+    def _encode_serial_push(self, kind: int, t: Dict[str, np.ndarray]):
+        """One serial row-push frame, grads compressed per the policy
+        (zero-copy parts when ``writev`` is on, as in the dense worker)."""
         t, enc = self._encode_push_tree(t)
-        return tv.encode(kind, self.worker, t,
-                         extra={"enc": enc} if enc else None)
+        extra = {"enc": enc} if enc else None
+        if self.writev:
+            return tv.encode_parts(kind, self.worker, t, extra)
+        return tv.encode(kind, self.worker, t, extra)
 
     # -- bucketed, non-blocking push (the pipelined transport) ----------------
 
@@ -692,8 +719,10 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
             t = {k: np.ascontiguousarray(v) for k, v in t.items()}
             plan = BucketPlan.from_arrays(t, self.bucket_bytes)
             pumps = self._pumps[i]
+            # zero-copy frames when writev is on (see the dense twin)
+            enc_bucket = plan.bucket_encoder(self.writev)
             for b in range(plan.nbuckets):
-                payload = plan.encode_bucket(
+                payload = enc_bucket(
                     tv.ROW_BUCKET_PUSH, self.worker, t, b,
                     extra={"epoch": epoch,
                            "nonce": self._transport_nonce,
@@ -701,7 +730,11 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                 )
                 futs.append((i, pumps[b % len(pumps)].submit(payload)))
         for i, fut in futs:
-            self._check(i, self._bucket_reply(i, fut))
+            reply = self._bucket_reply(i, fut)
+            try:
+                self._check(i, reply)
+            finally:
+                self._release_frame(reply)  # even when _check raises
 
     def push_async(self, pushes: Dict[str, Tuple[Any, Any]],
                    dedupe: bool = True) -> PendingCycle:
@@ -820,7 +853,8 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                 list(addrs) if addrs is not None else self._addrs,
                 self.worker, dict(self._spec),
                 bucket_bytes=self.bucket_bytes, pool_size=self.pool_size,
-                compress=self.compress)
+                compress=self.compress, writev=self.writev, shm=self.shm,
+                shm_bytes=self.shm_bytes)
         finally:
             self._restore_transport_state(saved)
 

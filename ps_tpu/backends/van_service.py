@@ -40,7 +40,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
-from ps_tpu.backends.common import BucketAssembler
+from ps_tpu.backends.common import BucketAssembler, send_payload
 from ps_tpu.control import tensor_van as tv
 from ps_tpu.utils.metrics import TransportStats
 
@@ -83,7 +83,22 @@ class VanService:
         your commit path checks so no push lands after ``stop()`` returns.
     """
 
-    def __init__(self, port: int = 0, bind: str = "127.0.0.1"):
+    def __init__(self, port: int = 0, bind: str = "127.0.0.1",
+                 writev: Optional[bool] = None,
+                 shm: Optional[bool] = None):
+        from ps_tpu.config import env_flag
+
+        # vectored replies (scatter-gather send of live snapshot tensors —
+        # no staging bytearray) and willingness to accept a worker's
+        # same-host shared-memory lane offer. None = the PS_WRITEV /
+        # PS_SHM env defaults; PS_SHM=0 is the job-wide lane off-switch
+        # (workers then never offer, and this side also refuses — note the
+        # asymmetric defaults: workers only OFFER on explicit PS_SHM=1,
+        # servers ACCEPT offers unless explicitly told not to).
+        self.writev = (env_flag("PS_WRITEV", True)
+                       if writev is None else bool(writev))
+        self._shm_accept = (env_flag("PS_SHM", True)
+                            if shm is None else bool(shm))
         self._listener = tv.Listener(port=port, bind=bind)
         self._stop = threading.Event()
         self._chan_lock = threading.Lock()
@@ -104,9 +119,14 @@ class VanService:
         self._stage_lock = threading.Lock()
         self._push_stage: Dict[int, BucketAssembler] = {}
         # server-side transport accounting: stale-epoch drops (observable
-        # via STATS and the worker's StepLogger line) and codec seconds for
-        # compressed pushes/pulls
+        # via STATS and the worker's StepLogger line), codec seconds for
+        # compressed pushes/pulls, and the zero-copy lane counters (shm
+        # frames, spill, vectored-reply bytes, recv-pool hit rate)
         self.transport = TransportStats()
+        # reusable receive buffers for the serve loop: a request frame is
+        # provably dead once its reply is sent, so the loop borrows and
+        # returns per request instead of allocating per frame
+        self._recv_pool = tv.RecvBufferPool(stats=self.transport)
         # checkpoint ownership token (issued at pause, validated by every
         # later phase, cleared at resume) — shared bookkeeping for both
         # concrete services; mutated only under the subclass's apply lock
@@ -233,6 +253,8 @@ class VanService:
             ch = self._listener.accept(timeout_ms=200)
             if ch is None:
                 continue
+            ch.stats = self.transport
+            ch.pool = self._recv_pool
             with self._chan_lock:
                 # prune finished serve threads so a long-lived server with
                 # many reconnects doesn't accumulate dead Thread objects
@@ -250,11 +272,41 @@ class VanService:
                 self._conns.append(t)
             t.start()
 
+    def _try_shm_upgrade(self, ch: tv.Channel, worker: int, extra: dict):
+        """Attach the worker's offered ring segments; returns
+        ``(lane_or_None, reply_frame)`` — any failure becomes an ERR reply
+        and the connection stays plain TCP."""
+        from ps_tpu.control import shm_lane
+
+        if not self._shm_accept:
+            return None, tv.encode(tv.ERR, worker, None, extra={
+                "error": "shm lane disabled on this server (PS_SHM=0)",
+            })
+        try:
+            lane = shm_lane.accept_upgrade(ch, extra, stats=self.transport)
+        except Exception as e:
+            return None, tv.encode(tv.ERR, worker, None,
+                                   extra={"error": repr(e)})
+        return lane, tv.encode(tv.OK, worker, None, extra={"shm": True})
+
+    @staticmethod
+    def _send_reply(conn, reply) -> None:
+        """Reply in either form: contiguous frame, or zero-copy
+        ``(header, chunks)`` parts (vectored TCP send / one ring write)."""
+        send_payload(conn, reply)
+
     def _serve(self, ch: tv.Channel) -> None:
+        # `conn` is the data plane: the TCP channel until a successful
+        # SHM_SETUP, the shared-memory lane after (the lane's recv hands
+        # out ring frames IN PLACE and polls the TCP side for oversize
+        # spills and peer death; stop() still severs via the TCP channel)
+        conn = ch
+        lane = None
         try:
             while not self._stop.is_set():
                 try:
-                    msg = ch.recv()
+                    msg = (conn.recv() if lane is None
+                           else lane.recv(stop=self._stop.is_set))
                 except tv.VanError:
                     return  # worker hung up (or stop() severed an idle conn)
                 with self._inflight_cond:
@@ -262,8 +314,12 @@ class VanService:
                 try:
                     kind, worker, tensors, extra = tv.decode(msg)
                     goodbye = kind == tv.SHUTDOWN
+                    new_lane = None
                     if goodbye:
                         reply = tv.encode(tv.OK, worker, None)
+                    elif kind == tv.SHM_SETUP and lane is None:
+                        new_lane, reply = self._try_shm_upgrade(
+                            ch, worker, extra)
                     else:
                         try:
                             reply = self._handle(kind, worker, tensors, extra)
@@ -271,9 +327,25 @@ class VanService:
                             reply = tv.encode(tv.ERR, worker, None,
                                               extra={"error": repr(e)})
                     try:
-                        ch.send(reply)
+                        self._send_reply(conn, reply)
                     except tv.VanError:
-                        return  # worker vanished mid-reply; nothing to tell it
+                        if new_lane is not None:
+                            # attached but never adopted (the OK reply
+                            # died): release its mappings deterministically
+                            new_lane.close()
+                        return  # worker vanished mid-reply; nothing to tell
+                    finally:
+                        # ONLY now is the request frame provably dead: the
+                        # reply may alias it (a handler may echo zero-copy
+                        # views of the request), so the buffer goes back
+                        # to the pool after the send attempt — success or
+                        # failure — never before. The shm lane's ring
+                        # bytes are likewise released at the NEXT recv.
+                        tensors = None
+                        self._recv_pool.ret(msg)
+                        msg = None
+                    if new_lane is not None:
+                        conn = lane = new_lane  # data plane switches here
                 finally:
                     with self._inflight_cond:
                         self._inflight -= 1
@@ -284,7 +356,10 @@ class VanService:
                         self._goodbye_cond.notify_all()
                     return
         finally:
-            ch.close()
+            if lane is not None:
+                lane.close()  # closes the TCP channel too
+            else:
+                ch.close()
             with self._chan_lock:
                 try:
                     self._channels.remove(ch)

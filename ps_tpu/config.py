@@ -30,6 +30,13 @@ Environment variables honored by :meth:`Config.from_env`:
   raw (default 65536 — protects optimizer-critical small tensors)
 - ``PS_COMPRESS_PULL``      — '1' also compresses the pull return path on
   the bucketed transport (cast16/int8 only)
+- ``PS_WRITEV``             — '0' disables vectored (scatter-gather) frame
+  sends and restores the legacy staging-bytearray framing (default on)
+- ``PS_SHM``                — '1' negotiates the same-host shared-memory
+  ring lane per van connection (TCP fallback on any failure); '0' also
+  makes servers refuse offers (job-wide off switch)
+- ``PS_SHM_BYTES``          — ring capacity per direction for the shm lane
+  (default 16 MiB — cache-resident)
 - ``PS_CKPT_ROOT``          — server side: confine CHECKPOINT saves under
   this root (client paths relative-only, ``..`` refused)
 - ``DMLC_ROLE``, ``DMLC_NUM_WORKER``, ``DMLC_NUM_SERVER``,
@@ -42,6 +49,24 @@ from __future__ import annotations
 import dataclasses
 import os
 from typing import Optional
+
+
+def env_flag(name: str, default: bool) -> bool:
+    """The ONE parser for boolean PS_* env knobs (PS_WRITEV, PS_SHM, ...):
+    every consumer — Config.from_env, the workers' transport init, the
+    server's accept gate — resolves through here, so the accepted token
+    set can never drift between them. Unset (or unrecognized) values keep
+    ``default``; the worker-off/server-accept asymmetry of PS_SHM is
+    expressed purely through each caller's default."""
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    v = v.strip().lower()
+    if v in ("1", "true", "yes", "on"):
+        return True
+    if v in ("0", "false", "no", "off"):
+        return False
+    return default
 
 
 @dataclasses.dataclass
@@ -76,6 +101,19 @@ class Config:
       compress_pull: also compress the bucketed pull return path
         (cast16/int8 only; topk is refused — its error-feedback residuals
         live at the sender).
+      writev: vectored frame sends (README "Transport lanes") — tensor
+        bytes go to the kernel as scatter-gather iovecs of the live
+        arrays instead of through a per-frame staging bytearray. On by
+        default; turn off only to compare against the legacy framing
+        (the wire bytes are identical either way).
+      shm: negotiate the same-host shared-memory ring lane per van
+        connection (worker and server must report the same boot id);
+        falls back to TCP when negotiation fails, the segments cannot be
+        created, or the peer dies. Off by default — explicit opt-in,
+        like the bucketed transport.
+      shm_bytes: ring capacity per direction for the shm lane (default
+        16 MiB — small enough to stay cache-resident; frames over
+        half a ring spill to TCP transparently).
       heartbeat_base_port: enable the control-plane failure detector for
         multi-process runs. Without ``peer_hosts``, process i's monitor binds
         base_port+i on this host (single-host/localhost topology). With
@@ -128,6 +166,13 @@ class Config:
     compress_topk: float = 0.01
     compress_min_bytes: int = 1 << 16
     compress_pull: bool = False
+    # zero-copy transport lanes (README "Transport lanes"): vectored
+    # scatter-gather sends (no staging copy; identical wire bytes) and the
+    # same-host shared-memory ring lane (negotiated per connection at
+    # connect time, TCP fallback on any failure)
+    writev: bool = True
+    shm: bool = False
+    shm_bytes: int = 16 << 20
     # server: confine CHECKPOINT saves under this root (client paths must
     # be relative, '..' escapes refused). None = legacy client-names-path.
     ckpt_root: Optional[str] = None
@@ -220,6 +265,11 @@ class Config:
                 "compress_pull cannot use topk (error-feedback residuals "
                 "live at the sender); use cast16 or int8"
             )
+        if self.shm_bytes < (1 << 16):
+            raise ValueError(
+                f"shm_bytes {self.shm_bytes} too small: the ring needs at "
+                f"least 64 KiB per direction to be worth negotiating"
+            )
 
     def compress_spec(self) -> Optional[dict]:
         """The normalized codec spec dict workers pass to
@@ -294,8 +344,13 @@ class Config:
         if "PS_COMPRESS_MIN_BYTES" in env:
             kwargs["compress_min_bytes"] = int(env["PS_COMPRESS_MIN_BYTES"])
         if "PS_COMPRESS_PULL" in env:
-            kwargs["compress_pull"] = env["PS_COMPRESS_PULL"].lower() in (
-                "1", "true", "yes", "on")
+            kwargs["compress_pull"] = env_flag("PS_COMPRESS_PULL", False)
+        if "PS_WRITEV" in env:
+            kwargs["writev"] = env_flag("PS_WRITEV", True)
+        if "PS_SHM" in env:
+            kwargs["shm"] = env_flag("PS_SHM", False)
+        if "PS_SHM_BYTES" in env:
+            kwargs["shm_bytes"] = int(env["PS_SHM_BYTES"])
         if "PS_CKPT_ROOT" in env:
             kwargs["ckpt_root"] = env["PS_CKPT_ROOT"] or None
         if "PS_HEARTBEAT_BASE_PORT" in env:

@@ -52,6 +52,10 @@ BUCKET_PULL = 13   # bucket 0 snapshots the tree server-side; buckets 1..n-1
 #                    stream the remaining slices of that same snapshot
 ROW_BUCKET_PUSH = 14  # sparse twin: row chunks staged per epoch, applied
 #                    as ONE atomic multi-table push when the epoch completes
+SHM_SETUP = 15     # same-host shared-memory lane negotiation: the worker
+#                    names two ring segments + its boot id; an OK reply
+#                    switches the connection's data plane to the rings
+#                    (ps_tpu/control/shm_lane.py), ERR keeps plain TCP
 
 _HDR = struct.Struct("<BIQ")  # kind, worker_id, meta_len
 
@@ -71,6 +75,25 @@ def _lib():
     # second arg is c_void_p (not c_char_p) so zero-copy bytearray frames
     # from encode() can be handed over via from_buffer
     lib.tv_send.argtypes = [ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64]
+    lib.tv_send_vec.restype = ctypes.c_int
+    lib.tv_send_vec.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,
+    ]
+    lib.tv_poll_readable.restype = ctypes.c_int
+    lib.tv_poll_readable.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    # shm-ring primitives (GIL-free copies, acquire/release cursors, and
+    # the futex-free adaptive wait) — ps_tpu/control/shm_lane.py
+    lib.tv_memcpy.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                              ctypes.c_uint64]
+    lib.tv_prefault.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                ctypes.c_int]
+    lib.tv_load_u64.restype = ctypes.c_uint64
+    lib.tv_load_u64.argtypes = [ctypes.c_void_p]
+    lib.tv_store_u64.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.tv_wait_u64.restype = ctypes.c_int
+    lib.tv_wait_u64.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                                ctypes.c_int, ctypes.c_int]
     lib.tv_recv_size.restype = ctypes.c_int64
     lib.tv_recv_size.argtypes = [ctypes.c_void_p]
     lib.tv_recv_into.restype = ctypes.c_int
@@ -84,15 +107,17 @@ def _lib():
 # -- tensor-tree codec -------------------------------------------------------
 
 
-def encode(kind: int, worker: int, tensors: Optional[Dict[str, np.ndarray]],
-           extra: Optional[dict] = None) -> bytearray:
-    """One message: header + json meta (+ optional 'extra' json fields) +
-    concatenated raw buffers. Keys are encoded in sorted order.
-
-    Exactly ONE copy of each tensor's bytes is made — straight into the
-    preallocated frame (no per-array ``tobytes`` temporaries, no join copy).
-    At BERT-size trees (~0.4 GB/frame) the removed copies were a measurable
-    slice of serve latency (tools/bench_van.py)."""
+def encode_parts(kind: int, worker: int,
+                 tensors: Optional[Dict[str, np.ndarray]],
+                 extra: Optional[dict] = None):
+    """The zero-copy form of :func:`encode`: returns ``(header, chunks)``
+    where ``header`` is the packed frame header + json meta (a bytearray)
+    and ``chunks`` are byte ``memoryview``s of the LIVE tensors, in frame
+    order. ``header + b"".join(chunks)`` is byte-identical to
+    :func:`encode`'s frame — asserted by the frame-parity property tests —
+    but nothing is staged: :meth:`Channel.send_parts` hands the views
+    straight to the kernel (writev) and the shm lane writes them once into
+    its ring. The views pin their source arrays for the send's duration."""
     names = sorted(tensors) if tensors else []
     arrays = [np.ascontiguousarray(np.asarray(tensors[n])) for n in names]
     meta = {
@@ -103,16 +128,57 @@ def encode(kind: int, worker: int, tensors: Optional[Dict[str, np.ndarray]],
         "extra": extra or {},
     }
     mj = json.dumps(meta).encode()
-    buf = bytearray(_HDR.size + len(mj) + sum(a.nbytes for a in arrays))
-    _HDR.pack_into(buf, 0, kind, worker, len(mj))
-    off = _HDR.size
-    buf[off:off + len(mj)] = mj
-    off += len(mj)
-    for a in arrays:
-        n = a.nbytes
-        buf[off:off + n] = memoryview(a).cast("B")
+    header = bytearray(_HDR.size + len(mj))
+    _HDR.pack_into(header, 0, kind, worker, len(mj))
+    header[_HDR.size:] = mj
+    # zero-size arrays can't cast("B") (zeros in shape); they contribute
+    # no bytes, only their meta entry
+    return header, [memoryview(a).cast("B") if a.nbytes else memoryview(b"")
+                    for a in arrays]
+
+
+def encode_chunks_parts(kind: int, worker: int, chunks,
+                        extra: Optional[dict] = None):
+    """Zero-copy twin of :func:`encode_chunks`: ``(header, chunks)`` with
+    the caller's byte views passed through untouched (the bucketed
+    transport's frame, minus its staging copy)."""
+    total = sum(len(c) for c in chunks)
+    meta = {
+        "tensors": [{"name": "raw", "dtype": "|u1", "shape": [total]}],
+        "extra": extra or {},
+    }
+    mj = json.dumps(meta).encode()
+    header = bytearray(_HDR.size + len(mj))
+    _HDR.pack_into(header, 0, kind, worker, len(mj))
+    header[_HDR.size:] = mj
+    return header, list(chunks)
+
+
+def assemble(header, chunks) -> bytearray:
+    """Stage ``(header, chunks)`` parts into one contiguous legacy frame
+    (each chunk copied exactly once) — the fallback when a channel cannot
+    send vectored, and the definition the parity tests hold the vectored
+    path to."""
+    buf = bytearray(len(header) + sum(len(c) for c in chunks))
+    buf[:len(header)] = header
+    off = len(header)
+    for c in chunks:
+        n = len(c)
+        buf[off:off + n] = c
         off += n
     return buf
+
+
+def encode(kind: int, worker: int, tensors: Optional[Dict[str, np.ndarray]],
+           extra: Optional[dict] = None) -> bytearray:
+    """One message: header + json meta (+ optional 'extra' json fields) +
+    concatenated raw buffers. Keys are encoded in sorted order.
+
+    Exactly ONE copy of each tensor's bytes is made — straight into the
+    preallocated frame (no per-array ``tobytes`` temporaries, no join copy).
+    Defined as ``assemble(*encode_parts(...))`` so the legacy single-buffer
+    framing and the vectored path can never drift apart."""
+    return assemble(*encode_parts(kind, worker, tensors, extra))
 
 
 def encode_chunks(kind: int, worker: int, chunks, extra: Optional[dict] = None
@@ -120,28 +186,9 @@ def encode_chunks(kind: int, worker: int, chunks, extra: Optional[dict] = None
     """One message whose single tensor ``raw`` (uint8 ``[total]``) is the
     concatenation of ``chunks`` — buffer-protocol byte views, typically
     ``memoryview`` slices of live tensors (the bucketed-transport frame of
-    :class:`ps_tpu.backends.common.BucketPlan`).
-
-    Same zero-extra-copy discipline as :func:`encode`: each chunk's bytes
-    are copied exactly once, straight into the preallocated frame — no
-    intermediate concatenation buffer.
-    """
-    total = sum(len(c) for c in chunks)
-    meta = {
-        "tensors": [{"name": "raw", "dtype": "|u1", "shape": [total]}],
-        "extra": extra or {},
-    }
-    mj = json.dumps(meta).encode()
-    buf = bytearray(_HDR.size + len(mj) + total)
-    _HDR.pack_into(buf, 0, kind, worker, len(mj))
-    off = _HDR.size
-    buf[off:off + len(mj)] = mj
-    off += len(mj)
-    for c in chunks:
-        n = len(c)
-        buf[off:off + n] = c
-        off += n
-    return buf
+    :class:`ps_tpu.backends.common.BucketPlan`). Staged form of
+    :func:`encode_chunks_parts`."""
+    return assemble(*encode_chunks_parts(kind, worker, chunks, extra))
 
 
 def decode(buf: memoryview) -> Tuple[int, int, Dict[str, np.ndarray], dict]:
@@ -168,6 +215,82 @@ class VanError(ConnectionError):
     """The peer closed or the frame was invalid."""
 
 
+class RecvBufferPool:
+    """Size-bucketed borrow/return pool for receive frames.
+
+    ``Channel.recv`` allocates a fresh bytearray per frame; on the hot pull
+    path that is one multi-MB allocation per bucket per cycle, all churned
+    through the allocator. Owners whose frame lifetimes are explicit — the
+    serve loop (frame dead once the reply is sent) and the pump-reply
+    consumers (frame dead once decoded/assembled) — borrow here instead and
+    return the buffer when done. Buffers are allocated at the requested
+    size (never pow2-rounded — the recurring workload is same-size bucket
+    frames, so rounding would only zero-fill and pin up to 2x the bytes)
+    and filed by next-power-of-two class; a borrow scans its class for a
+    buffer with enough capacity. Frames under ``min_bytes`` are not worth
+    pooling, and frames over ``max_bytes`` are not worth RETAINING (a
+    pooled serial BERT-size frame would pin hundreds of MB for the
+    process lifetime) — both fall through to a plain allocation (not
+    counted as misses). Thread-safe; a buffer returned twice, or one the
+    pool never issued, is ignored.
+    """
+
+    def __init__(self, min_bytes: int = 1 << 16,
+                 max_bytes: int = 64 << 20,
+                 max_per_class: int = 8, stats=None):
+        import threading
+
+        self.min_bytes = int(min_bytes)
+        self.max_bytes = int(max_bytes)
+        self.max_per_class = int(max_per_class)
+        self.stats = stats  # TransportStats with record_pool(hit)
+        self._lock = threading.Lock()
+        self._free: Dict[int, list] = {}
+        self._out: set = set()  # id() of buffers currently borrowed
+
+    def borrow(self, n: int):
+        """A bytearray of capacity >= n, or None (caller allocates)."""
+        if n < self.min_bytes or n > self.max_bytes:
+            return None
+        cls = max(n - 1, 1).bit_length()  # next power of two >= n
+        buf = None
+        with self._lock:
+            free = self._free.get(cls)
+            if free:
+                # same-class buffers may be slightly smaller than n (they
+                # are request-sized, not pow2): take the first that fits
+                for i, b in enumerate(free):
+                    if len(b) >= n:
+                        buf = b
+                        del free[i]
+                        break
+            hit = buf is not None
+            if buf is None:
+                buf = bytearray(n)
+            self._out.add(id(buf))
+        if self.stats is not None:
+            self.stats.record_pool(hit)
+        return buf
+
+    def ret(self, frame) -> None:
+        """Return a borrowed buffer. Accepts the memoryview ``recv``
+        handed out (its ``.obj`` is the pooled buffer) or the buffer
+        itself; anything else is a no-op, so callers can return every
+        frame unconditionally."""
+        buf = getattr(frame, "obj", frame)
+        if not isinstance(buf, bytearray) \
+                or not (self.min_bytes <= len(buf) <= self.max_bytes):
+            return  # never issued a buffer outside the pooling range
+        cls = max(len(buf) - 1, 1).bit_length()
+        with self._lock:
+            if id(buf) not in self._out:
+                return
+            self._out.discard(id(buf))
+            free = self._free.setdefault(cls, [])
+            if len(free) < self.max_per_class:
+                free.append(buf)
+
+
 class Channel:
     """One framed TCP connection (blocking; one driving thread at a time —
     except :meth:`shutdown`/:meth:`close`, which are cross-thread safe).
@@ -176,6 +299,15 @@ class Channel:
     severs the socket immediately (waking any thread blocked in recv) but
     defers the ``tv_close`` free until the last thread inside a native call
     exits, so no peer thread can dereference a freed Conn."""
+
+    #: set by owners that account per-lane transport (a TransportStats);
+    #: send_parts records its staging-copy-avoided bytes here
+    stats = None
+    #: set by owners with explicit frame lifetimes (a RecvBufferPool);
+    #: recv borrows receive buffers from it instead of allocating
+    pool = None
+    #: lane tag for accounting ("tcp" here; the shm lane overrides)
+    lane = "tcp"
 
     def __init__(self, handle, lib):
         import threading
@@ -188,20 +320,52 @@ class Channel:
 
     @classmethod
     def connect(cls, host: str, port: int, timeout_ms: int = 10_000,
-                retries: int = 50, retry_delay_s: float = 0.1) -> "Channel":
-        """Dial host:port, retrying while the server comes up."""
+                retries: int = 50, retry_delay_s: float = 0.1,
+                max_wait_s: float = 15.0) -> "Channel":
+        """Dial host:port, retrying while the server comes up.
+
+        The hostname is re-resolved on EVERY attempt (a restarted server —
+        or a k8s service — may come back at a new address; resolving once
+        outside the loop would retry a stale A record 50 times), and the
+        delay between attempts is jittered exponential backoff capped at
+        ~2 s so a thundering herd of reconnecting workers decorrelates
+        instead of hammering the listener in lockstep. ``max_wait_s``
+        bounds the TOTAL time spent sleeping between attempts, so capped
+        backoff cannot turn ``retries`` into minutes against a
+        fast-refusing dead address."""
+        import random
         import socket as pysocket
         import time
 
         lib = _lib()
-        addr = pysocket.gethostbyname(host)
+        delay = max(float(retry_delay_s), 1e-3)
+        slept = 0.0  # only SLEEP counts against max_wait_s: a peer that
+        # drops SYNs already self-limits via timeout_ms per dial, and its
+        # dial time must not eat the retry budget of the dead-fast-refusal
+        # case the cap exists for
+        err: Optional[Exception] = None
+        dials = 0
         for attempt in range(retries):
+            if attempt:
+                if slept >= max_wait_s:
+                    break
+                d = min(delay * (0.5 + random.random()),  # 0.5x..1.5x
+                        max_wait_s - slept)
+                time.sleep(d)
+                slept += d
+                delay = min(delay * 2, 2.0)
+            dials += 1
+            try:
+                addr = pysocket.gethostbyname(host)
+            except OSError as e:  # transient DNS failure: retry like a dial
+                err = e
+                continue
             h = lib.tv_connect(addr.encode(), port, timeout_ms)
             if h:
                 return cls(h, lib)
-            time.sleep(retry_delay_s)
         raise VanError(f"could not connect to {host}:{port} "
-                       f"after {retries} attempts")
+                       f"after {dials} attempts"
+                       + (f" (last resolve error: {err})" if err else ""))
 
     @contextlib.contextmanager
     def _native(self):
@@ -233,11 +397,40 @@ class Channel:
             self.close()  # half-sent frame: the stream is unusable
             raise VanError("send failed: peer closed")
 
+    def send_parts(self, header, chunks) -> None:
+        """Send one frame gathered from ``header`` + ``chunks`` (byte
+        views of live tensors) with NO staging copy: the views go straight
+        to the kernel through ``tv_send_vec`` (sendmsg scatter-gather).
+        Byte-identical on the wire to ``send(assemble(header, chunks))``."""
+        views = [np.frombuffer(header, np.uint8)]
+        views += [np.frombuffer(c, np.uint8) for c in chunks if len(c)]
+        n = len(views)
+        ptrs = (ctypes.c_void_p * n)(*(v.ctypes.data for v in views))
+        lens = (ctypes.c_uint64 * n)(*(v.nbytes for v in views))
+        with self._native() as h:
+            ok = self._lib.tv_send_vec(h, ptrs, lens, n)
+        del views  # pinned the sources for exactly the call's duration
+        if not ok:
+            self.close()  # half-sent frame: the stream is unusable
+            raise VanError("send failed: peer closed")
+        if self.stats is not None:
+            self.stats.record_vec_send(
+                sum(len(c) for c in chunks))  # staging copy avoided
+
+    def poll_readable(self, timeout_ms: int = 0) -> bool:
+        """True when ``recv`` would not block (data pending or EOF)."""
+        with self._native() as h:
+            return bool(self._lib.tv_poll_readable(h, int(timeout_ms)))
+
     def recv(self) -> memoryview:
+        buf = None
         with self._native() as h:
             n = self._lib.tv_recv_size(h)
             if n >= 0:
-                buf = bytearray(n)
+                buf = (self.pool.borrow(n) if self.pool is not None
+                       else None)
+                if buf is None:
+                    buf = bytearray(n)
                 ok = (not n) or self._lib.tv_recv_into(
                     h, (ctypes.c_char * n).from_buffer(buf), n)
         if n < 0:
@@ -248,12 +441,20 @@ class Channel:
             raise VanError("recv failed: peer closed" if n == -1
                            else "recv failed: oversized frame")
         if not ok:
+            if self.pool is not None:
+                self.pool.ret(buf)  # don't strand a borrow on the error path
             self.close()
             raise VanError("recv failed mid-frame: peer closed")
-        return memoryview(buf)
+        # pooled buffers may exceed the frame; the slice's .obj is still
+        # the buffer, so RecvBufferPool.ret(view) finds its way home
+        return memoryview(buf)[:n]
 
     def request(self, payload: bytes) -> memoryview:
         self.send(payload)
+        return self.recv()
+
+    def request_parts(self, header, chunks) -> memoryview:
+        self.send_parts(header, chunks)
         return self.recv()
 
     def shutdown(self) -> None:

@@ -22,12 +22,14 @@
 // the GIL for the duration of each call); handles are opaque pointers.
 
 #include <arpa/inet.h>
+#include <limits.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <cerrno>
 #include <poll.h>
 #include <sys/socket.h>
 #include <sys/time.h>
+#include <sys/uio.h>
 #include <unistd.h>
 
 #include <atomic>
@@ -373,6 +375,145 @@ int tv_send(void* h, const void* buf, uint64_t n) {
   uint64_t len_le = n;  // this ABI is little-endian-host only (x86/ARM)
   if (!write_exact(c->fd, &len_le, sizeof(len_le))) return 0;
   return write_exact(c->fd, buf, n) ? 1 : 0;
+}
+
+// Send one frame gathered from `n` buffers WITHOUT any staging copy: the
+// u64 length prefix plus every buffer goes out through sendmsg(2) scatter-
+// gather iovecs (batched at IOV_MAX, partial writes resumed mid-iovec).
+// The Python side hands live tensor memoryviews straight here — this is
+// what deletes the per-frame staging bytearray of the legacy encode path.
+// Returns 1 on success, 0 on a broken connection.
+int tv_send_vec(void* h, const void** bufs, const uint64_t* lens, int n) {
+  auto* c = static_cast<Conn*>(h);
+  uint64_t total = 0;
+  for (int i = 0; i < n; ++i) total += lens[i];
+  uint64_t len_le = total;  // little-endian-host only, like tv_send
+  std::vector<iovec> iov;
+  iov.reserve((size_t)n + 1);
+  iov.push_back({&len_le, sizeof(len_le)});
+  for (int i = 0; i < n; ++i)
+    if (lens[i]) iov.push_back({const_cast<void*>(bufs[i]), (size_t)lens[i]});
+  size_t idx = 0;
+  while (idx < iov.size()) {
+    size_t cnt = iov.size() - idx;
+    if (cnt > (size_t)IOV_MAX) cnt = (size_t)IOV_MAX;
+    msghdr mh{};
+    mh.msg_iov = &iov[idx];
+    mh.msg_iovlen = cnt;
+    ssize_t r = sendmsg(c->fd, &mh, MSG_NOSIGNAL);
+    if (r <= 0) {
+      if (r < 0 && errno == EINTR) continue;
+      return 0;
+    }
+    while (r > 0 && idx < iov.size()) {
+      if ((size_t)r >= iov[idx].iov_len) {
+        r -= (ssize_t)iov[idx].iov_len;
+        ++idx;
+      } else {
+        iov[idx].iov_base = (char*)iov[idx].iov_base + r;
+        iov[idx].iov_len -= (size_t)r;
+        r = 0;
+      }
+    }
+  }
+  return 1;
+}
+
+// Non-blocking (or bounded) readability probe: 1 when the next tv_recv_size
+// would not block — data pending OR the peer hung up (EOF is "readable").
+// The shm lane's poll loops use this to watch the TCP side for spilled
+// frames and peer death without ever blocking on the socket.
+int tv_poll_readable(void* h, int timeout_ms) {
+  auto* c = static_cast<Conn*>(h);
+  pollfd p{c->fd, POLLIN, 0};
+  int r = poll(&p, 1, timeout_ms);
+  return (r > 0 && (p.revents & (POLLIN | POLLHUP | POLLERR))) ? 1 : 0;
+}
+
+// ---------------------------------------------------------------------------
+// Shared-memory ring primitives (ps_tpu/control/shm_lane.py). The lane's
+// hot path must not run under the Python interpreter lock: ctypes releases
+// the GIL for each of these calls, so frame copies run truly parallel with
+// the peer thread (the same-process worker+server topology of every test
+// and bench here) and the cursor waits burn no interpreter time at all.
+// Cursors are published with release stores and read with acquire loads —
+// the cross-process ordering contract the pure-Python seqlock could only
+// approximate on TSO hardware.
+
+// memcpy with the GIL released (ctypes drops it for the call's duration).
+void tv_memcpy(void* dst, const void* src, uint64_t n) {
+  memcpy(dst, src, n);
+}
+
+// Fault a fresh mapping in NOW (GIL-free), at negotiation time. mode 1:
+// zero-fill (creator — allocates the backing pages); mode 2: rewrite one
+// byte per page in place (attacher — maps the existing pages WITH write
+// access; only safe while no traffic flows, i.e. during negotiation);
+// mode 0: read-touch only. Without this, every first pass around a ring
+// pays a page fault per 4 KiB — an order of magnitude over the copy
+// itself on sandboxed kernels.
+void tv_prefault(void* addr, uint64_t n, int mode) {
+  if (mode == 1) {
+    memset(addr, 0, n);
+    return;
+  }
+  auto* p = static_cast<volatile char*>(addr);
+  if (mode == 2) {
+    for (uint64_t i = 0; i < n; i += 4096) p[i] = p[i];
+    return;
+  }
+  uint64_t sum = 0;
+  for (uint64_t i = 0; i < n; i += 4096) sum += (uint64_t)p[i];
+  (void)sum;
+}
+
+uint64_t tv_load_u64(const void* addr) {
+  return reinterpret_cast<const std::atomic<uint64_t>*>(addr)->load(
+      std::memory_order_acquire);
+}
+
+void tv_store_u64(void* addr, uint64_t v) {
+  reinterpret_cast<std::atomic<uint64_t>*>(addr)->store(
+      v, std::memory_order_release);
+}
+
+// Futex-free adaptive wait until *addr != last or ~timeout_us elapses,
+// in three phases tuned for hostile (sandboxed) kernels as much as bare
+// metal: (1) a brief hot spin catches back-to-back traffic for free;
+// (2) a yield-spin — check + sched_yield — carries the typical multi-MB
+// frame latency (~ms) with wakeup granularity of one yield (µs..tens of
+// µs under gVisor-style sandboxes) while handing the core to the peer's
+// copy; (3) nanosleeps from 0.5 ms doubling to 2 ms, because sleep is
+// the only phase that is truly free and some sandbox kernels round every
+// nanosleep up to ~0.5 ms anyway — idle connections decay here and cost
+// ~nothing. Returns 1 (changed in a spin phase), 2 (changed after
+// sleeping), 0 (timeout — the caller re-checks its closed/peer-death
+// conditions and calls again). GIL-free throughout (ctypes).
+// `skip_spin`: nonzero jumps straight to the sleep phase — the caller
+// passes it after a previous slice already timed out, so long-idle
+// connections pay sleeps only, never re-burning the spin phases.
+int tv_wait_u64(const void* addr, uint64_t last, int timeout_us,
+                int skip_spin) {
+  auto* p = reinterpret_cast<const std::atomic<uint64_t>*>(addr);
+  auto start = Clock::now();
+  auto deadline = start + std::chrono::microseconds(timeout_us);
+  if (!skip_spin) {
+    for (int i = 0; i < 512; ++i)
+      if (p->load(std::memory_order_acquire) != last) return 1;
+    auto yield_until =
+        std::min(deadline, start + std::chrono::microseconds(3000));
+    while (Clock::now() < yield_until) {
+      if (p->load(std::memory_order_acquire) != last) return 1;
+      std::this_thread::yield();
+    }
+  }
+  int64_t ns = 500 * 1000;
+  while (true) {
+    if (p->load(std::memory_order_acquire) != last) return 2;
+    if (Clock::now() >= deadline) return 0;
+    std::this_thread::sleep_for(std::chrono::nanoseconds(ns));
+    ns = std::min<int64_t>(ns * 2, 2 * 1000 * 1000);
+  }
 }
 
 // Read the next frame's size (blocking). Returns -1 on EOF/error, -2 on an

@@ -75,6 +75,65 @@ class TransportStats:
         # instead of a silent drop (satellite of the codec PR)
         self.stale_epochs = 0
         self.stale_epoch_buckets = 0
+        # zero-copy transport lanes (the zero-copy PR): vectored sends
+        # (frames whose tensor bytes skipped the staging bytearray and the
+        # bytes thereby not copied), shm-ring frames vs TCP spills on an
+        # upgraded connection, the shm poll loop's spin-vs-sleep wakeups,
+        # and the receive-buffer pool's hit/miss counts
+        self.vec_frames = 0
+        self.vec_bytes_avoided = 0
+        self.shm_frames = 0
+        self.shm_frame_bytes = 0
+        self.shm_spill_frames = 0
+        self.spin_wakeups = 0
+        self.sleep_wakeups = 0
+        self.pool_hits = 0
+        self.pool_misses = 0
+
+    def record_vec_send(self, nbytes: int) -> None:
+        """One vectored (scatter-gather) send: ``nbytes`` of tensor payload
+        went to the kernel without a staging copy."""
+        with self._lock:
+            self.vec_frames += 1
+            self.vec_bytes_avoided += int(nbytes)
+
+    def record_shm_frame(self, nbytes: int) -> None:
+        """One frame moved through a shared-memory ring (either way)."""
+        with self._lock:
+            self.shm_frames += 1
+            self.shm_frame_bytes += int(nbytes)
+
+    def record_shm_spill(self) -> None:
+        """One frame too large for the ring traveled TCP instead."""
+        with self._lock:
+            self.shm_spill_frames += 1
+
+    def record_wakeup(self, spun: bool) -> None:
+        """One shm poll-loop wakeup: found the frame while spinning
+        (``spun``) or only after backing off to sleep."""
+        with self._lock:
+            if spun:
+                self.spin_wakeups += 1
+            else:
+                self.sleep_wakeups += 1
+
+    def record_pool(self, hit: bool) -> None:
+        """One receive-buffer-pool borrow (reused buffer or fresh alloc)."""
+        with self._lock:
+            if hit:
+                self.pool_hits += 1
+            else:
+                self.pool_misses += 1
+
+    def lane(self) -> str:
+        """Which data-plane lane this endpoint's traffic used: "shm"
+        (rings only), "shm+tcp" (a negotiated shm lane whose oversize
+        frames spilled to TCP — even if EVERY frame spilled), or "tcp"
+        (no shm lane traffic at all)."""
+        with self._lock:
+            if self.shm_spill_frames > 0:
+                return "shm+tcp"
+            return "shm" if self.shm_frames > 0 else "tcp"
 
     def record_codec(self, raw_bytes: int, enc_bytes: int,
                      seconds: float) -> None:
@@ -138,7 +197,12 @@ class TransportStats:
             return (self.buckets, self.bucket_bytes, self.bucket_seconds,
                     self.cycles, self.busy_s, self.blocked_s,
                     self.codec_raw_bytes, self.codec_enc_bytes, self.codec_s,
-                    self.stale_epochs, self.stale_epoch_buckets)
+                    self.stale_epochs, self.stale_epoch_buckets,
+                    self.vec_frames, self.vec_bytes_avoided,
+                    self.shm_frames, self.shm_frame_bytes,
+                    self.shm_spill_frames,
+                    self.spin_wakeups, self.sleep_wakeups,
+                    self.pool_hits, self.pool_misses)
 
     def summary(self, since: Optional[tuple] = None) -> Dict[str, float]:
         now = self.snapshot()
@@ -166,6 +230,23 @@ class TransportStats:
         if d[9] > 0:
             out["stale_epochs"] = int(d[9])
             out["stale_epoch_buckets"] = int(d[10])
+        # zero-copy lanes: only reported once the paths are live, so
+        # legacy summaries (and snapshots from before the fields existed)
+        # are unchanged
+        if d[12] > 0:
+            out["staging_copy_bytes_avoided"] = int(d[12])
+        if d[13] > 0 or d[15] > 0:
+            # lane tag from the INTERVAL's deltas, not lifetime counters —
+            # one early spill must not mislabel every later interval
+            out["lane"] = "shm+tcp" if d[15] > 0 else "shm"
+            out["shm_frames"] = int(d[13])
+            out["shm_gb"] = round(d[14] / 1e9, 4)
+            if d[15] > 0:
+                out["shm_spill_frames"] = int(d[15])
+            out["spin_wakeups"] = int(d[16])
+            out["sleep_wakeups"] = int(d[17])
+        if d[18] + d[19] > 0:
+            out["recv_pool_hit_rate"] = round(d[18] / (d[18] + d[19]), 4)
         return out
 
 

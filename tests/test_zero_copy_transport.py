@@ -1,0 +1,417 @@
+"""Zero-copy transport PR: vectored scatter-gather sends + the same-host
+shared-memory lane (ps_tpu/control/shm_lane.py).
+
+Four families:
+
+1. frame parity — the vectored ``encode_parts``/``encode_chunks_parts``
+   forms assemble byte-identically to the legacy ``encode``/
+   ``encode_chunks`` frames across dtypes, zero-size, scalar,
+   non-contiguous and codec-compressed payloads, AND produce identical
+   bytes on a real wire;
+2. shm-lane faults — negotiation failure (cross-host boot id) falls back
+   to TCP with identical results, ring wrap-around survives many cycles,
+   oversize frames spill to TCP, and a peer death mid-frame surfaces as
+   the same typed failure the TCP lane raises (no hang, no data loss);
+3. satellites — per-attempt DNS re-resolution + capped backoff in
+   ``Channel.connect``, and the receive-buffer pool's borrow/return + hit
+   rate;
+4. MNIST-MLP loss parity over the shm lane vs TCP (same seed, same data
+   order → identical loss trajectory).
+"""
+
+import os
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import ps_tpu as ps
+from ps_tpu.backends.remote_async import (
+    ServerFailureError,
+    connect_async,
+    serve_async,
+)
+from ps_tpu.control import shm_lane
+from ps_tpu.control import tensor_van as tv
+
+
+def _dense_job(params, num_workers=2):
+    ps.init(backend="tpu", mode="async", num_workers=num_workers)
+    store = ps.KVStore(optimizer="sgd", learning_rate=0.1, mode="async")
+    store.init(params)
+    svc = serve_async(store, bind="127.0.0.1")
+    return store, svc, f"127.0.0.1:{svc.port}"
+
+
+# -- 1. frame parity ----------------------------------------------------------
+
+
+PARITY_TREES = [
+    {"f32": np.arange(12, dtype=np.float32).reshape(3, 4)},
+    {"f16": np.arange(6, dtype=np.float16), "i64": np.arange(4)},
+    {"zero": np.zeros((0, 8), np.float32), "x": np.ones((3,), np.int32)},
+    {"scalar": np.float32(3.5)},
+    {"noncontig": np.arange(40, dtype=np.float64).reshape(5, 8)[::2, 1::3]},
+    {"u8": np.arange(255, dtype=np.uint8), "bool": np.ones((7,), np.bool_)},
+    {},  # empty tree (HELLO-shaped frames)
+]
+
+
+@pytest.mark.parametrize("tree", PARITY_TREES,
+                         ids=[",".join(sorted(t)) or "empty"
+                              for t in PARITY_TREES])
+def test_encode_parts_assembles_byte_identical(tree):
+    extra = {"version": 7, "enc": ["a"], "nested": {"x": [1, 2]}}
+    legacy = tv.encode(tv.PUSH, 3, tree, extra=extra)
+    header, chunks = tv.encode_parts(tv.PUSH, 3, tree, extra=extra)
+    assert bytes(legacy) == bytes(tv.assemble(header, chunks))
+    kind, worker, tensors, e = tv.decode(memoryview(legacy))
+    assert kind == tv.PUSH and worker == 3 and e == extra
+    for k, v in tree.items():
+        np.testing.assert_array_equal(tensors[k],
+                                      np.ascontiguousarray(np.asarray(v)))
+
+
+def test_encode_chunks_parts_byte_identical():
+    chunks = [memoryview(np.arange(64, dtype=np.uint8)),
+              b"", b"tail-bytes",
+              memoryview(np.ones((4, 4), np.float32)).cast("B")]
+    extra = {"bucket": 1, "nbuckets": 3, "slices": [["k", "<f4", [4, 4], 0, 64]]}
+    legacy = tv.encode_chunks(tv.BUCKET_PUSH, 9, chunks, extra)
+    header, parts = tv.encode_chunks_parts(tv.BUCKET_PUSH, 9, chunks, extra)
+    assert bytes(legacy) == bytes(tv.assemble(header, parts))
+
+
+def test_compressed_payload_parity():
+    """Codec-packed uint8 frames ride the parts path byte-identically."""
+    from ps_tpu.compress import CompressPolicy, GradCompressor
+
+    comp = GradCompressor(CompressPolicy.from_spec(
+        {"codec": "int8", "min_bytes": 0, "seed": 1}))
+    tree, enc = comp.encode_tree(
+        {"w": np.random.default_rng(0).normal(0, 1, (64, 64)).astype(np.float32)})
+    assert enc  # the codec actually packed something
+    extra = {"enc": enc}
+    legacy = tv.encode(tv.PUSH, 0, tree, extra=extra)
+    assert bytes(legacy) == bytes(tv.assemble(
+        *tv.encode_parts(tv.PUSH, 0, tree, extra=extra)))
+
+
+def test_vectored_wire_frame_identical_to_legacy():
+    """send_parts puts the SAME bytes on a real socket as send(encode())."""
+    tree = {"a": np.arange(1000, dtype=np.float32),
+            "empty": np.zeros((0,), np.int32)}
+    legacy = tv.encode(tv.PUSH_PULL, 5, tree, extra={"v": 1})
+    header, chunks = tv.encode_parts(tv.PUSH_PULL, 5, tree, extra={"v": 1})
+    got = {}
+    with tv.Listener(bind="127.0.0.1") as lst:
+        def serve():
+            ch = lst.accept(5000)
+            got["vec"] = bytes(ch.recv())
+            got["legacy"] = bytes(ch.recv())
+            ch.send(b"done")
+            got["ch"] = ch
+        t = threading.Thread(target=serve)
+        t.start()
+        c = tv.Channel.connect("127.0.0.1", lst.port)
+        c.send_parts(header, chunks)
+        c.send(legacy)
+        assert bytes(c.recv()) == b"done"
+        t.join(5)
+        c.close()
+        got["ch"].close()
+    assert got["vec"] == bytes(legacy) == got["legacy"]
+
+
+def test_writev_off_matches_writev_on_results():
+    """Two separate single-worker jobs — one vectored, one staged — land
+    bit-identical engine params (a corrupt vectored push/pull would
+    diverge from the staged ground truth, not merely crash)."""
+    params = {"w": jnp.ones((64, 64)), "b": jnp.zeros((16,))}
+    grads = {"w": jnp.full((64, 64), 0.01), "b": jnp.full((16,), 0.01)}
+    finals = {}
+    for writev in (True, False):
+        store, svc, uri = _dense_job(params, num_workers=1)
+        try:
+            w = connect_async(uri, 0, params, writev=writev)
+            for _ in range(3):
+                pulled = w.push_pull(grads)
+            # what the worker decoded == what the engine actually holds
+            np.testing.assert_array_equal(np.asarray(pulled["w"]),
+                                          np.asarray(store.params()["w"]))
+            finals[writev] = np.asarray(store.params()["w"])
+            w.close()
+        finally:
+            svc.stop()
+            ps.shutdown()
+    np.testing.assert_array_equal(finals[True], finals[False])
+
+
+# -- 2. shm lane --------------------------------------------------------------
+
+
+def test_shm_negotiation_failure_falls_back_to_tcp(monkeypatch):
+    """A cross-host-shaped boot-id mismatch keeps plain TCP with
+    identical results (acceptance: graceful degradation, covered by
+    tests)."""
+    monkeypatch.setenv("PS_SHM_BOOT_ID", "some-other-host-boot-id")
+    params = {"w": jnp.ones((32, 32))}
+    grads = {"w": jnp.full((32, 32), 0.1)}
+    store, svc, uri = _dense_job(params, num_workers=1)
+    try:
+        w = connect_async(uri, 0, params, shm=True)
+        assert isinstance(w._chs[0], tv.Channel)  # NOT upgraded
+        assert w.transport.lane() == "tcp"
+        p = w.push_pull(grads)
+        np.testing.assert_allclose(np.asarray(p["w"]),
+                                   np.asarray(params["w"]) - 0.1 * 0.1,
+                                   rtol=1e-6)
+        w.close()
+    finally:
+        svc.stop()
+        ps.shutdown()
+
+
+def test_shm_lane_parity_with_tcp_and_stats():
+    """Two separate single-worker jobs — one on the shm lane, one on TCP
+    — land bit-identical engine params (corruption on the rings would
+    diverge from the TCP ground truth, not merely crash); the shm
+    worker's stats carry the lane tag + wakeup counters."""
+    params = {"w": jnp.ones((128, 128)), "b": jnp.zeros((128,))}
+    grads = {"w": jnp.full((128, 128), 0.01), "b": jnp.full((128,), 0.01)}
+    finals = {}
+    for shm in (False, True):
+        store, svc, uri = _dense_job(params, num_workers=1)
+        try:
+            w = connect_async(uri, 0, params, bucket_bytes=1 << 14,
+                              shm=shm, shm_bytes=1 << 20)
+            if shm:
+                assert isinstance(w._chs[0], shm_lane.ShmChannel)
+            for _ in range(3):
+                pulled = w.push_pull(grads)
+            np.testing.assert_array_equal(np.asarray(pulled["w"]),
+                                          np.asarray(store.params()["w"]))
+            finals[shm] = np.asarray(store.params()["w"])
+            if shm:
+                s = w.transport.summary()
+                assert s["lane"].startswith("shm")
+                assert s["shm_frames"] > 0
+                assert s["spin_wakeups"] + s["sleep_wakeups"] > 0
+                assert s["staging_copy_bytes_avoided"] > 0
+            w.close()
+        finally:
+            svc.stop()
+            ps.shutdown()
+    np.testing.assert_array_equal(finals[False], finals[True])
+
+
+def test_shm_ring_wraparound_many_cycles():
+    """A ring much smaller than the cumulative traffic wraps many times
+    without corrupting frames."""
+    params = {"w": jnp.ones((64, 64))}  # 16 KiB tree
+    grads = {"w": jnp.full((64, 64), 1e-3)}
+    store, svc, uri = _dense_job(params, num_workers=1)
+    try:
+        # 128 KiB rings; 60 cycles × ~32 KiB/cycle ≈ 15 wraps
+        w = connect_async(uri, 0, params, shm=True, shm_bytes=1 << 17)
+        assert isinstance(w._chs[0], shm_lane.ShmChannel)
+        for _ in range(60):
+            p = w.push_pull(grads)
+        assert w.transport.shm_frames >= 120
+        expect = np.asarray(store.params()["w"])
+        np.testing.assert_array_equal(np.asarray(p["w"]), expect)
+        w.close()
+    finally:
+        svc.stop()
+        ps.shutdown()
+
+
+def test_oversize_frame_spills_to_tcp():
+    """A frame bigger than half the ring travels TCP — transparently, on
+    the same connection, with correct results."""
+    params = {"w": jnp.ones((256, 256))}  # 256 KiB frames
+    grads = {"w": jnp.full((256, 256), 0.1)}
+    store, svc, uri = _dense_job(params, num_workers=1)
+    w = None
+    try:
+        w = connect_async(uri, 0, params, shm=True, shm_bytes=1 << 17)
+        assert isinstance(w._chs[0], shm_lane.ShmChannel)
+        p = w.push_pull(grads)
+        assert w.transport.shm_spill_frames > 0
+        assert w.transport.lane() == "shm+tcp"
+        np.testing.assert_allclose(np.asarray(p["w"]),
+                                   np.asarray(params["w"]) - 0.1 * 0.1,
+                                   rtol=1e-6)
+    finally:
+        if w is not None:
+            w.close()
+        svc.stop()
+        ps.shutdown()
+
+
+def test_shm_peer_death_mid_frame_raises_typed_failure():
+    """Server dies while the worker is mid-cycle on the shm lane: the
+    worker gets the SAME typed ServerFailureError the TCP lane raises —
+    within bounded time (no spin-forever), and a reconnect to a fresh
+    server works over TCP or shm."""
+    params = {"w": jnp.ones((64, 64))}
+    grads = {"w": jnp.full((64, 64), 0.1)}
+    store, svc, uri = _dense_job(params, num_workers=1)
+    w = None
+    try:
+        w = connect_async(uri, 0, params, shm=True, shm_bytes=1 << 18)
+        assert isinstance(w._chs[0], shm_lane.ShmChannel)
+        w.push_pull(grads)
+        svc.stop(grace=0.5)
+        t0 = time.monotonic()
+        with pytest.raises(ServerFailureError):
+            for _ in range(4):  # first call may have raced the drain
+                w.push_pull(grads)
+        assert time.monotonic() - t0 < 30.0
+    finally:
+        if w is not None:
+            try:
+                w.close()
+            except Exception:
+                pass
+        svc.stop()
+        ps.shutdown()
+
+
+def test_shm_segments_cleaned_up_after_close():
+    before = {f for f in os.listdir("/dev/shm") if f.startswith("psvan")}
+    params = {"w": jnp.ones((16, 16))}
+    store, svc, uri = _dense_job(params, num_workers=1)
+    try:
+        w = connect_async(uri, 0, params, bucket_bytes=1 << 12,
+                          shm=True, shm_bytes=1 << 17)
+        assert isinstance(w._chs[0], shm_lane.ShmChannel)
+        w.pull_all()
+        w.close()
+        leftovers = [f for f in os.listdir("/dev/shm")
+                     if f.startswith("psvan") and f not in before]
+        assert leftovers == []
+    finally:
+        svc.stop()
+        ps.shutdown()
+
+
+# -- 3. satellites ------------------------------------------------------------
+
+
+def test_connect_re_resolves_every_attempt(monkeypatch):
+    import socket as pysocket
+
+    calls = []
+    real = pysocket.gethostbyname
+    monkeypatch.setattr(pysocket, "gethostbyname",
+                        lambda h: (calls.append(h), real(h))[1])
+    t0 = time.monotonic()
+    with pytest.raises(tv.VanError):
+        tv.Channel.connect("127.0.0.1", 1, timeout_ms=200, retries=4,
+                           retry_delay_s=0.05)
+    dt = time.monotonic() - t0
+    assert len(calls) == 4  # one resolution PER attempt, not one total
+    # jittered exponential backoff: more than a flat 3×0.05s, well under
+    # the old fixed-delay pathology's scale, capped at ~2s per gap
+    assert 0.05 < dt < 5.0
+
+
+def test_connect_backoff_caps_at_two_seconds(monkeypatch):
+    sleeps = []
+    monkeypatch.setattr(time, "sleep", lambda s: sleeps.append(s))
+    with pytest.raises(tv.VanError):
+        tv.Channel.connect("127.0.0.1", 1, timeout_ms=50, retries=10,
+                           retry_delay_s=0.1)
+    assert len(sleeps) == 9  # no sleep before the first attempt
+    # jitter is 0.5x..1.5x of the current delay; the delay itself caps at 2s
+    assert max(sleeps) <= 2.0 * 1.5 + 1e-9
+    assert sleeps[0] < sleeps[-1]  # it actually backs off
+
+
+def test_recv_buffer_pool_borrow_return_and_hit_rate():
+    from ps_tpu.utils.metrics import TransportStats
+
+    stats = TransportStats()
+    pool = tv.RecvBufferPool(min_bytes=1 << 10, max_per_class=2, stats=stats)
+    assert pool.borrow(16) is None          # under the floor: no pooling
+    b1 = pool.borrow(1 << 12)
+    assert len(b1) == 1 << 12
+    pool.ret(b1)
+    b2 = pool.borrow(3000)                  # same power-of-two class
+    assert b2 is b1                         # reused, not reallocated
+    pool.ret(memoryview(b2)[:3000])         # return via the recv view form
+    assert stats.pool_hits == 1 and stats.pool_misses == 1
+    # double-return / foreign buffers are ignored
+    pool.ret(b2)
+    pool.ret(bytearray(8))
+    b3, b4, b5 = pool.borrow(1 << 12), pool.borrow(1 << 12), pool.borrow(1 << 12)
+    for b in (b3, b4, b5):
+        pool.ret(b)  # class cap is 2: the third return is dropped
+    assert len(pool._free[12]) == 2
+
+
+def test_pool_hit_rate_reported_on_hot_pulls():
+    # bucket frames must clear the pool's 64 KiB floor to be pooled:
+    # 256 KiB tree in 128 KiB buckets
+    params = {"w": jnp.ones((256, 256))}
+    grads = {"w": jnp.full((256, 256), 1e-3)}
+    store, svc, uri = _dense_job(params, num_workers=1)
+    try:
+        w = connect_async(uri, 0, params, bucket_bytes=1 << 17, pool_size=2)
+        for _ in range(4):
+            w.push_pull(grads)
+        s = w.transport.summary()
+        assert s.get("recv_pool_hit_rate", 0) > 0
+        w.close()
+    finally:
+        svc.stop()
+        ps.shutdown()
+
+
+# -- 4. MNIST-MLP loss parity over the shm lane -------------------------------
+
+
+def test_mnist_mlp_loss_parity_shm_vs_tcp():
+    """Identical seed + data order through two separate single-worker
+    jobs — one on the shm lane, one on TCP — produce identical losses
+    (the lane changes the bytes' route, never their values)."""
+    from ps_tpu.data.synthetic import mnist_batches
+    from ps_tpu.models.mlp import MLP, cross_entropy_loss
+
+    model = MLP(hidden=32)
+    params0 = model.init(jax.random.key(0),
+                         jnp.zeros((1, 28, 28, 1)))["params"]
+
+    def loss_fn(p, batch):
+        images, labels = batch
+        return cross_entropy_loss(model.apply({"params": p}, images), labels)
+
+    def run(shm: bool):
+        ps.init(backend="tpu", mode="async", num_workers=1, dc_lambda=0.04)
+        store = ps.KVStore(optimizer="sgd", learning_rate=0.1, mode="async")
+        store.init(params0)
+        svc = serve_async(store, bind="127.0.0.1")
+        w = connect_async(f"127.0.0.1:{svc.port}", 0, params0,
+                          bucket_bytes=1 << 14, shm=shm,
+                          shm_bytes=1 << 20)
+        if shm:
+            assert isinstance(w._chs[0], shm_lane.ShmChannel)
+        run_step = w.make_async_step(loss_fn)
+        losses = []
+        for batch in mnist_batches(32, steps=8):
+            images, labels = batch
+            losses.append(float(run_step(
+                (jnp.asarray(images), jnp.asarray(labels)))))
+        w.close()
+        svc.stop()
+        ps.shutdown()
+        return losses
+
+    tcp = run(False)
+    shm = run(True)
+    assert tcp == shm
+    assert tcp[-1] < tcp[0]  # it actually trained

@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Transport-lane smoke (<60 s): runs `bench.py --model transport --quick`
+# on the CPU backend and asserts that BOTH the bucketed-TCP lane and the
+# same-host shared-memory lane actually move data, printing the per-lane
+# GB/s. Referenced from the README next to tools/ci_tier1.sh.
+#
+# Usage: tools/ci_bench_smoke.sh   (from the repo root)
+set -euo pipefail
+out=$(timeout -k 10 120 env JAX_PLATFORMS=cpu python bench.py --model transport --quick 2>/dev/null | tail -1)
+python - "$out" <<'EOF'
+import json
+import sys
+
+det = json.loads(sys.argv[1])["detail"]
+lanes = {
+    "serial (writev)": det["serial_gbps"],
+    "serial (staged)": det["serial_staged_gbps"],
+    "bucketed tcp": det["bucketed_gbps"],
+    "shm (full cycle)": det["shm_gbps"],
+    "wire bucketed tcp": det["wire_bucketed_tcp_gbps"],
+    "wire shm": det["wire_shm_gbps"],
+}
+for name, gbps in lanes.items():
+    print(f"  {name:18s} {gbps:8.3f} GB/s")
+assert det["bucketed_gbps"] and det["bucketed_gbps"] > 0, \
+    "bucketed-TCP lane moved no data"
+assert det["shm_gbps"] and det["shm_gbps"] > 0, "shm lane moved no data"
+assert det["shm_lane_stats"]["negotiated"], "shm lane failed to negotiate"
+assert det["shm_lane_stats"]["shm_frames"] > 0, \
+    "shm lane negotiated but no frames rode the rings"
+print(f"  shm/tcp wire speedup: {det['shm_speedup_vs_bucketed_tcp']}x")
+print("transport smoke OK")
+EOF
