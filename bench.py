@@ -634,6 +634,147 @@ def bench_transport(args, retried: bool):
     }))
 
 
+# -- failover -----------------------------------------------------------------
+
+
+def bench_failover(args, retried: bool):
+    """Shard replication & live failover (ps_tpu/replica): steady-state
+    replication overhead and kill-to-first-successful-push latency.
+
+    Three steady-state legs on the same tree/hardware — unreplicated
+    baseline, sync-ack pair (push replies wait for the backup), async-ack
+    pair (bounded lag) — then the drill: the primary is killed abruptly
+    (listener + every socket severed, exactly what SIGKILL leaves), its
+    heartbeat stops, the backup's PromotionWatch declares it dead after
+    the horizon and promotes, and the worker's next push_pull rides its
+    replica set to the new primary. The headline number is wall clock from
+    the kill to that push's return — detection + promotion + re-route +
+    apply. Runs anywhere (pure host path; --quick for the <60 s CI
+    smoke)."""
+    import numpy as np
+
+    from ps_tpu.backends.remote_async import AsyncPSService, connect_async
+    from ps_tpu.control.heartbeat import HeartbeatClient
+    from ps_tpu.replica import PromotionWatch
+
+    if args.quick:
+        args.transport_mb = min(args.transport_mb, 8.0)
+        args.steps = min(args.steps, 4)
+    cycles = max(args.steps, 2)
+    mb = min(args.transport_mb, 32.0)
+    rng = np.random.default_rng(0)
+    tree = {"embed/word": rng.normal(0, 1, (30522, 16)).astype(np.float32)}
+    i = 0
+    while sum(a.nbytes for a in tree.values()) < mb * 1e6:
+        tree[f"layer{i // 4:02d}/block{i % 4}"] = rng.normal(
+            0, 1, (512, 512)).astype(np.float32)
+        i += 1
+    nbytes = sum(a.nbytes for a in tree.values())
+    grads = {k: rng.normal(0, 1e-3, v.shape).astype(np.float32)
+             for k, v in tree.items()}
+
+    ps.init(backend="tpu", mode="async", num_workers=4)
+
+    def mkstore():
+        st = ps.KVStore(optimizer="sgd", learning_rate=0.01, mode="async")
+        st.init(tree)
+        return st
+
+    def run_cycles(w, n):
+        t0 = time.monotonic()
+        for _ in range(n):
+            w.push_pull(grads)
+        return n / max(time.monotonic() - t0, 1e-9)
+
+    # leg A: unreplicated baseline
+    prim_a = AsyncPSService(mkstore(), bind="127.0.0.1")
+    wa = connect_async(f"127.0.0.1:{prim_a.port}", 0, tree)
+    wa.pull_all()
+    run_cycles(wa, 1)
+    baseline_cps = max(run_cycles(wa, cycles) for _ in range(2))
+    wa.close()
+    prim_a.stop()
+
+    def replicated_leg(ack, worker_id):
+        prim = AsyncPSService(mkstore(), bind="127.0.0.1")
+        back = AsyncPSService(mkstore(), bind="127.0.0.1", backup=True)
+        sess = prim.attach_backup("127.0.0.1", back.port, ack=ack)
+        w = connect_async(f"127.0.0.1:{prim.port}|127.0.0.1:{back.port}",
+                          worker_id, tree, failover_timeout=30.0)
+        w.pull_all()
+        run_cycles(w, 1)
+        cps = max(run_cycles(w, cycles) for _ in range(2))
+        return prim, back, sess, w, cps
+
+    # leg B: sync ack (the drill rides this pair afterwards)
+    prim, back, sess, wb, sync_cps = replicated_leg("sync", 1)
+    sync_lag = sess.lag
+
+    # leg C: async ack
+    prim_c, back_c, sess_c, wc, async_cps = replicated_leg("async", 2)
+    async_lag_max = sess_c.log.next_seq - 1 - sess_c.acked_seq
+    wc.close()
+    prim_c.stop()
+    back_c.stop()
+
+    # the drill: heartbeat-triggered promotion on abrupt primary death
+    hb_timeout_ms = 400
+    watch = PromotionWatch(back, primary_id=1, timeout_ms=hb_timeout_ms)
+    hb = HeartbeatClient("127.0.0.1", watch.port, node_id=1, interval_ms=50)
+    watch.wait_for_primary()
+    t_kill = time.monotonic()
+    prim.kill()   # sever everything NOW — what SIGKILL leaves behind
+    hb.close()    # the dead process stops beating (no goodbye)
+    wb.push_pull(grads)  # rides the replica set through the promotion
+    kill_to_push_s = time.monotonic() - t_kill
+    promote_reason = back.promote_reason
+    promotion_s = back.promotion_s
+    failover_s = wb.transport.failover_s
+    watch.close()
+    wb.close()
+    back.stop()
+    ps.shutdown()
+
+    print(json.dumps({
+        "metric": "failover_kill_to_first_push_s",
+        "value": round(kill_to_push_s, 3),
+        "unit": "s",
+        "vs_baseline": None,
+        "detail": {
+            "tree_mb": round(nbytes / 1e6, 1),
+            "cycles": cycles,
+            "retried": retried,
+            "baseline_cycles_per_s": round(baseline_cps, 2),
+            "sync_repl_cycles_per_s": round(sync_cps, 2),
+            "async_repl_cycles_per_s": round(async_cps, 2),
+            "sync_overhead_x": round(baseline_cps / sync_cps, 3)
+            if sync_cps else None,
+            "async_overhead_x": round(baseline_cps / async_cps, 3)
+            if async_cps else None,
+            "sync_lag_after_leg": sync_lag,
+            "async_lag_seen": int(async_lag_max),
+            "heartbeat_timeout_ms": hb_timeout_ms,
+            "promote_reason": promote_reason,
+            "promotion_s": promotion_s,
+            "worker_failover_s": round(failover_s, 4),
+            "kill_to_first_push_s": round(kill_to_push_s, 3),
+            "note": (
+                "loopback van, serial push_pull on one dense async shard; "
+                "sync/async legs replicate every commit to a warm backup "
+                "(ps_tpu/replica) — overhead_x is the steady-state cost "
+                "of replication vs the unreplicated baseline (sync pays "
+                "one backup round trip per commit, async hides it inside "
+                "the window); the drill severs the primary's sockets and "
+                "heartbeat (SIGKILL-equivalent), the backup's "
+                "PromotionWatch promotes on the heartbeat timeout, and "
+                "kill_to_first_push_s is wall clock from the kill to the "
+                "worker's next successful push_pull (detection + "
+                "promotion + re-route + apply)"
+            ),
+        },
+    }))
+
+
 # -- widedeep -----------------------------------------------------------------
 
 
@@ -733,7 +874,8 @@ def bench_widedeep(args, retried: bool):
 def main(argv=None, retried: bool = False):
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="resnet",
-                    choices=["resnet", "bert", "widedeep", "transport"])
+                    choices=["resnet", "bert", "widedeep", "transport",
+                             "failover"])
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--transport-mb", type=float, default=96.0,
                     help="(transport) parameter-tree size for the van "
@@ -774,13 +916,15 @@ def main(argv=None, retried: bool = False):
     args = ap.parse_args(argv)
     if args.per_chip_batch is None:
         args.per_chip_batch = {"resnet": 256, "bert": 128,
-                               "widedeep": 4096, "transport": 0}[args.model]
+                               "widedeep": 4096, "transport": 0,
+                               "failover": 0}[args.model]
 
     if ps.is_initialized():  # retry path: reset the runtime
         ps.shutdown()
     {"resnet": bench_resnet, "bert": bench_bert,
      "widedeep": bench_widedeep,
-     "transport": bench_transport}[args.model](args, retried)
+     "transport": bench_transport,
+     "failover": bench_failover}[args.model](args, retried)
 
 
 def _is_transport_error(e: BaseException) -> bool:

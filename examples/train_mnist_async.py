@@ -27,6 +27,16 @@ route per-subtree pushes/pulls to the owners):
         --shard 1 --num-shards 2 --num-workers 2 --steps 60
     python examples/train_mnist_async.py --role worker \
         --server localhost:7077,localhost:7078 --worker-id 0 --steps 30
+
+Replicated shard with live failover (README "Replication & failover" —
+kill the primary mid-run; the backup promotes on the heartbeat timeout and
+workers ride straight through):
+    python examples/train_mnist_async.py --role server --port 7078 \
+        --backup --watch-port 7979 --num-workers 1
+    python examples/train_mnist_async.py --role server --port 7077 \
+        --replicate-to localhost:7078 --beat localhost:7979 --num-workers 1
+    python examples/train_mnist_async.py --role worker \
+        --server "localhost:7077|localhost:7078" --worker-id 0 --steps 60
 """
 
 from __future__ import annotations
@@ -118,6 +128,33 @@ def main():
     ap.add_argument("--num-shards", type=int, default=cfg.num_shards,
                     help="server: total servers in the key partition "
                          "(or env PS_NUM_SHARDS / DMLC_NUM_SERVER)")
+    # shard replication & live failover (README "Replication & failover"):
+    # run a second server with --backup --watch-port W; start the primary
+    # with --replicate-to backup:port --beat backup:W; point workers at
+    # the replica set "primary:port|backup:port" — killing the primary
+    # mid-run promotes the backup and the workers ride straight through
+    ap.add_argument("--backup", action="store_true",
+                    help="server: start in backup role — follow a "
+                         "primary's replication stream, refuse worker "
+                         "traffic until promoted")
+    ap.add_argument("--watch-port", type=int, default=0,
+                    help="backup: heartbeat port the PRIMARY must beat "
+                         "(--beat); the backup promotes itself when the "
+                         "beats stop (0 = no promotion watch)")
+    ap.add_argument("--replicate-to", default=None,
+                    help="primary: host:port of this shard's backup "
+                         "server (attached before workers are admitted)")
+    ap.add_argument("--replica-ack", default=cfg.replica_ack,
+                    choices=["sync", "async"],
+                    help="primary: sync = replies wait for the backup's "
+                         "ack (bitwise promotion); async = bounded lag "
+                         "(env PS_REPLICA_ACK)")
+    ap.add_argument("--replica-window", type=int, default=cfg.replica_window,
+                    help="primary: max commits the backup may trail "
+                         "(env PS_REPLICA_WINDOW)")
+    ap.add_argument("--beat", default=None,
+                    help="primary: host:port of the backup's promotion "
+                         "watch to heartbeat")
     args = ap.parse_args()
     params, loss_fn = build(args.seed)
 
@@ -189,20 +226,54 @@ def main():
         store.init(params)
 
     if args.role == "server":
+        import time
+
         svc = ps.serve_async(store, port=args.port, bind=args.bind,
-                             shard=args.shard, num_shards=args.num_shards)
+                             shard=args.shard, num_shards=args.num_shards,
+                             backup=args.backup)
         shard_note = ("" if args.num_shards is None else
                       f", shard {args.shard}/{args.num_shards}")
-        print(f"async PS server on port {svc.port} "
-              f"({args.num_workers} workers expected{shard_note})")
+        watch = hb = None
+        if args.backup:
+            if args.watch_port:
+                watch = ps.PromotionWatch(svc, primary_id=1,
+                                          port=args.watch_port,
+                                          bind=args.bind)
+            print(f"async PS BACKUP on port {svc.port}{shard_note} — "
+                  f"following the primary"
+                  + (f", promotion watch on :{watch.port}" if watch else ""),
+                  flush=True)
+            while svc.role == "backup":  # until promoted (or Ctrl-C)
+                time.sleep(0.1)
+            print(f"promoted to primary (reason={svc.promote_reason}, "
+                  f"epoch {svc.epoch}) — now serving workers", flush=True)
+        else:
+            if args.replicate_to:
+                host, port = args.replicate_to.rsplit(":", 1)
+                svc.attach_backup(host, int(port), ack=args.replica_ack,
+                                  window=args.replica_window)
+            if args.beat:
+                from ps_tpu.control.heartbeat import HeartbeatClient
+
+                host, port = args.beat.rsplit(":", 1)
+                hb = HeartbeatClient(host, int(port), node_id=1)
+            print(f"async PS server on port {svc.port} "
+                  f"({args.num_workers} workers expected{shard_note})"
+                  + (f", replicating to {args.replicate_to} "
+                     f"[{args.replica_ack}]" if args.replicate_to else ""),
+                  flush=True)
         # quiesce on worker goodbyes, not push counts: a worker SHUTDOWNs
         # only after its last reply arrived, so stop() cannot race a reply
         # (the r4 flake — see backends/van_service.py)
         svc.wait_for_goodbyes(args.num_workers)
         hist = dict(store._engine.staleness_hist)
-        print(f"served {len(svc.apply_log)} pushes, "
+        print(f"served {svc.apply_log.total} pushes, "
               f"final version {store._engine.version}, "
               f"staleness histogram {dict(sorted(hist.items()))}")
+        if watch is not None:
+            watch.close()
+        if hb is not None:
+            hb.close(goodbye=True)  # planned leave: peers see 'left'
         svc.stop()
         ps.shutdown()
         return

@@ -48,6 +48,8 @@ from ps_tpu.backends.remote_sparse import (
 from ps_tpu import checkpoint
 from ps_tpu import compress
 from ps_tpu import optim
+from ps_tpu import replica
+from ps_tpu.replica import PromotionWatch
 from ps_tpu.data.files import file_batches, write_dataset
 from ps_tpu.ops import flash_attention
 
@@ -72,6 +74,8 @@ __all__ = [
     "checkpoint",
     "compress",
     "optim",
+    "replica",
+    "PromotionWatch",
     "file_batches",
     "write_dataset",
     "flash_attention",

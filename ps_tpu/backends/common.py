@@ -21,7 +21,41 @@ from ps_tpu.optim.dc import delay_compensate
 from ps_tpu.utils.metrics import TransportStats
 
 class ServerFailureError(RuntimeError):
-    """A remote PS server died mid-job (its connection failed)."""
+    """A remote PS server died mid-job (its connection failed).
+
+    ``server`` (when known) is the failed server's index into the worker's
+    address list — what the failover loop re-routes."""
+
+    def __init__(self, message: str, server: Optional[int] = None):
+        super().__init__(message)
+        self.server = server
+
+
+class BackupNotServing(Exception):
+    """A replica answered HELLO but is an unpromoted backup — retryable
+    (the failover loop waits out the promotion)."""
+
+
+class ReplicaRejected(Exception):
+    """A replica answered HELLO but failed validation (stale epoch /
+    mismatched topology) — skip it, keep cycling the set."""
+
+
+def parse_replica_uri(uri: str):
+    """``"h0:p0|b0:q0,h1:p1|b1:q1"`` → ``(primaries, replica_sets)``.
+
+    Commas separate shards (as everywhere); ``|`` separates the members of
+    one shard's replica set, preferred (primary) first. A plain
+    ``host:port`` list parses to singleton sets — no failover."""
+    primaries, sets = [], []
+    for part in uri.split(","):
+        cands = []
+        for member in part.strip().split("|"):
+            host, port = member.strip().rsplit(":", 1)
+            cands.append((host, int(port)))
+        primaries.append(cands[0])
+        sets.append(cands)
+    return primaries, sets
 
 
 #: Default fusion-bucket size for the pipelined transport. ~4 MiB is the
@@ -349,8 +383,17 @@ class BucketedTransportMixin:
         # incarnation nonce, sent with every push bucket: a restarted (or
         # reconnected) worker reuses epoch NUMBERS from zero, so the server
         # must never complete a staged epoch of a dead incarnation with
-        # buckets from a new one — the nonce makes the two distinguishable
+        # buckets from a new one — the nonce makes the two distinguishable.
+        # The (nonce, push-seq) pair is also the dedup token: servers skip
+        # a push whose seq they already applied for this incarnation, so a
+        # push replayed at a promoted replica lands exactly once.
         self._transport_nonce = uuid.uuid4().hex[:12]
+        # per-worker push sequence (one per push/push_pull operation, the
+        # same number on every shard's message of that operation): the seq
+        # half of the dedup token, and — with the fanout set the sparse
+        # worker attaches — what the sparse checkpoint drain compares
+        # across shards
+        self._push_seq = 0
         self.pool_size = max(int(pool_size), 1) if pool_size is not None \
             else (2 if self.bucket_bytes is not None else 1)
         self.transport = TransportStats()
@@ -481,8 +524,236 @@ class BucketedTransportMixin:
             host, port = self._addrs[i]
             raise ServerFailureError(
                 f"{self._failure_noun} {i} ({host}:{port}) failed "
-                f"mid-job: {e}"
+                f"mid-job: {e}", server=i
             ) from e
+
+    # -- replica sets & live failover (ps_tpu/replica, worker half) -----------
+
+    def _init_failover(self, replica_sets, failover_timeout) -> None:
+        """Record each shard's replica set (preferred/primary first) and
+        the budget for riding out a promotion. Call after ``_addrs`` is
+        set, before dialing."""
+        import os
+
+        n = len(self._addrs)
+        if replica_sets is None:
+            replica_sets = [[tuple(a)] for a in self._addrs]
+        if len(replica_sets) != n:
+            raise ValueError(
+                f"replica_sets names {len(replica_sets)} shards but the "
+                f"worker dialed {n}"
+            )
+        self._replica_sets = [[tuple(a) for a in s] for s in replica_sets]
+        for i, s in enumerate(self._replica_sets):
+            if tuple(self._addrs[i]) not in s:
+                raise ValueError(
+                    f"server {i}'s address {self._addrs[i]} is not in its "
+                    f"replica set {s}"
+                )
+        if failover_timeout is None:
+            failover_timeout = float(
+                os.environ.get("PS_FAILOVER_TIMEOUT_MS", 10_000)) / 1e3
+        self.failover_timeout = float(failover_timeout)
+        self._epochs = [0] * n  # shard-table epochs, learned from HELLO
+
+    def _next_push_seq(self) -> int:
+        self._push_seq += 1
+        return self._push_seq
+
+    def _reply_error(self, i: int, extra: dict) -> BaseException:
+        """The typed error for an ERR reply mid-stream: a 'not serving'
+        refusal (an unpromoted backup, a zombie fenced mid-commit) maps to
+        the same retryable failure a dead connection raises — the failover
+        loop re-routes and replays; anything else is a real application
+        error and surfaces as-is."""
+        if extra.get("backup"):
+            host, port = self._addrs[i]
+            return ServerFailureError(
+                f"{self._failure_noun} {i} ({host}:{port}) is not "
+                f"serving: {extra.get('error')}", server=i)
+        return RuntimeError(f"server {i} error: {extra.get('error')}")
+
+    def _hello(self, ch) -> dict:
+        """One HELLO round trip; typed outcomes for the failover loop."""
+        kind, _, _, extra = tv.decode(
+            ch.request(tv.encode(tv.HELLO, self.worker, None))
+        )
+        if kind != tv.OK:
+            if extra.get("backup"):
+                raise BackupNotServing(extra.get("error"))
+            raise ReplicaRejected(f"HELLO refused: {extra.get('error')}")
+        return extra
+
+    def _validate_failover_hello(self, i: int, extra: dict) -> Optional[str]:
+        """Subclass hook: check a promoted replica's HELLO against what
+        the worker validated at connect time (error string, or None)."""
+        return None
+
+    def _cycle_replica_set(self, i: int, deadline: float,
+                           skip_current: bool = False, validate=None,
+                           cause: Optional[BaseException] = None):
+        """THE replica-set dial loop (shared by connect-time ``_hello_any``
+        and mid-job ``_failover`` so retry/backoff/typed-outcome handling
+        cannot drift between them): cycle server ``i``'s candidates until
+        one answers HELLO as a serving primary and passes ``validate``
+        (unpromoted backups and rejected members keep the loop going), or
+        the deadline passes. Returns ``(channel, hello_extra, addr)``; the
+        channel is stats-accounted but NOT pooled or shm-upgraded (main
+        channels never attach the recv pool — their replies are consumed,
+        not returned)."""
+        import time
+
+        cands = self._replica_sets[i]
+        k = cands.index(tuple(self._addrs[i])) \
+            if tuple(self._addrs[i]) in cands else 0
+        if skip_current:
+            k += 1
+        last: Optional[BaseException] = cause
+        while True:
+            host, port = cands[k % len(cands)]
+            k += 1
+            try:
+                ch = tv.Channel.connect(host, port, timeout_ms=2000,
+                                        retries=2, max_wait_s=0.5)
+                ch.stats = self.transport
+                try:
+                    extra = self._hello(ch)
+                    if validate is not None:
+                        err = validate(extra)
+                        if err is not None:
+                            raise ReplicaRejected(err)
+                except BaseException:
+                    ch.close()
+                    raise
+                return ch, extra, (host, port)
+            except (BackupNotServing, ReplicaRejected, tv.VanError,
+                    OSError) as e:
+                last = e
+            if time.monotonic() >= deadline:
+                err = ServerFailureError(
+                    f"no member of {self._failure_noun} {i}'s replica set "
+                    f"{cands} is serving before the failover deadline: "
+                    f"{last}", server=i)
+                if cause is not None:
+                    raise err from cause
+                raise err
+            time.sleep(0.05)
+
+    def _hello_any(self, i: int):
+        """Connect-time dial of server ``i``: its preferred address, or —
+        when a replica set is configured — the first member that answers
+        HELLO as a serving primary (an unpromoted backup keeps the loop
+        cycling within the failover window, so a worker can join a shard
+        mid-promotion). Returns ``(channel, hello_extra)``."""
+        import time
+
+        cands = getattr(self, "_replica_sets",
+                        [[tuple(a)] for a in self._addrs])[i]
+        if len(cands) == 1:
+            host, port = cands[0]
+            ch = tv.Channel.connect(host, port)
+            ch.stats = self.transport
+            try:
+                return ch, self._hello(ch)
+            except (BackupNotServing, ReplicaRejected) as e:
+                ch.close()
+                raise ServerFailureError(
+                    f"{self._failure_noun} {i} ({host}:{port}) refused "
+                    f"HELLO: {e}", server=i) from e
+        deadline = time.monotonic() + self.failover_timeout
+        ch, extra, addr = self._cycle_replica_set(i, deadline)
+        self._addrs[i] = addr
+        return ch, extra
+
+    def _failover(self, i: int, cause: BaseException,
+                  deadline: float) -> None:
+        """Re-route shard ``i`` to a serving replica: tear down the dead
+        transport, cycle the replica set (waiting out an in-flight
+        promotion), refuse stale epochs (a zombie old primary must not win
+        the race), revalidate the topology, and rebuild pumps. Raises the
+        typed failure when nothing serves before ``deadline``."""
+        import logging
+        import time
+
+        t0 = time.monotonic()
+        logging.getLogger(__name__).warning(
+            "%s %d (%s:%d) failed; trying its replica set (%d member(s))",
+            self._failure_noun, i, *self._addrs[i],
+            len(self._replica_sets[i]),
+        )
+        for p in self._pumps.pop(i, []):
+            p.close()
+        try:
+            self._chs[i].close()
+        except Exception:
+            pass
+
+        def validate(extra):
+            epoch = int(extra.get("epoch") or 0)
+            if epoch < self._epochs[i]:
+                return (f"stale shard epoch {epoch} < {self._epochs[i]} "
+                        f"(zombie old primary?)")
+            return self._validate_failover_hello(i, extra)
+
+        # start at the NEXT member: the preferred address just failed
+        ch, extra, addr = self._cycle_replica_set(
+            i, deadline, skip_current=True, validate=validate, cause=cause)
+        try:
+            ch = self._maybe_upgrade(ch)
+        except tv.VanError as e:
+            # the candidate died DURING shm negotiation (a mere refusal
+            # falls back to TCP inside try_upgrade): treat it like any
+            # dead candidate — the caller's retry loop fails over again
+            # within the same deadline
+            ch.close()
+            raise ServerFailureError(
+                f"{self._failure_noun} {i} died during lane negotiation: "
+                f"{e}", server=i) from e
+        self._chs[i] = ch
+        self._addrs[i] = addr
+        self._epochs[i] = int(extra.get("epoch") or 0)
+        if self.bucket_bytes is not None:
+            self._open_pumps([i])
+        dt = time.monotonic() - t0
+        self.transport.record_failover(dt)
+        logging.getLogger(__name__).warning(
+            "%s %d re-routed to %s:%d (epoch %d) in %.2fs",
+            self._failure_noun, i, *addr, self._epochs[i], dt,
+        )
+
+    def _with_failover(self, fn):
+        """Run one transport operation; on a typed server failure, fail
+        the shard over to a replica and retry the WHOLE operation. Safe
+        because operations are idempotent: pulls are reads, and every push
+        carries its (nonce, seq) dedup token — shards that already applied
+        it ack without re-applying, so the retry is exactly-once
+        everywhere. The total window (re-routes included, across every
+        shard the retry trips over) is bounded by ``failover_timeout``."""
+        import time
+
+        try:
+            return fn()
+        except ServerFailureError as e:
+            err = e
+        deadline = time.monotonic() + self.failover_timeout
+        while True:
+            i = getattr(err, "server", None)
+            if i is None or len(self._replica_sets[i]) <= 1:
+                raise err
+            try:
+                self._failover(i, err, deadline)
+            except ServerFailureError as e:
+                # a candidate died mid-adoption (e.g. during lane
+                # negotiation): keep cycling within the SAME deadline; a
+                # deadline-expired failure propagates
+                if time.monotonic() >= deadline:
+                    raise
+                err = e
+                continue
+            try:
+                return fn()
+            except ServerFailureError as e:
+                err = e
 
     def _track_pending(self, pending) -> None:
         """Register a background handle for flush(). Handles that resolved

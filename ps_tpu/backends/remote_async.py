@@ -42,10 +42,16 @@ from ps_tpu.backends.common import (
     BucketedTransportMixin,
     BucketPlan,
     ServerFailureError,
+    parse_replica_uri,
     payload_nbytes,
     request_payload,
 )
-from ps_tpu.backends.van_service import VanService, resolve_ckpt_dir
+from ps_tpu.backends.van_service import (
+    VanService,
+    log_tail,
+    make_history_log,
+    resolve_ckpt_dir,
+)
 from ps_tpu.compress import CompressPolicy, GradCompressor, decode_tree
 from ps_tpu.control import tensor_van as tv
 from ps_tpu.kv import keys as keymod
@@ -100,7 +106,10 @@ class AsyncPSService(VanService):
                  num_shards: Optional[int] = None,
                  ckpt_root: Optional[str] = None,
                  writev: Optional[bool] = None,
-                 shm: Optional[bool] = None):
+                 shm: Optional[bool] = None,
+                 backup: bool = False,
+                 record_full_history: bool = False,
+                 history: int = 4096):
         engine = store._engine
         if getattr(engine, "mode", "sync") != "async":
             raise ValueError("AsyncPSService requires an async-mode KVStore")
@@ -141,14 +150,25 @@ class AsyncPSService(VanService):
         self._pull_cache: Dict[int, dict] = {}
         self._applied: Dict[int, int] = {}   # per-worker applied pushes
         self._drain_targets: Dict[int, int] = {}
+        # exactly-once under failover replay: worker -> (nonce, seq) of the
+        # last applied dedup-tagged push; a replayed (nonce, seq <= last)
+        # push is acked without applying. Replicated with each push entry,
+        # so a promoted backup suppresses the same replays its primary
+        # would have.
+        self._applied_pseq: Dict[int, tuple] = {}
         self._log_lock = threading.Lock()
-        self.apply_log: List[int] = []  # worker id per committed tree, in order
-        # full ordered (op, worker) history — "pull" records matter because
+        # worker id per committed tree, in order — a bounded ring by
+        # default (a long-lived server must not hold O(applies) memory);
+        # record_full_history=True keeps everything for the replay-parity
+        # tests
+        self.apply_log = make_history_log(record_full_history, history)
+        # ordered (op, worker) history — "pull" records matter because
         # the DC apply depends on WHAT each worker last pulled; replaying
         # this log through a threaded engine reproduces params bit-for-bit
-        self.event_log: List[List] = []
+        self.event_log = make_history_log(record_full_history, history)
         # starts accepting: state ready
-        super().__init__(port=port, bind=bind, writev=writev, shm=shm)
+        super().__init__(port=port, bind=bind, writev=writev, shm=shm,
+                         backup=backup)
 
     # -- server internals -----------------------------------------------------
 
@@ -165,6 +185,10 @@ class AsyncPSService(VanService):
             version = self._engine.version
             with self._log_lock:
                 self.event_log.append(["pull", worker])
+            # pulls replicate too: the DC apply depends on what each worker
+            # last pulled, so the backup's _stale bookkeeping must follow
+            rseq = self._replicate("pull", worker)
+        self._await_replication(rseq)
         host = {k: np.asarray(v) for k, v in kv.items()}
         if self.writev:
             # vectored reply: the host tensors are sent as live views
@@ -174,15 +198,32 @@ class AsyncPSService(VanService):
         return tv.encode(tv.OK, worker, host, extra={"version": version})
 
     def _apply_push(self, worker: int, grads: Dict[str, np.ndarray],
-                    copy: bool = True) -> None:
+                    copy: bool = True,
+                    extra: Optional[dict] = None) -> Tuple[Optional[int], bool]:
+        """Apply one whole-tree push; returns ``(replication_seq, dedup)``.
+
+        ``extra``'s optional ``pseq``/``pnonce`` are the worker's dedup
+        token: a (nonce, seq) at or below the last applied one is a replay
+        — an in-flight push whose reply died with the old primary, resent
+        at this (possibly promoted) server — and is acked WITHOUT applying,
+        so failover retries are exactly-once."""
         if sorted(grads) != sorted(self._key_order):
             raise KeyError("push keys do not match the registered tree")
+        extra = extra or {}
+        pseq = extra.get("pseq")
+        pnonce = extra.get("pnonce")
         if copy:
             # copy out of the recv buffer: the engine may keep references
             # beyond this frame's lifetime (bucket-assembled trees already
             # own their buffers and skip this)
             grads = {k: np.array(v) for k, v in grads.items()}
         with self._engine._lock:
+            if pseq is not None:
+                last = self._applied_pseq.get(worker)
+                if (last is not None and last[0] == pnonce
+                        and int(pseq) <= last[1]):
+                    self.transport.record_dedup_hit()
+                    return None, True
             while (self._paused and not self._draining
                    and not self._admit_while_paused(worker)):
                 self._pause_wait_begin()
@@ -194,10 +235,18 @@ class AsyncPSService(VanService):
                 raise RuntimeError("server is draining; push refused")
             self._engine.push_tree(grads, worker=worker)
             self._applied[worker] = self._applied.get(worker, 0) + 1
+            if pseq is not None:
+                self._applied_pseq[worker] = (pnonce, int(pseq))
             self._pause_cond.notify_all()  # a drain_to waiter may be watching
             with self._log_lock:
                 self.apply_log.append(worker)
                 self.event_log.append(["push", worker])
+            # replicate the post-decode host tree (it owns its buffers by
+            # now), carrying the dedup token so a promoted backup
+            # suppresses the same replays its primary would have
+            rseq = self._replicate("push", worker, grads,
+                                   {"pseq": pseq, "pnonce": pnonce})
+        return rseq, False
 
     def _admit_while_paused(self, worker: int) -> bool:
         """Under pause, admit exactly the pushes a drain_to round asked
@@ -231,9 +280,11 @@ class AsyncPSService(VanService):
             return tv.encode(tv.OK, worker, None,
                              extra={"staged": int(extra["bucket"])})
         tree = decode_tree(tree, extra.get("enc"), stats=self.transport)
-        self._apply_push(worker, tree, copy=False)
+        rseq, dedup = self._apply_push(worker, tree, copy=False, extra=extra)
+        self._await_replication(rseq)
         return tv.encode(tv.OK, worker, None, extra={
             "version": self._engine.version, "committed": True,
+            "dedup": dedup,
         })
 
     def _bucket_pull(self, worker: int, extra) -> bytes:
@@ -251,6 +302,8 @@ class AsyncPSService(VanService):
                 version = self._engine.version
                 with self._log_lock:
                     self.event_log.append(["pull", worker])
+                rseq = self._replicate("pull", worker)
+            self._await_replication(rseq)
             # contiguous host conversion ONCE; per-bucket encodes then slice
             # it zero-copy (jax arrays convert contiguous, but be explicit)
             host = {k: np.ascontiguousarray(np.asarray(v))
@@ -320,16 +373,23 @@ class AsyncPSService(VanService):
                 "num_workers": self._engine.num_workers,
                 "shard": self.shard,
                 "num_shards": self.num_shards,
+                "epoch": self.epoch,
+                "role": self.role,
             })
         elif kind == tv.PULL:
             return self._params_payload(worker)
         elif kind == tv.PUSH:
-            self._apply_push(worker, self._decode_push(tensors, extra))
+            rseq, dedup = self._apply_push(
+                worker, self._decode_push(tensors, extra), extra=extra)
+            self._await_replication(rseq)
             return tv.encode(tv.OK, worker, None, extra={
-                "version": self._engine.version,
+                "version": self._engine.version, "dedup": dedup,
             })
         elif kind == tv.PUSH_PULL:
-            self._apply_push(worker, self._decode_push(tensors, extra))
+            self._apply_push(worker, self._decode_push(tensors, extra),
+                             extra=extra)
+            # no separate ack wait: the pull record below is a LATER log
+            # entry, and the reply already waits on it (FIFO acks)
             return self._params_payload(worker)
         elif kind == tv.BUCKET_PUSH:
             return self._bucket_push(worker, tensors, extra)
@@ -337,14 +397,20 @@ class AsyncPSService(VanService):
             return self._bucket_pull(worker, extra)
         elif kind == tv.STATS:
             with self._log_lock:
-                log = list(self.apply_log)
-            return tv.encode(tv.OK, worker, None, extra={
+                # a bounded TAIL plus the true total — never the unbounded
+                # list: a 10⁶-apply server must not ship multi-MB stats
+                # frames (or hold them; the log itself is a ring unless
+                # record_full_history opted in)
+                log = log_tail(self.apply_log)
+                log_total = self.apply_log.total
+            out = {
                 "version": self._engine.version,
                 "staleness_hist": {
                     str(t): n for t, n in
                     self._engine.staleness_hist.items()
                 },
                 "apply_log": log,
+                "apply_log_total": log_total,
                 "worker_version": {
                     str(w): v for w, v in
                     self._engine._worker_version.items()
@@ -353,7 +419,9 @@ class AsyncPSService(VanService):
                 # of only in server stderr (codec-PR satellite)
                 "stale_epochs": self.transport.stale_epochs,
                 "stale_epoch_buckets": self.transport.stale_epoch_buckets,
-            })
+            }
+            out.update(self.replica_state())
+            return tv.encode(tv.OK, worker, None, extra=out)
         elif kind == tv.CHECKPOINT:
             return self._checkpoint(worker, extra)
         return tv.encode(tv.ERR, worker, None,
@@ -467,11 +535,72 @@ class AsyncPSService(VanService):
             self._draining = True
             self._pause_cond.notify_all()  # paused pushes wake into refusal
 
+    # -- shard replication hooks (ps_tpu/replica) -----------------------------
+
+    def _service_lock(self):
+        return self._engine._lock
+
+    def _replica_hello_extra(self) -> dict:
+        return {
+            "kind": "dense",
+            "keys": self._key_order,
+            "shard": self.shard,
+            "num_shards": self.num_shards,
+            "version": self._engine.version,
+            "start_seq": 0,
+        }
+
+    def _replica_validate(self, extra: dict) -> Optional[str]:
+        if extra.get("kind") != "dense":
+            return (f"replication stream kind {extra.get('kind')!r} does "
+                    f"not match this dense service")
+        if sorted(extra.get("keys") or []) != sorted(self._key_order):
+            return "primary and backup disagree on the key range"
+        if (extra.get("shard"), extra.get("num_shards")) \
+                != (self.shard, self.num_shards):
+            return (f"primary is shard {extra.get('shard')}/"
+                    f"{extra.get('num_shards')}, backup is shard "
+                    f"{self.shard}/{self.num_shards}")
+        if int(extra.get("version", -1)) != self._engine.version:
+            return (f"state-point mismatch: primary at version "
+                    f"{extra.get('version')}, backup at "
+                    f"{self._engine.version} — a deltas-only stream cannot "
+                    f"catch up past missed commits; start the pair from the "
+                    f"same initial params or a common checkpoint")
+        return None
+
+    def _replica_apply(self, op: str, worker: int, tensors, extra) -> None:
+        # engine lock HELD by the dispatcher: apply inline, never through
+        # _apply_push (which re-acquires it)
+        if op == "pull":
+            self._engine.pull_tree(worker=worker)
+            with self._log_lock:
+                self.event_log.append(["pull", worker])
+            return
+        if op != "push":
+            raise ValueError(f"unknown replica op {op!r}")
+        tree = decode_tree(dict(tensors), extra.get("enc"),
+                           stats=self.transport)
+        # own-memory copies: the entry's arrays view the request frame,
+        # and the engine keeps references past its lifetime
+        tree = {k: np.array(v) for k, v in tree.items()}
+        if sorted(tree) != sorted(self._key_order):
+            raise KeyError("replica push keys do not match the tree")
+        self._engine.push_tree(tree, worker=worker)
+        self._applied[worker] = self._applied.get(worker, 0) + 1
+        if extra.get("pseq") is not None:
+            self._applied_pseq[worker] = (extra.get("pnonce"),
+                                          int(extra["pseq"]))
+        with self._log_lock:
+            self.apply_log.append(worker)
+            self.event_log.append(["push", worker])
+
 
 def serve_async(store, port: int = 0, bind: str = "127.0.0.1",
                 shard: Optional[int] = None,
                 num_shards: Optional[int] = None,
-                ckpt_root: Optional[str] = None) -> "AsyncPSService":
+                ckpt_root: Optional[str] = None,
+                backup: bool = False) -> "AsyncPSService":
     """Expose an initialized async KVStore to remote worker processes.
 
     The top-level entry of the cross-process async deployment: each server
@@ -486,10 +615,15 @@ def serve_async(store, port: int = 0, bind: str = "127.0.0.1",
     ``s`` of ``N`` runs ``store.init(shard_tree(params, s, N))`` and
     ``serve_async(store, ..., shard=s, num_shards=N)``. ``ckpt_root``
     confines CHECKPOINT saves under a server-side root (recommended for
-    any non-loopback bind)."""
+    any non-loopback bind).
+
+    Replication (README "Replication & failover"): ``backup=True`` starts
+    the service in backup role — it refuses worker traffic and follows the
+    primary's REPLICA stream until promoted; the primary side calls
+    ``svc.attach_backup(host, port, ack=...)`` before admitting workers."""
     return AsyncPSService(store, port=port, bind=bind,
                           shard=shard, num_shards=num_shards,
-                          ckpt_root=ckpt_root)
+                          ckpt_root=ckpt_root, backup=backup)
 
 
 def connect_async(uri: str, worker: int, params_like,
@@ -497,7 +631,9 @@ def connect_async(uri: str, worker: int, params_like,
                   pool_size: Optional[int] = None,
                   compress=None, writev: Optional[bool] = None,
                   shm: Optional[bool] = None,
-                  shm_bytes: Optional[int] = None) -> "RemoteAsyncWorker":
+                  shm_bytes: Optional[int] = None,
+                  failover_timeout: Optional[float] = None
+                  ) -> "RemoteAsyncWorker":
     """Join a cross-process async job as worker ``worker``.
 
     ``uri`` is ``host:port`` of the :func:`serve_async` process, or a
@@ -506,6 +642,13 @@ def connect_async(uri: str, worker: int, params_like,
     ``PS_ASYNC_SERVER_URI``); ``params_like`` is a pytree with the model's
     parameter structure (used to validate the key partition against the
     servers and to rebuild pulled params).
+
+    Replica sets (README "Replication & failover"): each shard's entry may
+    list its replicas separated by ``|``, primary first —
+    ``"h0:p0|b0:q0,h1:p1|b1:q1"``. On a primary's death the worker retries
+    against the set (waiting out the backup's promotion, bounded by
+    ``failover_timeout`` seconds, env PS_FAILOVER_TIMEOUT_MS) and its
+    (nonce, seq)-tagged pushes apply exactly once at the new primary.
 
     ``bucket_bytes`` switches the data plane to the bucketed, pipelined
     transport (~4 MiB fusion buckets striped over ``pool_size`` persistent
@@ -527,15 +670,14 @@ def connect_async(uri: str, worker: int, params_like,
     connection at connect time — ``shm_bytes`` (env PS_SHM_BYTES) sizes
     each ring — falling back to TCP whenever the peer is another host,
     the segments cannot be created, or the server refuses."""
-    addrs = []
-    for part in uri.split(","):
-        host, port = part.strip().rsplit(":", 1)
-        addrs.append((host, int(port)))
+    addrs, replica_sets = parse_replica_uri(uri)
     return RemoteAsyncWorker.connect_many(addrs, worker, params_like,
                                           bucket_bytes=bucket_bytes,
                                           pool_size=pool_size,
                                           compress=compress, writev=writev,
-                                          shm=shm, shm_bytes=shm_bytes)
+                                          shm=shm, shm_bytes=shm_bytes,
+                                          replica_sets=replica_sets,
+                                          failover_timeout=failover_timeout)
 
 
 class CheckpointRoundError(RuntimeError):
@@ -670,11 +812,14 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                  pool_size: Optional[int] = None,
                  compress=None, writev: Optional[bool] = None,
                  shm: Optional[bool] = None,
-                 shm_bytes: Optional[int] = None):
+                 shm_bytes: Optional[int] = None,
+                 replica_sets=None,
+                 failover_timeout: Optional[float] = None):
         self._init_multi([(host, int(port))], worker, params_like,
                          bucket_bytes=bucket_bytes, pool_size=pool_size,
                          compress=compress, writev=writev, shm=shm,
-                         shm_bytes=shm_bytes)
+                         shm_bytes=shm_bytes, replica_sets=replica_sets,
+                         failover_timeout=failover_timeout)
 
     @classmethod
     def connect_many(cls, addrs: Sequence[Tuple[str, int]], worker: int,
@@ -682,12 +827,16 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                      pool_size: Optional[int] = None,
                      compress=None, writev: Optional[bool] = None,
                      shm: Optional[bool] = None,
-                     shm_bytes: Optional[int] = None) -> "RemoteAsyncWorker":
+                     shm_bytes: Optional[int] = None,
+                     replica_sets=None,
+                     failover_timeout: Optional[float] = None
+                     ) -> "RemoteAsyncWorker":
         self = cls.__new__(cls)
         self._init_multi(list(addrs), worker, params_like,
                          bucket_bytes=bucket_bytes, pool_size=pool_size,
                          compress=compress, writev=writev, shm=shm,
-                         shm_bytes=shm_bytes)
+                         shm_bytes=shm_bytes, replica_sets=replica_sets,
+                         failover_timeout=failover_timeout)
         return self
 
     def _init_multi(self, addrs: List[Tuple[str, int]], worker: int,
@@ -695,7 +844,9 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                     pool_size: Optional[int] = None,
                     compress=None, writev: Optional[bool] = None,
                     shm: Optional[bool] = None,
-                    shm_bytes: Optional[int] = None) -> None:
+                    shm_bytes: Optional[int] = None,
+                    replica_sets=None,
+                    failover_timeout: Optional[float] = None) -> None:
         self.worker = worker
         kv, self._treedef = keymod.flatten_with_keys(params_like)
         # placeholders, not the arrays: reconnect() only needs keys +
@@ -720,6 +871,9 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         # bucketed transport config (None bucket_bytes = serial transport)
         self._init_transport(bucket_bytes, pool_size, compress=compress,
                              writev=writev, shm=shm, shm_bytes=shm_bytes)
+        # replica sets per shard + the promotion-wait budget (no-op with
+        # singleton sets — the legacy topology)
+        self._init_failover(replica_sets, failover_timeout)
         if self.compress and self.compress.get("pull") \
                 and self.compress.get("codec") == "topk":
             raise ValueError(
@@ -757,13 +911,14 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
 
     def _connect_and_validate(self, addrs, worker, kv) -> None:
         n = len(addrs)
-        for i, (host, port) in enumerate(addrs):
-            ch = tv.Channel.connect(host, port)
-            ch.stats = self.transport
+        for i in range(n):
+            # dials the preferred address — or, with a replica set, the
+            # member currently serving as primary (a worker may join a
+            # shard mid-promotion)
+            ch, extra = self._hello_any(i)
+            host, port = self._addrs[i]
             self._chs.append(ch)
-            _, _, _, extra = tv.decode(
-                ch.request(tv.encode(tv.HELLO, worker, None))
-            )
+            self._epochs[i] = int(extra.get("epoch") or 0)
             skeys = sorted(extra["keys"])
             ns = extra.get("num_shards")
             if ns is not None:
@@ -819,6 +974,20 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                 f"{self.num_workers}-worker job"
             )
 
+    def _validate_failover_hello(self, i: int, extra: dict) -> Optional[str]:
+        """A promoted replica must advertise exactly the key range the
+        worker validated for this shard at connect time."""
+        expected = sorted(k for k, o in self._owner.items() if o == i)
+        if sorted(extra.get("keys") or []) != expected:
+            return (f"replica of server {i} advertises a different key "
+                    f"range than the shard the worker validated")
+        nw = extra.get("num_workers")
+        if nw is not None and self.num_workers is not None \
+                and int(nw) != self.num_workers:
+            return (f"replica of server {i} says num_workers={nw}, "
+                    f"job runs {self.num_workers}")
+        return None
+
     @property
     def version(self) -> int:
         """Total whole-subtree applies across all servers (single-server:
@@ -833,7 +1002,8 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         except tv.VanError as e:
             host, port = self._addrs[i]
             raise ServerFailureError(
-                f"async PS server {i} ({host}:{port}) failed mid-job: {e}"
+                f"async PS server {i} ({host}:{port}) failed mid-job: {e}",
+                server=i
             ) from e
         with self._bytes_lock:
             self.bytes_pushed += payload_nbytes(payload)
@@ -864,7 +1034,7 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         for i, msg in msgs.items():
             kind, _, tensors, extra = tv.decode(msg)
             if kind != tv.OK:
-                raise RuntimeError(f"server {i} error: {extra.get('error')}")
+                raise self._reply_error(i, extra)
             self.versions[i] = int(extra["version"])
             for k, v in tensors.items():
                 kv[k] = jnp.asarray(np.array(v))
@@ -883,27 +1053,41 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         of its subtree)."""
         if self.bucket_bytes is not None:
             self.flush()
-            return self._merge_host_params(self._pull_buckets())
-        return self._merge_params(self._fanout({
+            return self._with_failover(
+                lambda: self._merge_host_params(self._pull_buckets()))
+        return self._with_failover(lambda: self._merge_params(self._fanout({
             i: tv.encode(tv.PULL, self.worker, None) for i in self._active
-        }))
+        })))
 
     def push_all(self, grads) -> None:
         """Push a gradient tree; each owner applies its subtree immediately
-        with the DC-ASGD correction against this worker's last pull from it."""
+        with the DC-ASGD correction against this worker's last pull from it.
+
+        The push carries this worker's (nonce, seq) dedup token — assigned
+        ONCE per logical push, reused verbatim by any failover retry, so a
+        shard that already applied it (directly, or via its dead primary's
+        replication stream) acks without re-applying."""
+        by_owner = self._split_by_owner(grads)
+        pseq = self._next_push_seq()
         if self.bucket_bytes is not None:
             self.flush()
-            self._push_buckets_sync(self._split_by_owner(grads))
+            self._with_failover(
+                lambda: self._push_buckets_sync(by_owner, pseq=pseq))
             return
-        msgs = self._fanout({
-            i: self._encode_serial_push(tv.PUSH, sub)
-            for i, sub in self._split_by_owner(grads).items()
-        })
-        for i, msg in msgs.items():
-            kind, _, _, extra = tv.decode(msg)
-            if kind != tv.OK:
-                raise RuntimeError(f"server {i} error: {extra.get('error')}")
-            self.versions[i] = int(extra["version"])
+
+        def once():
+            msgs = self._fanout({
+                i: self._encode_serial_push(tv.PUSH, sub, pseq=pseq)
+                for i, sub in by_owner.items()
+            })
+            for i, msg in msgs.items():
+                kind, _, _, extra = tv.decode(msg)
+                if kind != tv.OK:
+                    raise RuntimeError(
+                        f"server {i} error: {extra.get('error')}")
+                self.versions[i] = int(extra["version"])
+
+        self._with_failover(once)
 
     def push_pull(self, grads) -> Any:
         """push_all + pull_all in ONE round trip per server (the async
@@ -911,25 +1095,40 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         bucketed pipeline when the worker was connected with
         ``bucket_bytes`` (identical math — the server applies the same
         whole tree and snapshots the same atomic pull)."""
+        by_owner = self._split_by_owner(grads)
+        pseq = self._next_push_seq()
         if self.bucket_bytes is not None:
             self.flush()  # a cycle racing a serial call would reorder epochs
-            self._push_buckets_sync(self._split_by_owner(grads))
-            return self._merge_host_params(self._pull_buckets())
-        return self._merge_params(self._fanout({
-            i: self._encode_serial_push(tv.PUSH_PULL, sub)
-            for i, sub in self._split_by_owner(grads).items()
-        }))
+
+            def once_bucketed():
+                self._push_buckets_sync(by_owner, pseq=pseq)
+                return self._merge_host_params(self._pull_buckets())
+
+            return self._with_failover(once_bucketed)
+        return self._with_failover(
+            lambda: self._merge_params(self._fanout({
+                i: self._encode_serial_push(tv.PUSH_PULL, sub, pseq=pseq)
+                for i, sub in by_owner.items()
+            })))
 
     # -- bucketed, pipelined transport (worker half) --------------------------
 
-    def _encode_serial_push(self, kind: int, sub: Dict[str, np.ndarray]):
+    def _encode_serial_push(self, kind: int, sub: Dict[str, np.ndarray],
+                            pseq: Optional[int] = None):
         """One serial push frame, compressed per the policy (the packed-key
-        list rides the frame's extra, as on the bucketed path). With
-        ``writev`` on, the frame travels as zero-copy parts — the grad
-        tensors go to the kernel as iovecs instead of through a staging
-        bytearray (the measurable serial-path win at BERT-size trees)."""
+        list rides the frame's extra, as on the bucketed path) and tagged
+        with the (nonce, seq) dedup token. With ``writev`` on, the frame
+        travels as zero-copy parts — the grad tensors go to the kernel as
+        iovecs instead of through a staging bytearray (the measurable
+        serial-path win at BERT-size trees)."""
         sub, enc = self._encode_push_tree(sub)
-        extra = {"enc": enc} if enc else None
+        extra = {}
+        if enc:
+            extra["enc"] = enc
+        if pseq is not None:
+            extra["pseq"] = pseq
+            extra["pnonce"] = self._transport_nonce
+        extra = extra or None
         if self.writev:
             return tv.encode_parts(kind, self.worker, sub, extra)
         return tv.encode(kind, self.worker, sub, extra)
@@ -942,12 +1141,13 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                 "pipelined path"
             )
 
-    def _push_buckets_sync(self, by_owner: Dict[int, Dict[str, np.ndarray]]
-                           ) -> None:
+    def _push_buckets_sync(self, by_owner: Dict[int, Dict[str, np.ndarray]],
+                           pseq: Optional[int] = None) -> None:
         """Slice each owner's subtree into fusion buckets, stripe them over
         the connection pool, wait for every ack, and adopt the committed
         versions. The engine sees ONE whole-tree apply per server, exactly
-        like a serial PUSH."""
+        like a serial PUSH; ``pseq`` is the logical push's dedup token
+        (same on every bucket — the completing bucket's apply checks it)."""
         self._push_epoch += 1
         epoch = self._push_epoch
         futs: List[Tuple[int, Any]] = []
@@ -971,6 +1171,8 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                     tv.BUCKET_PUSH, self.worker, sub, b,
                     extra={"epoch": epoch,
                            "nonce": self._transport_nonce,
+                           "pseq": pseq,
+                           "pnonce": self._transport_nonce,
                            "enc": enc},
                 )
                 futs.append((i, pumps[b % len(pumps)].submit(payload)))
@@ -979,7 +1181,7 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
             kind, _, _, extra = tv.decode(reply)
             self._release_frame(reply)  # extra is json-owned; frame done
             if kind != tv.OK:
-                raise RuntimeError(f"server {i} error: {extra.get('error')}")
+                raise self._reply_error(i, extra)
             if extra.get("committed"):
                 self.versions[i] = int(extra["version"])
 
@@ -1009,7 +1211,7 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
             kind, _, tensors, extra = tv.decode(reply)
             if kind != tv.OK:
                 self._release_frame(reply)  # no borrow strands on errors
-                raise RuntimeError(f"server {i} error: {extra.get('error')}")
+                raise self._reply_error(i, extra)
             self.versions[i] = int(extra["version"])
             enc_keys.extend(extra.get("enc") or [])
             n = int(extra["nbuckets"])
@@ -1030,7 +1232,7 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
             kind, _, tensors, extra = tv.decode(reply)
             if kind != tv.OK:
                 self._release_frame(reply)
-                raise RuntimeError(f"server {i} error: {extra.get('error')}")
+                raise self._reply_error(i, extra)
             done = assemblers[i].add(int(extra["bucket"]), tensors["raw"],
                                      extra["slices"], epoch)
             self._release_frame(reply)
@@ -1062,16 +1264,20 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         step's host work."""
         self._require_bucketed()
         by_owner = self._split_by_owner(grads)  # host copy: caller may mutate
+        pseq = self._next_push_seq()  # assigned NOW: retries reuse it
         pending = PendingCycle(self.transport)
         self._track_pending(pending)
-        self._bg_executor().submit(self._run_cycle, by_owner, pending)
+        self._bg_executor().submit(self._run_cycle, by_owner, pseq, pending)
         return pending
 
-    def _run_cycle(self, by_owner, pending: PendingCycle) -> None:
+    def _run_cycle(self, by_owner, pseq: int, pending: PendingCycle) -> None:
         t0 = time.perf_counter()
         try:
-            self._push_buckets_sync(by_owner)
-            params = self._merge_host_params(self._pull_buckets())
+            def once():
+                self._push_buckets_sync(by_owner, pseq=pseq)
+                return self._merge_host_params(self._pull_buckets())
+
+            params = self._with_failover(once)
         except BaseException as e:
             pending._fail(e)
         else:
@@ -1184,7 +1390,13 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                     self._treedef, self._kv_like, self._key_order),
                 bucket_bytes=self.bucket_bytes, pool_size=self.pool_size,
                 compress=self.compress, writev=self.writev, shm=self.shm,
-                shm_bytes=self.shm_bytes)
+                shm_bytes=self.shm_bytes,
+                # explicit new addresses invalidate the old replica sets
+                # (restarted servers come back elsewhere); a plain re-dial
+                # keeps them
+                replica_sets=None if addrs is not None
+                else self._replica_sets,
+                failover_timeout=self.failover_timeout)
         finally:
             # restores the compressor too: topk error-feedback residuals
             # are unsent gradient mass and must survive the re-dial

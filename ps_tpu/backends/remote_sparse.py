@@ -41,6 +41,7 @@ from ps_tpu.backends.common import (
     BucketedTransportMixin,
     BucketPlan,
     ServerFailureError,
+    parse_replica_uri,
     payload_nbytes,
     request_payload,
 )
@@ -49,7 +50,12 @@ from ps_tpu.backends.remote_async import (
     CheckpointRoundsMixin,
     PendingCycle,
 )
-from ps_tpu.backends.van_service import VanService, resolve_ckpt_dir
+from ps_tpu.backends.van_service import (
+    VanService,
+    log_tail,
+    make_history_log,
+    resolve_ckpt_dir,
+)
 from ps_tpu.compress import decode_tree, resolve_spec
 from ps_tpu.control import tensor_van as tv
 
@@ -107,7 +113,10 @@ class SparsePSService(VanService):
                  total_rows: Optional[Dict[str, int]] = None,
                  ckpt_root: Optional[str] = None,
                  writev: Optional[bool] = None,
-                 shm: Optional[bool] = None):
+                 shm: Optional[bool] = None,
+                 backup: bool = False,
+                 record_full_history: bool = False,
+                 history: int = 4096):
         if not tables:
             raise ValueError("no tables to serve")
         if (shard is None) != (num_shards is None):
@@ -158,10 +167,21 @@ class SparsePSService(VanService):
         self.rows_applied: Dict[str, int] = {
             n: int(emb.rows_pushed) for n, emb in self._tables.items()
         }
+        # exactly-once under failover replay + the checkpoint drain round:
+        # worker -> (nonce, cycle seq, fanout) of the last applied push.
+        # The seq dedups replays; the fanout set tells the coordinator
+        # which shards that cycle addressed (sparse cycles route to a
+        # SUBSET of shards, so bare counts are not comparable — the seq
+        # and fanout make them so).
+        self._applied_pseq: Dict[int, tuple] = {}
+        self._drain_targets: Dict[int, tuple] = {}
         self._log_lock = threading.Lock()
-        self.apply_log: List[int] = []  # worker id per applied push message
+        # worker id per applied push message — bounded ring unless the
+        # replay-parity tests opt into full history
+        self.apply_log = make_history_log(record_full_history, history)
         # starts accepting: state ready
-        super().__init__(port=port, bind=bind, writev=writev, shm=shm)
+        super().__init__(port=port, bind=bind, writev=writev, shm=shm,
+                         backup=backup)
 
     # -- server internals -----------------------------------------------------
 
@@ -171,6 +191,8 @@ class SparsePSService(VanService):
             "shard": self.shard,
             "num_shards": self.num_shards,
             "versions": dict(self.versions),
+            "epoch": self.epoch,
+            "role": self.role,
         }
 
     def _split(self, tensors: Dict[str, np.ndarray]
@@ -196,20 +218,43 @@ class SparsePSService(VanService):
 
     def _apply_push(self, worker: int,
                     per_table: Dict[str, Dict[str, np.ndarray]],
-                    copy: bool = True) -> None:
+                    copy: bool = True,
+                    extra: Optional[dict] = None
+                    ) -> Tuple[Optional[int], bool]:
+        """Apply one multi-table push; returns ``(replication_seq, dedup)``.
+
+        ``extra``'s ``pseq``/``pnonce``/``pfan`` are the worker's cycle
+        token: seq at or below the last applied one (same incarnation
+        nonce) is a failover replay and is acked WITHOUT applying."""
+        extra = extra or {}
+        pseq = extra.get("pseq")
+        pnonce = extra.get("pnonce")
+        pfan = extra.get("pfan")
         # copy out of the recv buffer: the engine keeps references beyond
         # this frame's lifetime (bucket-assembled pushes own their buffers)
         arr = np.array if copy else np.asarray
         todo = []
+        wire: Dict[str, np.ndarray] = {}  # global-id form, for replication
         for name, t in per_table.items():
             if "ids" not in t or "grads" not in t:
                 raise KeyError(f"push for {name!r} needs ids + grads")
-            todo.append((name, self._localize(name, arr(t["ids"])),
-                         arr(t["grads"])))
+            ids = np.asarray(arr(t["ids"]), np.int32)
+            grads = arr(t["grads"])
+            todo.append((name, self._localize(name, ids), grads))
+            wire[f"{name}/ids"] = ids
+            wire[f"{name}/grads"] = grads
         if not todo:
-            return  # push_pull with no rows for this server: nothing applied
+            # push_pull with no rows for this server: nothing applied
+            return None, False
         with self._lock:
-            while self._paused and not self._draining:
+            if pseq is not None:
+                last = self._applied_pseq.get(worker)
+                if (last is not None and last[0] == pnonce
+                        and int(pseq) <= last[1]):
+                    self.transport.record_dedup_hit()
+                    return None, True
+            while (self._paused and not self._draining
+                   and not self._admit_while_paused(worker)):
                 self._pause_wait_begin()
                 try:
                     self._pause_cond.wait()  # checkpoint snapshot in flight
@@ -221,8 +266,29 @@ class SparsePSService(VanService):
                 self._tables[name].push(ids, grads)
                 self.versions[name] += 1
                 self.rows_applied[name] += int(ids.size)
+            if pseq is not None:
+                self._applied_pseq[worker] = (pnonce, int(pseq),
+                                              list(pfan or []))
+            self._pause_cond.notify_all()  # a drain_to waiter may watch
             with self._log_lock:
                 self.apply_log.append(worker)
+            rseq = self._replicate("push", worker, wire, {
+                "pseq": pseq, "pnonce": pnonce, "pfan": pfan,
+            })
+        return rseq, False
+
+    def _admit_while_paused(self, worker: int) -> bool:
+        """Under pause, admit exactly the pushes a drain_to round is
+        waiting on: this worker's applied cycle seq still lags its
+        cross-shard target (same incarnation)."""
+        tgt = self._drain_targets.get(worker)
+        if tgt is None:
+            return False
+        nonce, seq = tgt
+        rec = self._applied_pseq.get(worker)
+        if rec is None:
+            return True  # the targeted cycle's message is still in flight
+        return rec[0] == nonce and rec[1] < seq
 
     def _rows_payload(self, worker: int,
                       per_table: Dict[str, Dict[str, np.ndarray]]) -> bytes:
@@ -246,9 +312,11 @@ class SparsePSService(VanService):
         elif kind == tv.ROW_PUSH:
             tensors = decode_tree(dict(tensors), extra.get("enc"),
                                   stats=self.transport)
-            self._apply_push(worker, self._split(tensors))
+            rseq, dedup = self._apply_push(worker, self._split(tensors),
+                                           extra=extra)
+            self._await_replication(rseq)
             return tv.encode(tv.OK, worker, None, extra={
-                "versions": dict(self.versions),
+                "versions": dict(self.versions), "dedup": dedup,
             })
         elif kind == tv.ROW_PUSH_PULL:
             tensors = decode_tree(dict(tensors), extra.get("enc"),
@@ -257,7 +325,8 @@ class SparsePSService(VanService):
             push = {n: t for n, t in per.items() if "grads" in t}
             pull = {n: {"ids": t["pull_ids"]}
                     for n, t in per.items() if "pull_ids" in t}
-            self._apply_push(worker, push)
+            rseq, _ = self._apply_push(worker, push, extra=extra)
+            self._await_replication(rseq)
             return self._rows_payload(worker, pull)
         elif kind == tv.ROW_BUCKET_PUSH:
             # one fusion bucket of a multi-bucket row push: stage until the
@@ -274,43 +343,55 @@ class SparsePSService(VanService):
                 return tv.encode(tv.OK, worker, None,
                                  extra={"staged": int(extra["bucket"])})
             tree = decode_tree(tree, extra.get("enc"), stats=self.transport)
-            self._apply_push(worker, self._split(tree), copy=False)
+            rseq, dedup = self._apply_push(worker, self._split(tree),
+                                           copy=False, extra=extra)
+            self._await_replication(rseq)
             return tv.encode(tv.OK, worker, None, extra={
                 "versions": dict(self.versions), "committed": True,
+                "dedup": dedup,
             })
         elif kind == tv.STATS:
             with self._log_lock:
-                log = list(self.apply_log)
-            return tv.encode(tv.OK, worker, None, extra={
+                # bounded tail + true total, never the unbounded list
+                log = log_tail(self.apply_log)
+                log_total = self.apply_log.total
+            out = {
                 "versions": dict(self.versions),
                 "rows_applied": dict(self.rows_applied),
                 "apply_log": log,
+                "apply_log_total": log_total,
                 "stale_epochs": self.transport.stale_epochs,
                 "stale_epoch_buckets": self.transport.stale_epoch_buckets,
-            })
+            }
+            out.update(self.replica_state())
+            return tv.encode(tv.OK, worker, None, extra=out)
         elif kind == tv.CHECKPOINT:
             return self._checkpoint(worker, extra)
         return tv.encode(tv.ERR, worker, None,
                          extra={"error": f"bad kind {kind}"})
 
     def _checkpoint(self, worker: int, extra: dict) -> bytes:
-        """Coordinated multi-server checkpoint, three phases (pause
-        applies everywhere -> save every owned table under
-        ``<dir>[/shard<i>]/<table>`` -> resume). Each shard's save is
-        atomic and the pause stops new cycles from landing mid-save;
-        unlike the dense service there is NO cross-shard drain round — a
-        sparse cycle routes to an arbitrary subset of shards (per the row
-        ranges of its ids), so per-worker counts are not comparable across
-        shards. The resulting semantics: a cycle concurrent with the
-        checkpoint may be captured on some shards and not others, which
-        for row-independent embedding state is exactly "that push partially
-        lost in flight" — tolerated by async training. Quiesce workers for
-        an exact global cut. A restarted server inits its range-sliced
-        tables, ``restore``s each, and the service re-seeds versions from
-        the restored push counts. Triggered by
-        :meth:`RemoteSparseWorker.checkpoint_all`; the endpoint writes
-        server-host paths and is unauthenticated — another reason ``bind``
-        defaults to loopback."""
+        """Coordinated multi-server checkpoint, the same cross-shard-atomic
+        protocol as the dense service (pause -> per-worker applied cycles
+        -> cross-shard max -> drain laggards -> save every owned table
+        under ``<dir>[/shard<i>]/<table>`` -> resume). A sparse cycle
+        routes to a SUBSET of shards (per the row ranges of its ids), so
+        bare per-worker counts are not comparable across shards — instead
+        every push carries its worker's global cycle seq AND the fanout
+        set of shards that cycle addressed. Pause reports each worker's
+        last applied (nonce, seq, fanout); the coordinator takes the
+        cross-shard max per worker and ``drain_to`` makes every shard in
+        that cycle's fanout admit the in-flight sub-push before the save —
+        so a cycle is captured on ALL the shards it addressed or none,
+        never torn. (Because a worker's cycles are fully acked before the
+        next begins, at most the LATEST cycle per worker is ever in
+        flight; TCP guarantees its already-fanned-out sub-pushes arrive,
+        and the deadline guards a worker that died mid-fanout.) A
+        restarted server inits its range-sliced tables, ``restore``s each,
+        and the service re-seeds versions from the restored push counts.
+        Triggered by :meth:`RemoteSparseWorker.checkpoint_all`; the
+        endpoint writes server-host paths and is unauthenticated — another
+        reason ``bind`` defaults to loopback."""
         import os
 
         phase = extra.get("phase", "save")
@@ -321,8 +402,12 @@ class SparsePSService(VanService):
                     return tv.encode(tv.ERR, worker, None,
                                      extra={"error": self._ckpt_busy_error()})
                 self._paused = True
+                applied = {str(w): [nonce, seq, fan]
+                           for w, (nonce, seq, fan)
+                           in self._applied_pseq.items()}
             return tv.encode(tv.OK, worker, None, extra={
                 "versions": dict(self.versions), "token": token,
+                "applied_pseq": applied,
             })
         if phase == "resume" and extra.get("force"):
             # operator escape hatch for a coordinator that died holding the
@@ -337,6 +422,44 @@ class SparsePSService(VanService):
         err = self._ckpt_token_error(phase, extra)
         if err is not None:
             return tv.encode(tv.ERR, worker, None, extra={"error": err})
+        if phase == "drain_to":
+            # admit blocked/in-flight sub-pushes until every targeted
+            # worker's applied cycle reaches its cross-shard max, then
+            # report back (dense drain_to's twin, keyed by cycle seq
+            # instead of bare counts). A worker that reconnected mid-round
+            # (nonce mismatch) is treated as satisfied — its old
+            # incarnation's messages can no longer arrive.
+            import time as _time
+
+            targets = {int(w): (t[0], int(t[1]))
+                       for w, t in extra.get("targets", {}).items()}
+            deadline = _time.monotonic() + float(extra.get("timeout", 30.0))
+
+            def lagging(w, nonce, seq):
+                rec = self._applied_pseq.get(w)
+                if rec is None:
+                    return True  # the targeted cycle is still in flight
+                if rec[0] != nonce:
+                    return False  # new incarnation: old stream is dead
+                return rec[1] < seq
+
+            with self._lock:
+                self._drain_targets = targets
+                self._pause_cond.notify_all()
+                while any(lagging(w, n, s) for w, (n, s) in targets.items()):
+                    left = deadline - _time.monotonic()
+                    if left <= 0 or self._draining:
+                        self._drain_targets = {}
+                        return tv.encode(tv.ERR, worker, None, extra={
+                            "error": ("drain_to aborted: server draining"
+                                      if self._draining else
+                                      "drain_to timed out: a worker's "
+                                      "in-flight push never arrived"),
+                        })
+                    self._pause_cond.wait(left)
+                self._drain_targets = {}
+            return tv.encode(tv.OK, worker, None,
+                             extra={"versions": dict(self.versions)})
         if phase == "resume":
             with self._lock:
                 self._paused = False
@@ -359,12 +482,67 @@ class SparsePSService(VanService):
             self._draining = True
             self._pause_cond.notify_all()  # paused pushes wake into refusal
 
+    # -- shard replication hooks (ps_tpu/replica) -----------------------------
+
+    def _service_lock(self):
+        return self._lock
+
+    def _replica_hello_extra(self) -> dict:
+        return {
+            "kind": "sparse",
+            "tables": self._meta,
+            "shard": self.shard,
+            "num_shards": self.num_shards,
+            "versions": dict(self.versions),
+            "start_seq": 0,
+        }
+
+    def _replica_validate(self, extra: dict) -> Optional[str]:
+        if extra.get("kind") != "sparse":
+            return (f"replication stream kind {extra.get('kind')!r} does "
+                    f"not match this sparse service")
+        if extra.get("tables") != self._meta:
+            return "primary and backup disagree on table metadata"
+        if (extra.get("shard"), extra.get("num_shards")) \
+                != (self.shard, self.num_shards):
+            return (f"primary is shard {extra.get('shard')}/"
+                    f"{extra.get('num_shards')}, backup is shard "
+                    f"{self.shard}/{self.num_shards}")
+        if {n: int(v) for n, v in (extra.get("versions") or {}).items()} \
+                != self.versions:
+            return (f"state-point mismatch: primary versions "
+                    f"{extra.get('versions')}, backup {self.versions} — "
+                    f"start the pair from the same initial tables or a "
+                    f"common checkpoint")
+        return None
+
+    def _replica_apply(self, op: str, worker: int, tensors, extra) -> None:
+        # table lock HELD by the dispatcher: apply inline, never through
+        # _apply_push (which re-acquires it)
+        if op != "push":
+            raise ValueError(f"unknown replica op {op!r}")
+        tree = decode_tree(dict(tensors), extra.get("enc"),
+                           stats=self.transport)
+        for name, t in self._split(tree).items():
+            ids = self._localize(name, np.array(t["ids"]))
+            grads = np.array(t["grads"])  # own memory past the frame
+            self._tables[name].push(ids, grads)
+            self.versions[name] += 1
+            self.rows_applied[name] += int(ids.size)
+        if extra.get("pseq") is not None:
+            self._applied_pseq[worker] = (extra.get("pnonce"),
+                                          int(extra["pseq"]),
+                                          list(extra.get("pfan") or []))
+        with self._log_lock:
+            self.apply_log.append(worker)
+
 
 def serve_sparse(tables: Dict[str, Any], port: int = 0,
                  bind: str = "127.0.0.1", shard: Optional[int] = None,
                  num_shards: Optional[int] = None,
                  total_rows: Optional[Dict[str, int]] = None,
-                 ckpt_root: Optional[str] = None
+                 ckpt_root: Optional[str] = None,
+                 backup: bool = False
                  ) -> "SparsePSService":
     """Expose initialized sparse tables to remote worker processes.
 
@@ -373,10 +551,12 @@ def serve_sparse(tables: Dict[str, Any], port: int = 0,
     ``N`` inits each table with ``hi - lo`` rows for
     ``lo, hi = row_range(s, N, total)`` and passes
     ``total_rows={name: total}``. Workers connect with
-    :func:`connect_sparse`."""
+    :func:`connect_sparse`. ``backup=True`` starts in backup role
+    (follows a primary's replication stream until promoted — README
+    "Replication & failover")."""
     return SparsePSService(tables, port=port, bind=bind, shard=shard,
                            num_shards=num_shards, total_rows=total_rows,
-                           ckpt_root=ckpt_root)
+                           ckpt_root=ckpt_root, backup=backup)
 
 
 def connect_sparse(uri: str, worker: int,
@@ -385,7 +565,9 @@ def connect_sparse(uri: str, worker: int,
                    pool_size: Optional[int] = None,
                    compress=None, writev: Optional[bool] = None,
                    shm: Optional[bool] = None,
-                   shm_bytes: Optional[int] = None) -> "RemoteSparseWorker":
+                   shm_bytes: Optional[int] = None,
+                   failover_timeout: Optional[float] = None
+                   ) -> "RemoteSparseWorker":
     """Join a cross-process sparse PS as worker ``worker``.
 
     ``uri`` is ``host:port`` or a comma-separated list naming every server
@@ -402,15 +584,17 @@ def connect_sparse(uri: str, worker: int,
 
     ``writev``/``shm``/``shm_bytes`` select the zero-copy transport lanes
     exactly as in :func:`~ps_tpu.backends.remote_async.connect_async`
-    (README "Transport lanes"; env PS_WRITEV / PS_SHM / PS_SHM_BYTES)."""
-    addrs = []
-    for part in uri.split(","):
-        host, port = part.strip().rsplit(":", 1)
-        addrs.append((host, int(port)))
+    (README "Transport lanes"; env PS_WRITEV / PS_SHM / PS_SHM_BYTES).
+
+    Replica sets: each shard's entry may list replicas separated by ``|``
+    (primary first) — a dead primary is retried against the set within
+    ``failover_timeout`` seconds (README "Replication & failover")."""
+    addrs, replica_sets = parse_replica_uri(uri)
     return RemoteSparseWorker(addrs, worker, tables,
                               bucket_bytes=bucket_bytes, pool_size=pool_size,
                               compress=compress, writev=writev, shm=shm,
-                              shm_bytes=shm_bytes)
+                              shm_bytes=shm_bytes, replica_sets=replica_sets,
+                              failover_timeout=failover_timeout)
 
 
 class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
@@ -435,11 +619,14 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                  pool_size: Optional[int] = None,
                  compress=None, writev: Optional[bool] = None,
                  shm: Optional[bool] = None,
-                 shm_bytes: Optional[int] = None):
+                 shm_bytes: Optional[int] = None,
+                 replica_sets=None,
+                 failover_timeout: Optional[float] = None):
         self._init_multi(list(addrs), worker, tables,
                          bucket_bytes=bucket_bytes, pool_size=pool_size,
                          compress=compress, writev=writev, shm=shm,
-                         shm_bytes=shm_bytes)
+                         shm_bytes=shm_bytes, replica_sets=replica_sets,
+                         failover_timeout=failover_timeout)
 
     def _init_multi(self, addrs: List[Tuple[str, int]], worker: int,
                     tables: Dict[str, Tuple[int, int]],
@@ -447,7 +634,9 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                     pool_size: Optional[int] = None,
                     compress=None, writev: Optional[bool] = None,
                     shm: Optional[bool] = None,
-                    shm_bytes: Optional[int] = None) -> None:
+                    shm_bytes: Optional[int] = None,
+                    replica_sets=None,
+                    failover_timeout: Optional[float] = None) -> None:
         """Fresh dial + validation — ``__init__``'s whole body, factored so
         :meth:`reconnect` re-inits without re-running ``__init__`` on a
         live instance (and so a failed re-dial leaves the identity fields
@@ -480,6 +669,7 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
             )
         self._init_transport(bucket_bytes, pool_size, compress=spec,
                              writev=writev, shm=shm, shm_bytes=shm_bytes)
+        self._init_failover(replica_sets, failover_timeout)
         try:
             self._connect_and_validate(worker)
         except Exception:
@@ -502,13 +692,13 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
 
     def _connect_and_validate(self, worker: int) -> None:
         n = len(self._addrs)
-        for i, (host, port) in enumerate(self._addrs):
-            ch = tv.Channel.connect(host, port)
-            ch.stats = self.transport
+        for i in range(n):
+            # preferred address, or the replica-set member currently
+            # serving as primary (a worker may join mid-promotion)
+            ch, extra = self._hello_any(i)
+            host, port = self._addrs[i]
             self._chs.append(ch)
-            _, _, _, extra = tv.decode(
-                ch.request(tv.encode(tv.HELLO, worker, None))
-            )
+            self._epochs[i] = int(extra.get("epoch") or 0)
             ns = extra.get("num_shards")
             if ns is not None and int(ns) != n:
                 raise ValueError(
@@ -571,6 +761,28 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         """Per-table total applies across all servers."""
         return {n: sum(v) for n, v in self._versions.items()}
 
+    def _validate_failover_hello(self, i: int, extra: dict) -> Optional[str]:
+        """A promoted replica must advertise exactly the row ranges the
+        worker validated for this shard at connect time."""
+        meta = extra.get("tables") or {}
+        if sorted(meta) != sorted(self._spec):
+            return (f"replica of server {i} serves tables {sorted(meta)}, "
+                    f"worker expects {sorted(self._spec)}")
+        for name, m in meta.items():
+            want = next(((lo, hi) for lo, hi, s in self._ranges[name]
+                         if s == i), None)
+            got = (int(m["lo"]), int(m["hi"]))
+            if want is not None and got != want:
+                return (f"replica of server {i} owns {name!r} rows "
+                        f"{got}, worker validated {want}")
+            total, dim = self._spec[name]
+            if int(m["total_rows"]) != total or int(m["dim"]) != dim:
+                return (f"replica of server {i} disagrees on {name!r} "
+                        f"shape")
+            if np.dtype(m["dtype"]) != self._dtype.get(name):
+                return f"replica of server {i} disagrees on {name!r} dtype"
+        return None
+
     # -- protocol -------------------------------------------------------------
 
     def _request(self, i: int, payload):
@@ -579,7 +791,8 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         except tv.VanError as e:
             host, port = self._addrs[i]
             raise ServerFailureError(
-                f"sparse PS server {i} ({host}:{port}) failed mid-job: {e}"
+                f"sparse PS server {i} ({host}:{port}) failed mid-job: {e}",
+                server=i
             ) from e
         with self._bytes_lock:
             self.bytes_pushed += payload_nbytes(payload)
@@ -618,7 +831,7 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
     def _check(self, i: int, msg: memoryview):
         kind, _, tensors, extra = tv.decode(msg)
         if kind != tv.OK:
-            raise RuntimeError(f"server {i} error: {extra.get('error')}")
+            raise self._reply_error(i, extra)
         for name, v in extra.get("versions", {}).items():
             self._versions[name][i] = int(v)
         return tensors
@@ -629,10 +842,15 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         if self._pending_cycles:
             self.flush()  # a pull must not overtake an in-flight push
         reqs, routes = self._build_pull(requests)
-        msgs = self._fanout({
-            i: tv.encode(tv.ROW_PULL, self.worker, t) for i, t in reqs.items()
-        })
-        return self._merge_rows(requests, routes, msgs)
+
+        def once():
+            msgs = self._fanout({
+                i: tv.encode(tv.ROW_PULL, self.worker, t)
+                for i, t in reqs.items()
+            })
+            return self._merge_rows(requests, routes, msgs)
+
+        return self._with_failover(once)
 
     def _build_pull(self, requests):
         reqs: Dict[int, Dict[str, np.ndarray]] = {}
@@ -681,33 +899,54 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         Bucketed transport (``bucket_bytes`` set) slices each server's
         payload into fusion buckets over the pool; the server applies the
         reassembled push as one atomic unit either way."""
+        reqs = self._build_push(pushes, dedupe)
+        pseq, pfan = self._next_push_seq(), sorted(reqs)
         if self.bucket_bytes is not None:
             self.flush()  # keep per-worker push order == epoch order
-            self._push_buckets_sync(self._build_push(pushes, dedupe))
+            self._with_failover(
+                lambda: self._push_buckets_sync(reqs, pseq=pseq, pfan=pfan))
             return
-        msgs = self._fanout({
-            i: self._encode_serial_push(tv.ROW_PUSH, t)
-            for i, t in self._build_push(pushes, dedupe).items()
-        })
-        for i, m in msgs.items():
-            self._check(i, m)
 
-    def _encode_serial_push(self, kind: int, t: Dict[str, np.ndarray]):
+        def once():
+            msgs = self._fanout({
+                i: self._encode_serial_push(tv.ROW_PUSH, t,
+                                            pseq=pseq, pfan=pfan)
+                for i, t in reqs.items()
+            })
+            for i, m in msgs.items():
+                self._check(i, m)
+
+        self._with_failover(once)
+
+    def _encode_serial_push(self, kind: int, t: Dict[str, np.ndarray],
+                            pseq: Optional[int] = None,
+                            pfan: Optional[List[int]] = None):
         """One serial row-push frame, grads compressed per the policy
-        (zero-copy parts when ``writev`` is on, as in the dense worker)."""
+        (zero-copy parts when ``writev`` is on, as in the dense worker),
+        tagged with the (nonce, cycle seq, fanout) token — the dedup key
+        under failover replay AND what the checkpoint drain round compares
+        across shards."""
         t, enc = self._encode_push_tree(t)
-        extra = {"enc": enc} if enc else None
+        extra = {}
+        if enc:
+            extra["enc"] = enc
+        if pseq is not None:
+            extra.update({"pseq": pseq, "pnonce": self._transport_nonce,
+                          "pfan": pfan})
+        extra = extra or None
         if self.writev:
             return tv.encode_parts(kind, self.worker, t, extra)
         return tv.encode(kind, self.worker, t, extra)
 
     # -- bucketed, non-blocking push (the pipelined transport) ----------------
 
-    def _push_buckets_sync(self, reqs: Dict[int, Dict[str, np.ndarray]]
-                           ) -> None:
+    def _push_buckets_sync(self, reqs: Dict[int, Dict[str, np.ndarray]],
+                           pseq: Optional[int] = None,
+                           pfan: Optional[List[int]] = None) -> None:
         """Stripe each server's ``{table/ids, table/grads}`` payload over
         the pool as byte-sliced fusion buckets; the completing bucket's
-        reply carries the committed versions."""
+        reply carries the committed versions. ``pseq``/``pfan`` tag every
+        bucket with the logical push's cycle token (dedup + drain)."""
         self._push_epoch += 1
         epoch = self._push_epoch
         futs: List[Tuple[int, Any]] = []
@@ -726,6 +965,9 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                     tv.ROW_BUCKET_PUSH, self.worker, t, b,
                     extra={"epoch": epoch,
                            "nonce": self._transport_nonce,
+                           "pseq": pseq,
+                           "pnonce": self._transport_nonce,
+                           "pfan": pfan,
                            "enc": enc},
                 )
                 futs.append((i, pumps[b % len(pumps)].submit(payload)))
@@ -750,6 +992,7 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                 "worker with bucket_bytes=... (e.g. 4 << 20)"
             )
         reqs = self._build_push(pushes, dedupe)
+        pseq, pfan = self._next_push_seq(), sorted(reqs)
         pending = PendingCycle(self.transport)
         self._track_pending(pending)
 
@@ -758,7 +1001,8 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
 
             t0 = _time.perf_counter()
             try:
-                self._push_buckets_sync(reqs)
+                self._with_failover(lambda: self._push_buckets_sync(
+                    reqs, pseq=pseq, pfan=pfan))
             except BaseException as e:
                 pending._fail(e)
             else:
@@ -777,29 +1021,41 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         if self._pending_cycles:
             self.flush()  # a cycle must not overtake an in-flight push
         reqs = self._build_push(pushes, dedupe)
+        # the cycle's fanout is the servers receiving GRADS — a pull-only
+        # message must not count toward the drain round's expectations
+        pseq, pfan = self._next_push_seq(), sorted(reqs)
         pull_reqs, routes = self._build_pull(requests)
         for i, t in pull_reqs.items():
             for name_ids, v in t.items():
                 name = name_ids.split("/")[0]
                 reqs.setdefault(i, {})[f"{name}/pull_ids"] = v
-        msgs = self._fanout({
-            i: self._encode_serial_push(tv.ROW_PUSH_PULL, t)
-            for i, t in reqs.items()
-        })
-        return self._merge_rows(requests, routes, msgs)
+
+        def once():
+            msgs = self._fanout({
+                i: self._encode_serial_push(tv.ROW_PUSH_PULL, t,
+                                            pseq=pseq, pfan=pfan)
+                for i, t in reqs.items()
+            })
+            return self._merge_rows(requests, routes, msgs)
+
+        return self._with_failover(once)
 
     def checkpoint_all(self, path: str) -> Dict[str, int]:
-        """Trigger a coordinated checkpoint: pause applies on every
-        server, save each server's tables under ``path``
-        (``path/shard<i>/<table>`` in the partitioned topology), resume.
-        Per-shard atomic; a cycle racing the checkpoint may land on a
-        subset of shards (see :meth:`SparsePSService._checkpoint` for why
-        that is the honest semantics for row-independent state — quiesce
-        workers for an exact cut). Returns the per-table total versions at
-        snapshot time. Restart: each server re-inits its range-sliced
-        tables, ``restore``s each from its shard dir, and serves again
-        (versions resume from the restored push counts); workers
-        :meth:`reconnect`."""
+        """Trigger a coordinated, CROSS-SHARD-ATOMIC checkpoint — the
+        dense protocol's four phases, keyed by cycle seq instead of bare
+        counts: **pause** (every server blocks new applies and reports
+        each worker's last applied (nonce, cycle seq, fanout)),
+        **drain_to** (a cycle may already be applied on one shard of its
+        fanout and in flight to the rest, so every shard in the max
+        cycle's fanout admits exactly the in-flight sub-pushes needed to
+        reach it; TCP guarantees those arrive), **save** (each server
+        writes its tables under ``path``, ``path/shard<i>/<table>`` when
+        partitioned), **resume**. The state on disk therefore captures
+        whole cycles — a push is on every shard it addressed, or none.
+        Returns the per-table total versions at snapshot time. Restart:
+        each server re-inits its range-sliced tables, ``restore``s each
+        from its shard dir, and serves again (versions resume from the
+        restored push counts); workers :meth:`reconnect`."""
         tokens: Dict[int, dict] = {}
         try:
             # pause inside the protected region: a failed round must still
@@ -813,6 +1069,14 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                 tokens = self._ckpt_tokens(e.oks)
                 raise
             tokens = self._ckpt_tokens(paused)
+            drain = self._drain_targets_from_pause(paused)
+            if drain:
+                per_server = {
+                    i: dict(tokens.get(i, {}), targets=drain.get(i, {}))
+                    for i in range(len(self._chs))
+                }
+                self._checkpoint_round({"dir": path, "phase": "drain_to"},
+                                       per_server=per_server)
             saves = self._checkpoint_round({"dir": path, "phase": "save"},
                                            per_server=tokens)
         except BaseException:
@@ -829,6 +1093,46 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
             for n, v in extra["versions"].items():
                 totals[n] += int(v)
         return totals
+
+    def _drain_targets_from_pause(self, paused: Dict[int, dict]
+                                  ) -> Dict[int, Dict[int, list]]:
+        """The dense drain round's cross-shard max, keyed by cycle seq:
+        from each shard's pause report of per-worker (nonce, seq, fanout),
+        find each worker's highest applied cycle, and return per-shard
+        ``{worker: [nonce, seq]}`` targets for exactly the shards in that
+        cycle's fanout that still lag it. Empty = no drain round needed.
+        A worker whose nonce differs across shards reconnected mid-round:
+        its old incarnation's messages can no longer arrive, so it is
+        skipped (its in-flight cycle died with the old connections)."""
+        per_shard: Dict[int, dict] = {
+            i: extra.get("applied_pseq", {}) for i, extra in paused.items()
+        }
+        nonces: Dict[int, str] = {}
+        best: Dict[int, tuple] = {}  # w -> (seq, fan)
+        skip = set()
+        for table in per_shard.values():
+            for w_s, rec in table.items():
+                w, nonce, seq = int(w_s), rec[0], int(rec[1])
+                if w in nonces and nonces[w] != nonce:
+                    skip.add(w)
+                    continue
+                nonces[w] = nonce
+                if w not in best or seq > best[w][0]:
+                    best[w] = (seq, [int(x) for x in (rec[2] or [])])
+        targets: Dict[int, Dict[int, list]] = {}
+        for i in per_shard:
+            t: Dict[int, list] = {}
+            for w, (seq, fan) in best.items():
+                if w in skip or i not in fan:
+                    continue
+                rec = per_shard[i].get(str(w))
+                applied = (int(rec[1]) if rec is not None
+                           and rec[0] == nonces[w] else 0)
+                if applied < seq:
+                    t[w] = [nonces[w], seq]
+            if t:
+                targets[i] = t
+        return targets
 
     def reconnect(self, addrs: Optional[Sequence[Tuple[str, int]]] = None
                   ) -> None:
@@ -854,7 +1158,10 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                 self.worker, dict(self._spec),
                 bucket_bytes=self.bucket_bytes, pool_size=self.pool_size,
                 compress=self.compress, writev=self.writev, shm=self.shm,
-                shm_bytes=self.shm_bytes)
+                shm_bytes=self.shm_bytes,
+                replica_sets=None if addrs is not None
+                else self._replica_sets,
+                failover_timeout=self.failover_timeout)
         finally:
             self._restore_transport_state(saved)
 

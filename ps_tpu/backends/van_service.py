@@ -45,6 +45,70 @@ from ps_tpu.control import tensor_van as tv
 from ps_tpu.utils.metrics import TransportStats
 
 
+class NotServingError(RuntimeError):
+    """Raised inside a handler when this service must refuse the request
+    retryably (it was fenced mid-request, or flipped out of primary). The
+    serve loop encodes it as an ERR reply carrying ``backup: True`` — the
+    same retry-able shape an unpromoted backup sends — so the worker's
+    failover loop re-routes instead of failing the job."""
+
+
+class RingLog:
+    """Fixed-size tail of an append-only log, plus the total count.
+
+    A 10⁶-apply server must not hold O(applies) memory: the services'
+    ``apply_log``/``event_log`` default to this ring (most recent
+    ``maxlen`` entries retained, ``total`` counts everything ever
+    appended). ``record_full_history=True`` swaps in :class:`FullLog`
+    for the replay-parity tests, which genuinely need every entry.
+    """
+
+    def __init__(self, maxlen: int = 4096):
+        import collections
+
+        self._d = collections.deque(maxlen=int(maxlen))
+        self.total = 0
+
+    def append(self, x) -> None:
+        self._d.append(x)
+        self.total += 1
+
+    def __len__(self) -> int:
+        return len(self._d)
+
+    def __iter__(self):
+        return iter(self._d)
+
+    def __repr__(self) -> str:
+        return (f"RingLog(tail={len(self._d)}/{self._d.maxlen}, "
+                f"total={self.total})")
+
+
+class FullLog(list):
+    """Unbounded history (``record_full_history=True``): a plain list —
+    json-serializable, as the replay-parity subprocess dumps require —
+    with the same ``total`` surface as :class:`RingLog`."""
+
+    @property
+    def total(self) -> int:
+        return len(self)
+
+
+def make_history_log(record_full_history: bool, maxlen: int = 4096):
+    return FullLog() if record_full_history else RingLog(maxlen)
+
+
+#: how many trailing log entries a STATS reply ships — bounded even when
+#: the service records full history, so stats frames never grow multi-MB
+STATS_LOG_TAIL = 4096
+
+
+def log_tail(log, n: int = STATS_LOG_TAIL) -> list:
+    """The last ``n`` entries of a RingLog/FullLog as a json-ready list."""
+    entries = list(log)
+    return entries[-n:] if len(entries) > n else entries
+
+
 def resolve_ckpt_dir(root: Optional[str], client_dir: str) -> str:
     """Resolve a client-supplied CHECKPOINT dir under the service's
     ``ckpt_root``.
@@ -85,7 +149,8 @@ class VanService:
 
     def __init__(self, port: int = 0, bind: str = "127.0.0.1",
                  writev: Optional[bool] = None,
-                 shm: Optional[bool] = None):
+                 shm: Optional[bool] = None,
+                 backup: bool = False):
         from ps_tpu.config import env_flag
 
         # vectored replies (scatter-gather send of live snapshot tensors —
@@ -132,6 +197,19 @@ class VanService:
         # concrete services; mutated only under the subclass's apply lock
         self._ckpt_token: Optional[int] = None
         self._ckpt_seq = 0
+        # shard replication & failover (ps_tpu/replica): a backup-role
+        # service applies REPLICA_APPEND events and refuses worker traffic
+        # until promoted; a primary may attach_backup() a session. The
+        # epoch is the shard-table fencing token — promotion bumps it, and
+        # workers refuse to re-route to a lower-epoch (zombie) server.
+        self.role = "backup" if backup else "primary"
+        self.epoch = 0
+        self._primary_epoch = 0       # backup: learned at REPLICA_HELLO
+        self._replica_applied_seq = 0  # backup: last applied stream seq
+        self._replica_attached = False
+        self._backup_session = None    # primary: BackupSession or None
+        self.promote_reason: Optional[str] = None
+        self.promotion_s: Optional[float] = None  # promote() call duration
         self.goodbyes = 0  # workers that sent SHUTDOWN (clean departures)
         self._goodbye_cond = threading.Condition()
         self._accept_thread = threading.Thread(
@@ -150,6 +228,237 @@ class VanService:
 
     def _set_draining(self) -> None:
         raise NotImplementedError
+
+    # replication hooks (only services that support primary/backup pairs
+    # implement these; the base dispatch never calls them otherwise)
+
+    def _service_lock(self):
+        """The apply lock replication serializes against (dense: the
+        engine lock; sparse: the table lock)."""
+        raise NotImplementedError
+
+    def _replica_hello_extra(self) -> dict:
+        """Primary: the attach-time topology + state-point description
+        (called under the apply lock by :meth:`attach_backup`)."""
+        raise NotImplementedError
+
+    def _replica_validate(self, extra: dict) -> Optional[str]:
+        """Backup: refuse a mismatched stream (error string) or accept
+        (None). Must check topology AND the state point — a backup that
+        did not start from the primary's exact state diverges silently."""
+        raise NotImplementedError
+
+    def _replica_apply(self, op: str, worker: int, tensors, extra) -> None:
+        """Backup: apply one replicated event through the local engine.
+        Called with :meth:`_service_lock` HELD (stream order is engine
+        order); must not re-acquire it."""
+        raise NotImplementedError
+
+    # -- replication / promotion ----------------------------------------------
+
+    _REPLICA_KINDS = frozenset({tv.REPLICA_HELLO, tv.REPLICA_APPEND,
+                                tv.REPLICA_PROMOTE, tv.REPLICA_STATE})
+
+    def _dispatch(self, kind: int, worker: int, tensors, extra) -> bytes:
+        """Route one request: replication-plane kinds are handled here;
+        data-plane kinds reach the subclass only on a serving primary — a
+        backup refuses them with a typed, retry-able reply (the worker's
+        failover loop keys off ``extra["backup"]`` to wait out the
+        promotion instead of failing the job)."""
+        if kind in self._REPLICA_KINDS:
+            return self._handle_replica(kind, worker, tensors, extra)
+        if self.role != "primary" and kind != tv.STATS:
+            return tv.encode(tv.ERR, worker, None, extra={
+                "error": (f"shard backup is not serving worker traffic "
+                          f"(role={self.role}, epoch {self.epoch}) — "
+                          f"retry after promotion"),
+                "backup": True, "epoch": self.epoch,
+            })
+        return self._handle(kind, worker, tensors, extra)
+
+    def _handle_replica(self, kind: int, worker: int, tensors,
+                        extra) -> bytes:
+        if kind == tv.REPLICA_STATE:
+            return tv.encode(tv.OK, worker, None, extra=self.replica_state())
+        if kind == tv.REPLICA_PROMOTE:
+            if self.role != "backup":
+                return tv.encode(tv.ERR, worker, None, extra={
+                    "error": f"cannot promote a {self.role} service",
+                })
+            epoch = self.promote(reason=str(extra.get("reason", "request")))
+            return tv.encode(tv.OK, worker, None,
+                             extra={"epoch": epoch, "role": self.role})
+        if self.role != "backup":
+            # a zombie primary still appending after this backup promoted:
+            # refuse WITH the fencing signal — the zombie's session calls
+            # its on_fenced hook and the old primary stops serving workers
+            # instead of forking history (split-brain). The fence lands on
+            # the zombie's next commit attempt; workers that re-routed are
+            # protected sooner by the epoch check in their failover loop.
+            return tv.encode(tv.ERR, worker, None, extra={
+                "error": (f"replication stream refused: this service is "
+                          f"{self.role} (epoch {self.epoch}), not a backup"),
+                "fenced": True, "epoch": self.epoch,
+            })
+        if kind == tv.REPLICA_HELLO:
+            err = self._replica_validate(extra)
+            if err is not None:
+                return tv.encode(tv.ERR, worker, None, extra={"error": err})
+            with self._service_lock():
+                self._primary_epoch = int(extra.get("epoch", 0))
+                self._replica_applied_seq = int(extra.get("start_seq", 0))
+                self._replica_attached = True
+            return tv.encode(tv.OK, worker, None, extra={
+                "applied_seq": self._replica_applied_seq,
+                "epoch": self.epoch,
+            })
+        # REPLICA_APPEND
+        seq = int(extra["seq"])
+        with self._service_lock():
+            if self.role != "backup":
+                return tv.encode(tv.ERR, worker, None, extra={
+                    "error": "promoted mid-append: stream refused",
+                })
+            if not self._replica_attached:
+                return tv.encode(tv.ERR, worker, None, extra={
+                    "error": "REPLICA_APPEND before REPLICA_HELLO",
+                })
+            if seq != self._replica_applied_seq + 1:
+                return tv.encode(tv.ERR, worker, None, extra={
+                    "error": (f"replication gap: expected seq "
+                              f"{self._replica_applied_seq + 1}, got {seq}"),
+                })
+            self._replica_apply(str(extra["op"]),
+                                int(extra.get("w", worker)), tensors, extra)
+            self._replica_applied_seq = seq
+        return tv.encode(tv.OK, worker, None, extra={"applied_seq": seq})
+
+    def promote(self, reason: str = "request") -> int:
+        """The backup→primary transition (idempotent): under the apply
+        lock — so no replica append is mid-apply and no worker push is
+        admitted across the flip — bump the shard-table epoch past the
+        primary's and start serving. Everything the primary committed
+        (sync ack: everything it ever ACKNOWLEDGED to a worker) is already
+        in this engine; there is nothing to rebuild, which is what makes
+        promotion a millisecond flip instead of a restart."""
+        import time as _time
+
+        t0 = _time.perf_counter()
+        with self._service_lock():
+            if self.role == "primary":
+                return self.epoch
+            self.role = "primary"
+            self.epoch = self._primary_epoch + 1
+            self.promote_reason = reason
+        self.promotion_s = _time.perf_counter() - t0
+        logging.getLogger(__name__).warning(
+            "backup promoted to primary (reason=%s, epoch %d) in %.1fms",
+            reason, self.epoch, self.promotion_s * 1e3,
+        )
+        return self.epoch
+
+    def attach_backup(self, host: str, port: int, ack: str = "sync",
+                      window: int = 256, compress=None,
+                      stall_timeout: float = 30.0):
+        """Primary: attach a warm backup and start replicating every
+        commit to it. Attach BEFORE admitting worker traffic (or from a
+        quiesced state): the handshake validates that both replicas stand
+        at the same state point and refuses otherwise — the deltas-only
+        stream cannot catch a backup up past missed commits.
+
+        ``ack="sync"``: push/pull replies wait for the backup's ack —
+        promotion is bitwise-identical to what workers observed.
+        ``ack="async"``: replies return immediately; the backup trails by
+        at most ``window`` commits (metrics-visible ``repl_lag``).
+        ``compress`` optionally runs the replica stream through a
+        stateless gradient codec (ps_tpu/compress)."""
+        from ps_tpu.replica.session import BackupSession
+
+        if self.role != "primary":
+            raise RuntimeError("only a primary can attach a backup")
+        with self._service_lock():
+            old = self._backup_session
+            if old is not None and not old.degraded:
+                raise RuntimeError("a live backup session is already "
+                                   "attached")
+            if old is not None:
+                old.close()  # degraded: replaceable — redundancy must be
+                # restorable without restarting the primary (quiesce,
+                # checkpoint, seed the new backup from it, re-attach)
+            hello = self._replica_hello_extra()
+            hello.update({"epoch": self.epoch, "ack": ack})
+            session = BackupSession(host, port, hello, ack=ack,
+                                    window=window, compress=compress,
+                                    stats=self.transport,
+                                    stall_timeout=stall_timeout)
+            session.on_fenced = self._fence
+            self._backup_session = session
+        return session
+
+    def _fence(self, peer_epoch: int) -> None:
+        """Self-fencing: our backup promoted past us (it refused the
+        replication stream as a primary of ``peer_epoch``). This service
+        is a zombie — stop serving workers so history cannot fork; the
+        retry-able refusal routes still-connected workers to the real
+        primary through their replica sets."""
+        with self._service_lock():
+            if self.role != "primary":
+                return
+            self.role = "fenced"
+        logging.getLogger(__name__).error(
+            "FENCED: this shard's backup promoted to primary (epoch %d) "
+            "while we were still serving — refusing all worker traffic "
+            "from now on (workers re-route via their replica sets)",
+            peer_epoch,
+        )
+
+    def _replicate(self, op: str, worker: int, tensors=None,
+                   meta: Optional[dict] = None) -> Optional[int]:
+        """Primary commit hook (call under the apply lock): append one
+        committed event to the replication stream. None = unreplicated
+        (no session, or it degraded)."""
+        s = self._backup_session
+        if s is None or s.degraded:
+            return None
+        return s.publish(op, worker, tensors, meta or {})
+
+    def _await_replication(self, seq: Optional[int]) -> None:
+        """Sync-ack gate (call OUTSIDE the apply lock, before sending the
+        reply): block until the backup acked ``seq``. No-op for async ack,
+        unreplicated commits, and degraded sessions — EXCEPT a session
+        that degraded because the backup PROMOTED: then this zombie's
+        commit never reached the real primary, so the reply must be a
+        retryable refusal — the worker re-routes and replays the push at
+        the promoted backup (dedup makes it exactly-once), and the commit
+        survives the fence instead of dying with the zombie."""
+        s = self._backup_session
+        if s is None:
+            return
+        if seq is not None and s.ack_mode == "sync":
+            s.wait_acked(seq)
+        # checked for EVERY commit (even unreplicated ones after the
+        # degrade): once fenced, no reply may tell a worker its commit
+        # stuck at this zombie
+        if s.fenced:
+            raise NotServingError(
+                "fenced mid-commit: this shard's backup promoted — retry "
+                "at the new primary"
+            )
+
+    def replica_state(self) -> dict:
+        """Role/epoch/replication introspection (REPLICA_STATE, and merged
+        into both services' STATS replies)."""
+        out = {"role": self.role, "epoch": self.epoch}
+        s = self._backup_session
+        if s is not None:
+            out["repl"] = s.state()
+        if self._replica_attached:
+            out["replica_applied_seq"] = self._replica_applied_seq
+        if self.promote_reason is not None:
+            out["promote_reason"] = self.promote_reason
+            out["promotion_s"] = self.promotion_s
+        out["dedup_hits"] = self.transport.dedup_hits
+        return out
 
     # -- bucketed-push staging -------------------------------------------------
 
@@ -322,7 +631,13 @@ class VanService:
                             ch, worker, extra)
                     else:
                         try:
-                            reply = self._handle(kind, worker, tensors, extra)
+                            reply = self._dispatch(kind, worker, tensors,
+                                                   extra)
+                        except NotServingError as e:  # retryable refusal
+                            reply = tv.encode(tv.ERR, worker, None, extra={
+                                "error": str(e), "backup": True,
+                                "epoch": self.epoch,
+                            })
                         except Exception as e:  # surface to the worker
                             reply = tv.encode(tv.ERR, worker, None,
                                               extra={"error": repr(e)})
@@ -386,6 +701,23 @@ class VanService:
                     return False
                 self._goodbye_cond.wait(left)
         return True
+
+    def kill(self) -> None:
+        """Simulate abrupt process death (failover drills / bench): sever
+        the listener and every connection NOW — no drain, no goodbye, no
+        draining flag, engine state left exactly as a SIGKILL would leave
+        it. Workers observe the same typed connection failure a real
+        primary death produces; an attached backup session degrades."""
+        self._stop.set()
+        self._accept_thread.join(timeout=5)
+        self._listener.close()
+        s = self._backup_session
+        if s is not None:
+            s.close()
+        with self._chan_lock:
+            chans = list(self._channels)
+        for ch in chans:
+            ch.shutdown()  # serve threads wake with VanError and close
 
     def stop(self, grace: float = 10.0) -> None:
         """Graceful drain, then sever. No push is applied after this
@@ -454,3 +786,6 @@ class VanService:
                 "%d serve thread(s) outlived the drain join; their pushes "
                 "are refused by the draining flag", len(stragglers)
             )
+        s = self._backup_session
+        if s is not None:
+            s.close()  # after the drain: every acked commit replicated

@@ -39,6 +39,14 @@ Environment variables honored by :meth:`Config.from_env`:
   (default 16 MiB — cache-resident)
 - ``PS_CKPT_ROOT``          — server side: confine CHECKPOINT saves under
   this root (client paths relative-only, ``..`` refused)
+- ``PS_REPLICAS``           — replica-set size per shard (1 = no
+  replication; 2 = primary + warm backup — ps_tpu/replica)
+- ``PS_REPLICA_ACK``        — 'sync' (push replies wait for the backup's
+  ack; bitwise-identical promotion) or 'async' (bounded lag)
+- ``PS_REPLICA_WINDOW``     — max commits the backup may trail before
+  primaries block (the bounded ack window; default 256)
+- ``PS_FAILOVER_TIMEOUT_MS`` — worker side: how long a shard's replica set
+  is retried (promotion wait included) before the typed failure surfaces
 - ``DMLC_ROLE``, ``DMLC_NUM_WORKER``, ``DMLC_NUM_SERVER``,
   ``DMLC_PS_ROOT_URI``/``_PORT`` are accepted as aliases where the meaning
   is knowable, so reference-family launcher scripts keep working.
@@ -114,6 +122,20 @@ class Config:
       shm_bytes: ring capacity per direction for the shm lane (default
         16 MiB — small enough to stay cache-resident; frames over
         half a ring spill to TCP transparently).
+      replicas: replica-set size per shard (ps_tpu/replica): 1 = classic
+        unreplicated servers; 2 = primary + warm backup with live
+        failover. Launchers size the server fleet with it; workers learn
+        the actual sets from the ``|``-separated server URIs.
+      replica_ack: 'sync' — a push/pull reply waits for the backup's ack,
+        so promotion is bitwise-identical to everything workers observed;
+        'async' — replies return immediately and the backup trails by at
+        most ``replica_window`` commits (metrics-visible lag).
+      replica_window: the bounded ack window: commits the backup may
+        trail before the primary blocks new appends (memory AND lag
+        bound).
+      failover_timeout_ms: worker side — how long each shard's replica
+        set is retried (covering detection + promotion) before a
+        ServerFailureError surfaces.
       heartbeat_base_port: enable the control-plane failure detector for
         multi-process runs. Without ``peer_hosts``, process i's monitor binds
         base_port+i on this host (single-host/localhost topology). With
@@ -176,6 +198,17 @@ class Config:
     # server: confine CHECKPOINT saves under this root (client paths must
     # be relative, '..' escapes refused). None = legacy client-names-path.
     ckpt_root: Optional[str] = None
+    # shard replication & live failover (ps_tpu/replica, README
+    # "Replication & failover"): replica-set size per shard (1 = none),
+    # the ack discipline ('sync' = push replies wait for the backup's ack,
+    # promotion is bitwise-identical to what workers observed; 'async' =
+    # replies return immediately, the backup trails by at most
+    # replica_window commits), and the worker-side window for riding out
+    # a promotion before the typed server failure surfaces
+    replicas: int = 1
+    replica_ack: str = "sync"
+    replica_window: int = 256
+    failover_timeout_ms: int = 10_000
     heartbeat_base_port: Optional[int] = None
     peer_hosts: Optional[str] = None
     heartbeat_bind: Optional[str] = None
@@ -270,6 +303,17 @@ class Config:
                 f"shm_bytes {self.shm_bytes} too small: the ring needs at "
                 f"least 64 KiB per direction to be worth negotiating"
             )
+        if self.replicas < 1:
+            raise ValueError("replicas must be >= 1 (1 = no replication)")
+        if self.replica_ack not in ("sync", "async"):
+            raise ValueError(
+                f"unknown replica_ack {self.replica_ack!r}; use 'sync' "
+                "(bitwise promotion) or 'async' (bounded lag)"
+            )
+        if self.replica_window < 1:
+            raise ValueError("replica_window must be >= 1")
+        if self.failover_timeout_ms < 1:
+            raise ValueError("failover_timeout_ms must be >= 1")
 
     def compress_spec(self) -> Optional[dict]:
         """The normalized codec spec dict workers pass to
@@ -353,6 +397,14 @@ class Config:
             kwargs["shm_bytes"] = int(env["PS_SHM_BYTES"])
         if "PS_CKPT_ROOT" in env:
             kwargs["ckpt_root"] = env["PS_CKPT_ROOT"] or None
+        if "PS_REPLICAS" in env:
+            kwargs["replicas"] = int(env["PS_REPLICAS"])
+        if "PS_REPLICA_ACK" in env:
+            kwargs["replica_ack"] = env["PS_REPLICA_ACK"]
+        if "PS_REPLICA_WINDOW" in env:
+            kwargs["replica_window"] = int(env["PS_REPLICA_WINDOW"])
+        if "PS_FAILOVER_TIMEOUT_MS" in env:
+            kwargs["failover_timeout_ms"] = int(env["PS_FAILOVER_TIMEOUT_MS"])
         if "PS_HEARTBEAT_BASE_PORT" in env:
             kwargs["heartbeat_base_port"] = int(env["PS_HEARTBEAT_BASE_PORT"])
         if "PS_PEER_HOSTS" in env:

@@ -107,6 +107,20 @@ class HeartbeatServer:
         """Beats received from node_id (0 = never seen)."""
         return int(self._lib.hb_server_seq(self._require(), node_id))
 
+    def state(self, node_id: int) -> str:
+        """One node's liveness: 'left' (clean goodbye — permanent), 'dead'
+        (seen-then-silent past the horizon), 'alive', or 'unseen' (never
+        beat — indistinguishable from not-started-yet). The promotion
+        watch (ps_tpu/replica/watch.py) keys its goodbye-vs-timeout
+        distinction off this."""
+        if node_id in self.left():
+            return "left"
+        if node_id in self.dead():
+            return "dead"
+        if node_id in self.alive():
+            return "alive"
+        return "unseen"
+
     def close(self) -> None:
         if self._h:
             self._lib.hb_server_stop(self._h)

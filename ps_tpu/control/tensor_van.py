@@ -56,6 +56,17 @@ SHM_SETUP = 15     # same-host shared-memory lane negotiation: the worker
 #                    names two ring segments + its boot id; an OK reply
 #                    switches the connection's data plane to the rings
 #                    (ps_tpu/control/shm_lane.py), ERR keeps plain TCP
+# shard replication (ps_tpu/replica): a primary service streams its
+# committed updates to a warm backup that can be promoted on primary death
+REPLICA_HELLO = 16    # primary -> backup: attach the replication stream
+#                       (topology + state-point validation; ERR = the pair
+#                       did not start from the same state)
+REPLICA_APPEND = 17   # primary -> backup: ONE sequenced committed event
+#                       (push tensors or a pull record); the ack reply is
+#                       what sync-mode push replies wait on
+REPLICA_PROMOTE = 18  # operator/watchdog -> backup: promote to primary now
+#                       (bumps the shard-table epoch; workers re-route)
+REPLICA_STATE = 19    # -> any service: role/epoch/replication-lag probe
 
 _HDR = struct.Struct("<BIQ")  # kind, worker_id, meta_len
 

@@ -1,0 +1,35 @@
+"""Shard replication & live failover for the parameter-server data plane.
+
+The reference design replicates each key range across servers and fails
+over without restarting the job (Li et al. OSDI'14 §4.3; SURVEY.md §6).
+ps_tpu's tier above the elastic-restart drill: every shard can run a
+primary/backup PAIR —
+
+- the PRIMARY serves workers as before and streams every committed update
+  (push trees, pull records) through a :class:`ReplicationLog` to its
+  backup over the van (:class:`BackupSession`); sync ack withholds the
+  worker's reply until the backup acked (bitwise-identical promotion),
+  async ack bounds the backup's lag by the session window;
+- the BACKUP runs the same service class with ``backup=True``: it applies
+  the replicated stream through its own engine (the replay-parity
+  contract makes this bit-exact) and refuses worker traffic until
+  promoted;
+- PROMOTION is triggered by the existing heartbeat machinery
+  (:class:`PromotionWatch` — goodbye = planned handoff, timeout =
+  failure), bumps the shard-table epoch, and flips the backup to serving;
+- WORKERS carry a replica set per shard: a dead primary's typed failure is
+  retried against the next replica (waiting out the promotion), and
+  per-(worker, seq) dedup tokens make replayed in-flight pushes apply
+  exactly once at the new primary.
+
+See README "Replication & failover" for the topology, the promotion
+timeline, and when to pick sync vs async ack.
+"""
+
+from ps_tpu.replica.log import ReplicationError, ReplicationLog
+from ps_tpu.replica.session import BackupSession
+from ps_tpu.replica.watch import PromotionWatch
+
+__all__ = [
+    "ReplicationLog", "ReplicationError", "BackupSession", "PromotionWatch",
+]

@@ -1,0 +1,144 @@
+"""Sequenced log of one shard's committed updates.
+
+The replication unit is the same event stream the parity tests already
+prove sufficient: replaying a server's ordered (push, pull) events through
+a fresh engine started from the same state reproduces its parameters
+bit-for-bit (tests/test_multiserver_async.py). The primary appends one
+entry per committed event UNDER its apply lock — so log order IS engine
+order — and a :class:`~ps_tpu.replica.session.BackupSession` ships the
+entries to the backup in sequence.
+
+The snapshot half of "snapshot + sequenced deltas" is the state point both
+replicas start from: the initial ``store.init(...)`` tree (primary and
+backup built from the same seed params, as every server of a partition
+already is) or a common checkpoint both restored — validated at attach
+time by the REPLICA_HELLO state-point check, which refuses a mid-stream
+attach instead of silently diverging. The deltas are this log.
+
+The ack window bounds both memory and backup lag: :meth:`append` blocks
+once ``window`` entries are committed-but-unacked. In sync-ack mode the
+push handler additionally waits on :meth:`wait_acked` before replying, so
+a worker never observes a commit the backup does not have (bitwise-
+identical promotion); in async-ack mode the window is the lag bound, and
+the worker may run ahead of the backup by at most ``window`` commits.
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+from typing import Dict, Optional, Tuple
+
+
+class ReplicationError(RuntimeError):
+    """The replication stream could not attach or broke mid-stream."""
+
+
+class ReplicationLog:
+    """Bounded FIFO of committed-but-unacked events, with seq assignment.
+
+    Thread contract: :meth:`append` is called under the service's apply
+    lock (order = engine order); :meth:`take`/:meth:`ack` are driven by the
+    session's sender thread; :meth:`wait_acked` by serve threads outside
+    the apply lock. ``mark_dead`` (backup gone) wakes every waiter so a
+    dead backup degrades the primary to unreplicated instead of wedging it.
+    """
+
+    def __init__(self, window: int = 256, stall_timeout: float = 30.0):
+        self.window = max(int(window), 1)
+        #: how long a full-window append may block before the log declares
+        #: the backup stalled and dies. A backup that is STALLED rather
+        #: than dead (SIGSTOP, blackholed packets — no RST, so no
+        #: VanError) must degrade the primary exactly like a dead one:
+        #: append blocks UNDER the apply lock, so an unbounded wait here
+        #: would wedge the whole shard, not just replication.
+        self.stall_timeout = float(stall_timeout)
+        self._cond = threading.Condition()
+        self._entries: collections.deque = collections.deque()
+        self.next_seq = 1      # seq the NEXT append receives
+        self.acked_seq = 0     # highest seq the backup has acked
+        self.dead = False
+        self.death_reason: Optional[str] = None
+
+    @property
+    def lag(self) -> int:
+        """Commits the backup has not acked yet (the metrics-visible lag)."""
+        with self._cond:
+            return self.next_seq - 1 - self.acked_seq
+
+    def append(self, op: str, worker: int, tensors: Optional[Dict],
+               meta: dict) -> int:
+        """Append one committed event; blocks while the ack window is full
+        (the bounded-lag backpressure), but never past ``stall_timeout`` —
+        a window that stays full that long means the backup hung, and the
+        log dies (degrading the primary) instead of wedging the shard.
+        Returns the entry's seq."""
+        import time
+
+        deadline = time.monotonic() + self.stall_timeout
+        with self._cond:
+            while (not self.dead
+                   and self.next_seq - 1 - self.acked_seq >= self.window):
+                left = deadline - time.monotonic()
+                if left <= 0:
+                    self._die(f"ack window full for {self.stall_timeout:.0f}s"
+                              " — backup stalled")
+                    break
+                self._cond.wait(left)
+            seq = self.next_seq
+            self.next_seq += 1
+            if not self.dead:
+                self._entries.append((seq, op, worker, tensors, meta))
+                self._cond.notify_all()
+            return seq
+
+    def take(self, timeout: Optional[float] = None
+             ) -> Optional[Tuple[int, str, int, Optional[Dict], dict]]:
+        """Sender side: the oldest unsent entry (entries stay queued until
+        acked-and-removed by :meth:`ack`; with the per-entry request/reply
+        session there is at most one in flight). None on timeout/death."""
+        with self._cond:
+            if not self._entries:
+                self._cond.wait(timeout)
+            if self.dead or not self._entries:
+                return None
+            return self._entries[0]
+
+    def ack(self, seq: int) -> None:
+        """The backup acked everything up to ``seq``: drop it, advance the
+        window, wake blocked appenders and sync waiters."""
+        with self._cond:
+            while self._entries and self._entries[0][0] <= seq:
+                self._entries.popleft()
+            if seq > self.acked_seq:
+                self.acked_seq = seq
+            self._cond.notify_all()
+
+    def wait_acked(self, seq: int, timeout: Optional[float] = None) -> bool:
+        """Sync-ack gate: block until the backup acked ``seq`` (True) or
+        the session died (False — the caller proceeds unreplicated)."""
+        import time
+
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            while self.acked_seq < seq and not self.dead:
+                left = None if deadline is None \
+                    else deadline - time.monotonic()
+                if left is not None and left <= 0:
+                    return False
+                self._cond.wait(left)
+            return self.acked_seq >= seq
+
+    def mark_dead(self, reason: Optional[str] = None) -> None:
+        """Backup unreachable: unblock every appender and sync waiter —
+        the primary degrades to unreplicated, loudly, never wedged."""
+        with self._cond:
+            self._die(reason)
+
+    def _die(self, reason: Optional[str]) -> None:
+        # caller holds self._cond
+        if not self.dead:
+            self.dead = True
+            self.death_reason = reason
+        self._entries.clear()
+        self._cond.notify_all()

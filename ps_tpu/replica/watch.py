@@ -1,0 +1,104 @@
+"""Heartbeat-driven promotion: the backup's watchdog over its primary.
+
+The existing control-plane liveness machinery is the trigger (SURVEY.md §6
+failure detection, ps_tpu/control/heartbeat.py): the PRIMARY process runs a
+:class:`~ps_tpu.control.heartbeat.HeartbeatClient` beating the backup's
+watch port from a C++ thread (a GIL pause cannot fake a death); the BACKUP
+runs this watch, which polls its :class:`HeartbeatServer` and promotes the
+local backup service the moment the primary is declared gone — with the
+goodbye-vs-timeout distinction preserved:
+
+- ``left`` (goodbye received): a PLANNED handoff — the primary announced a
+  clean leave (maintenance drain). Promotion is immediate;
+  ``promote_reason == "goodbye"``.
+- ``dead`` (seen-then-silent past the horizon): a FAILURE — promotion fires
+  after the death horizon; ``promote_reason == "timeout"``.
+
+A primary that never beat at all is neither (the detector cannot tell
+"not started yet" from "already dead"); :meth:`wait_for_primary` is the
+rendezvous for drills that must not race the first beat.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+from ps_tpu.control.heartbeat import HeartbeatServer
+
+
+class PromotionWatch:
+    """Poll a heartbeat monitor; promote ``service`` when the primary dies.
+
+    Args:
+      service: the backup-mode service (``promote(reason)`` is called on
+        it exactly once, from the watch thread).
+      primary_id: the heartbeat node id the primary beats with.
+      port/bind/timeout_ms: the local monitor (0 = ephemeral; read
+        :attr:`port` and point the primary's HeartbeatClient at it).
+        ``timeout_ms`` is the death horizon — the floor on
+        kill-to-promotion latency for the timeout path.
+      poll_s: watch poll cadence.
+      on_promote: optional callback ``(reason, detect_to_promote_s)`` —
+        e.g. a StepLogger event hook.
+    """
+
+    def __init__(self, service, primary_id: int, port: int = 0,
+                 bind: str = "127.0.0.1", timeout_ms: int = 1000,
+                 poll_s: float = 0.02, on_promote=None):
+        self.service = service
+        self.primary_id = int(primary_id)
+        self.server = HeartbeatServer(port=port, timeout_ms=timeout_ms,
+                                      bind=bind)
+        self.poll_s = float(poll_s)
+        self.promoted_reason: Optional[str] = None
+        self._on_promote = on_promote
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._loop, daemon=True,
+                                   name="ps-promotion-watch")
+        self._t.start()
+
+    @property
+    def port(self) -> int:
+        return self.server.port
+
+    def wait_for_primary(self, timeout_s: float = 30.0) -> None:
+        """Block until the primary's first beat arrives (so a drill's kill
+        cannot race detector warm-up)."""
+        deadline = time.monotonic() + timeout_s
+        while time.monotonic() < deadline:
+            if self.server.seq(self.primary_id) > 0:
+                return
+            time.sleep(0.01)
+        raise TimeoutError(
+            f"primary (node {self.primary_id}) never heartbeat the watch "
+            f"within {timeout_s}s"
+        )
+
+    def _loop(self) -> None:
+        while not self._stop.is_set():
+            state = self.server.state(self.primary_id)
+            if state in ("left", "dead"):
+                reason = "goodbye" if state == "left" else "timeout"
+                t0 = time.monotonic()
+                self.service.promote(reason=reason)
+                self.promoted_reason = reason
+                if self._on_promote is not None:
+                    try:
+                        self._on_promote(reason, time.monotonic() - t0)
+                    except Exception:
+                        pass  # observer must never kill the watch
+                return
+            time.sleep(self.poll_s)
+
+    def close(self) -> None:
+        self._stop.set()
+        self._t.join(timeout=5)
+        self.server.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

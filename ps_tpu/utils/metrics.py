@@ -89,6 +89,20 @@ class TransportStats:
         self.sleep_wakeups = 0
         self.pool_hits = 0
         self.pool_misses = 0
+        # shard replication & failover (ps_tpu/replica): entries/bytes
+        # shipped to the backup, sync-ack wait time, the current
+        # commits-behind lag gauge, a degraded flag (backup died, primary
+        # continues unreplicated), server-side duplicate-push suppressions
+        # (exactly-once under failover replay), and worker-side failover
+        # events with their re-route latency
+        self.repl_entries = 0
+        self.repl_bytes = 0
+        self.repl_ack_wait_s = 0.0
+        self.repl_lag = 0          # gauge, not cumulative
+        self.repl_degraded = False
+        self.dedup_hits = 0
+        self.failovers = 0
+        self.failover_s = 0.0
 
     def record_vec_send(self, nbytes: int) -> None:
         """One vectored (scatter-gather) send: ``nbytes`` of tensor payload
@@ -124,6 +138,37 @@ class TransportStats:
                 self.pool_hits += 1
             else:
                 self.pool_misses += 1
+
+    def record_repl_entry(self, nbytes: int) -> None:
+        """One replication-log entry acked by the backup (wire bytes)."""
+        with self._lock:
+            self.repl_entries += 1
+            self.repl_bytes += int(nbytes)
+
+    def record_repl_ack_wait(self, seconds: float) -> None:
+        """Time one serve thread spent blocked on a sync replica ack."""
+        with self._lock:
+            self.repl_ack_wait_s += float(seconds)
+
+    def set_repl_lag(self, lag: int) -> None:
+        with self._lock:
+            self.repl_lag = int(lag)
+
+    def set_repl_degraded(self) -> None:
+        with self._lock:
+            self.repl_degraded = True
+
+    def record_dedup_hit(self) -> None:
+        """One duplicate push suppressed by its (worker, seq) token —
+        a replayed in-flight push applied exactly once under failover."""
+        with self._lock:
+            self.dedup_hits += 1
+
+    def record_failover(self, seconds: float) -> None:
+        """One worker-side shard re-route to a promoted replica."""
+        with self._lock:
+            self.failovers += 1
+            self.failover_s += float(seconds)
 
     def lane(self) -> str:
         """Which data-plane lane this endpoint's traffic used: "shm"
@@ -202,7 +247,10 @@ class TransportStats:
                     self.shm_frames, self.shm_frame_bytes,
                     self.shm_spill_frames,
                     self.spin_wakeups, self.sleep_wakeups,
-                    self.pool_hits, self.pool_misses)
+                    self.pool_hits, self.pool_misses,
+                    self.repl_entries, self.repl_bytes,
+                    self.repl_ack_wait_s, self.dedup_hits,
+                    self.failovers, self.failover_s)
 
     def summary(self, since: Optional[tuple] = None) -> Dict[str, float]:
         now = self.snapshot()
@@ -247,6 +295,20 @@ class TransportStats:
             out["sleep_wakeups"] = int(d[17])
         if d[18] + d[19] > 0:
             out["recv_pool_hit_rate"] = round(d[18] / (d[18] + d[19]), 4)
+        # replication & failover: interval deltas for the counters, the
+        # CURRENT lag for the gauge (an interval delta of a gauge is noise)
+        if d[20] > 0 or self.repl_degraded:
+            out["repl_entries"] = int(d[20])
+            out["repl_gb"] = round(d[21] / 1e9, 4)
+            out["repl_ack_wait_s"] = round(d[22], 4)
+            out["repl_lag"] = int(self.repl_lag)
+            if self.repl_degraded:
+                out["repl_degraded"] = True
+        if d[23] > 0:
+            out["dedup_hits"] = int(d[23])
+        if d[24] > 0:
+            out["failovers"] = int(d[24])
+            out["failover_s"] = round(d[25], 4)
         return out
 
 

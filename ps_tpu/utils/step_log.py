@@ -71,6 +71,20 @@ class StepLogger:
                     parts.append(f"{k} {v}")
             print("  ".join(parts), file=self.stream)
 
+    def event(self, name: str, **fields) -> None:
+        """Out-of-band run event (server failover, backup promotion,
+        replication degradation): always printed — regardless of the
+        ``every`` cadence, these are the lines an operator greps for —
+        and appended to the JSONL stream as ``{"event": name, ...}``."""
+        if self._jsonl is not None:
+            self._jsonl.write(json.dumps({"event": name, **fields}) + "\n")
+            self._jsonl.flush()
+        parts = [f"event {name}"]
+        for k, v in fields.items():
+            parts.append(f"{k} {v:.4f}" if isinstance(v, float)
+                         else f"{k} {v}")
+        print("  ".join(parts), file=self.stream)
+
     def close(self) -> None:
         if self._jsonl is not None:
             self._jsonl.close()

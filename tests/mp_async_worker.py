@@ -68,8 +68,11 @@ def run_server(port: int, out_dir: str, nworkers: int, cycles: int,
     ps.init(backend="tpu", mode="async", num_workers=nworkers, dc_lambda=0.04)
     store = ps.KVStore(optimizer="sgd", learning_rate=0.05, mode="async")
     store.init(params)
+    # full history: the parent replays this server's event log bit-for-bit
+    # (the logs are bounded rings by default)
     svc = AsyncPSService(store, port=port, bind="127.0.0.1",
-                         shard=shard, num_shards=nshards)
+                         shard=shard, num_shards=nshards,
+                         record_full_history=True)
     # quiesce on worker SHUTDOWNs, not apply counts: a worker says goodbye
     # only after its final push's reply arrived, so at goodbyes==nworkers
     # nothing is in flight anywhere and stop() cannot race a reply

@@ -1,0 +1,173 @@
+"""Subprocess entries for the real-process failover drill
+(tests/test_replica_failover.py).
+
+Roles (argv[1]):
+  backup <port> <out_dir> <watch_port> <watch_timeout_ms>
+      backup-mode AsyncPSService + PromotionWatch listening for the
+      primary's heartbeat on <watch_port>. Serves the replication stream;
+      on primary death it promotes and serves workers. Exits when the
+      parent writes <out_dir>/done, dumping promote_reason/versions.
+  primary <port> <out_dir> <backup_port> <watch_port> <ack>
+      AsyncPSService + attach_backup(<backup_port>, ack=<ack>) +
+      HeartbeatClient beating the backup's watch. Touches
+      <out_dir>/primary.ready once replication is attached (workers must
+      not connect before — the attach handshake validates the state
+      point). Runs until killed (the drill SIGKILLs it) or until the
+      done file appears (the unkilled reference run).
+  worker <uri> <out_dir> <steps> <kill_at>
+      MNIST-MLP training loop (SGD, dc_lambda=0 — the bitwise-parity
+      regime) against the replica-set <uri>. After step <kill_at>'s
+      push_pull returns it touches <out_dir>/killpoint (the parent's cue
+      to SIGKILL the primary) and keeps stepping straight through the
+      failover. Dumps the full loss curve.
+
+All three build the same MLP(hidden=32) params from seed 0, so primary
+and backup start at the same state point by construction.
+"""
+
+import json
+import os
+import sys
+import time
+
+
+def _params():
+    import jax
+    import jax.numpy as jnp
+
+    from ps_tpu.models.mlp import MLP
+
+    model = MLP(hidden=32)
+    return model, model.init(jax.random.key(0),
+                             jnp.zeros((1, 28, 28, 1)))["params"]
+
+
+def _store(params):
+    import ps_tpu as ps
+
+    st = ps.KVStore(optimizer="sgd", learning_rate=0.1, mode="async")
+    st.init(params)
+    return st
+
+
+def _wait_file(path, timeout=120):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(path):
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def run_backup(port: int, out_dir: str, watch_port: int,
+               watch_timeout_ms: int) -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import ps_tpu as ps
+    from ps_tpu.backends.remote_async import AsyncPSService
+    from ps_tpu.replica import PromotionWatch
+
+    ps.init(backend="tpu", mode="async", num_workers=1, dc_lambda=0.0)
+    _, params = _params()
+    svc = AsyncPSService(_store(params), port=port, bind="127.0.0.1",
+                         backup=True)
+    watch = PromotionWatch(svc, primary_id=1, port=watch_port,
+                           timeout_ms=watch_timeout_ms)
+    _wait_file(os.path.join(out_dir, "done"), timeout=300)
+    with open(os.path.join(out_dir, "backup.json"), "w") as f:
+        json.dump({
+            "promote_reason": svc.promote_reason,
+            "epoch": svc.epoch,
+            "role": svc.role,
+            "version": svc._engine.version,
+            "replica_applied_seq": svc._replica_applied_seq,
+            "dedup_hits": svc.transport.dedup_hits,
+        }, f)
+    watch.close()
+    svc.stop()
+    ps.shutdown()
+    return 0
+
+
+def run_primary(port: int, out_dir: str, backup_port: int,
+                watch_port: int, ack: str) -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import ps_tpu as ps
+    from ps_tpu.backends.remote_async import AsyncPSService
+    from ps_tpu.control.heartbeat import HeartbeatClient
+
+    ps.init(backend="tpu", mode="async", num_workers=1, dc_lambda=0.0)
+    _, params = _params()
+    svc = AsyncPSService(_store(params), port=port, bind="127.0.0.1")
+    svc.attach_backup("127.0.0.1", backup_port, ack=ack)
+    hb = HeartbeatClient("127.0.0.1", watch_port, node_id=1, interval_ms=50)
+    with open(os.path.join(out_dir, "primary.ready"), "w") as f:
+        f.write(str(svc.port))
+    # serve until killed (the drill) or until the run completes (the
+    # reference) — never exits on its own mid-run
+    _wait_file(os.path.join(out_dir, "done"), timeout=300)
+    hb.close(goodbye=False)
+    svc.stop()
+    ps.shutdown()
+    return 0
+
+
+def run_worker(uri: str, out_dir: str, steps: int, kill_at: int) -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    from ps_tpu.backends.remote_async import connect_async
+    from ps_tpu.data.synthetic import mnist_batches
+    from ps_tpu.models.mlp import cross_entropy_loss
+
+    model, params = _params()
+
+    @jax.jit
+    def grad_fn(p, images, labels):
+        def loss_fn(q):
+            return cross_entropy_loss(
+                model.apply({"params": q}, images), labels)
+        return jax.value_and_grad(loss_fn)(p)
+
+    w = connect_async(uri, 0, params, failover_timeout=30.0)
+    losses = []
+    p = w.pull_all()
+    for step, (images, labels) in enumerate(mnist_batches(32, steps=steps)):
+        loss, grads = grad_fn(p, jnp.asarray(images), jnp.asarray(labels))
+        losses.append(float(loss))
+        p = w.push_pull(grads)  # rides the failover when the kill lands
+        if step == kill_at:
+            # parent's cue: SIGKILL the primary NOW — the next push_pull
+            # races real process death
+            with open(os.path.join(out_dir, "killpoint"), "w") as f:
+                f.write(str(step))
+    with open(os.path.join(out_dir, "worker.json"), "w") as f:
+        json.dump({
+            "losses": losses,
+            "failovers": w.transport.failovers,
+            "epochs": w._epochs,
+        }, f)
+    w.close()
+    return 0
+
+
+def main() -> int:
+    role = sys.argv[1]
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    if role == "backup":
+        return run_backup(int(sys.argv[2]), sys.argv[3], int(sys.argv[4]),
+                          int(sys.argv[5]))
+    if role == "primary":
+        return run_primary(int(sys.argv[2]), sys.argv[3], int(sys.argv[4]),
+                           int(sys.argv[5]), sys.argv[6])
+    return run_worker(sys.argv[2], sys.argv[3], int(sys.argv[4]),
+                      int(sys.argv[5]))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

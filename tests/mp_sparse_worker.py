@@ -108,9 +108,12 @@ def run_server(port: int, out_dir: str, nworkers: int, cycles: int,
 
     ps.init(backend="tpu")
     tables = _make_local_tables(shard, nshards)
+    # full history: the parent replays this server's apply log bit-for-bit
+    # (the log is a bounded ring by default)
     svc = SparsePSService(
         tables, port=port, bind="127.0.0.1", shard=shard, num_shards=nshards,
         total_rows={n: v for n, (v, _, _) in TABLES.items()},
+        record_full_history=True,
     )
     # quiesce on worker SHUTDOWNs, not apply counts: a worker says goodbye
     # only after its final push's reply arrived, so at goodbyes==nworkers

@@ -1,0 +1,668 @@
+"""Shard replication & live failover (ps_tpu/replica) — in-process tier.
+
+The real-OS-process kill drill lives in tests/test_replica_failover.py
+(slow-marked); this file covers the protocol fast, with services as
+objects in one process:
+
+- the ReplicationLog's sequencing, bounded ack window, and death wakeup;
+- a backup follows its primary bit-for-bit (dense and sparse) and refuses
+  worker traffic until promoted (typed, retry-able reply);
+- the attach handshake refuses a mid-stream state-point mismatch;
+- (worker, seq) dedup tokens: a replayed push applies exactly once — at
+  the same primary and at a promoted backup;
+- async-ack lag never exceeds the window; a dead backup degrades the
+  primary instead of wedging it;
+- worker failover: serial and bucketed transports ride a kill+promotion
+  transparently, with epoch adoption and exactly-once applies;
+- MNIST-MLP loss parity: a killed-and-failed-over run's loss curve is
+  bitwise-identical to an unkilled reference (sync ack, λ=0);
+- PromotionWatch: goodbye promotes immediately, silence promotes after
+  the horizon (the goodbye-vs-timeout distinction);
+- the sparse checkpoint drain round: snapshots are cross-shard atomic
+  under a concurrent pusher (the dense hammer, ported);
+- bounded apply/event logs: rings by default with STATS tails + totals,
+  full history on opt-in.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import ps_tpu as ps
+from ps_tpu.backends.remote_async import AsyncPSService, connect_async
+from ps_tpu.backends.remote_sparse import (
+    SparsePSService,
+    connect_sparse,
+    row_range,
+)
+from ps_tpu.backends.van_service import FullLog, RingLog
+from ps_tpu.control import tensor_van as tv
+from ps_tpu.kv.sparse import SparseEmbedding
+from ps_tpu.replica import PromotionWatch, ReplicationError, ReplicationLog
+
+
+def _params(n=4, seed=0):
+    rng = np.random.default_rng(seed)
+    return {f"p{i}/w": jnp.asarray(rng.normal(0, 1, (4, 3)).astype(np.float32))
+            for i in range(n)}
+
+
+def _mkstore(params, lr=0.1):
+    st = ps.KVStore(optimizer="sgd", learning_rate=lr, mode="async")
+    st.init(params)
+    return st
+
+
+def _pair(params, ack="sync", **kw):
+    """primary + attached backup + the session."""
+    prim = AsyncPSService(_mkstore(params), bind="127.0.0.1", **kw)
+    back = AsyncPSService(_mkstore(params), bind="127.0.0.1", backup=True,
+                          **kw)
+    sess = prim.attach_backup("127.0.0.1", back.port, ack=ack)
+    return prim, back, sess
+
+
+# -- ReplicationLog -----------------------------------------------------------
+
+
+def test_replication_log_sequences_and_acks():
+    log = ReplicationLog(window=8)
+    s1 = log.append("push", 0, None, {})
+    s2 = log.append("pull", 1, None, {})
+    assert (s1, s2) == (1, 2)
+    assert log.lag == 2
+    seq, op, w, _, _ = log.take(timeout=0.1)
+    assert (seq, op, w) == (1, "push", 0)
+    log.ack(1)
+    assert log.lag == 1 and log.acked_seq == 1
+    assert log.take(timeout=0.1)[0] == 2
+    log.ack(2)
+    assert log.wait_acked(2, timeout=0.1)
+
+
+def test_replication_log_window_blocks_and_death_wakes():
+    log = ReplicationLog(window=2)
+    log.append("push", 0, None, {})
+    log.append("push", 0, None, {})
+    blocked = threading.Event()
+    seqs = []
+
+    def appender():
+        blocked.set()
+        seqs.append(log.append("push", 0, None, {}))  # window full: blocks
+
+    t = threading.Thread(target=appender)
+    t.start()
+    blocked.wait(1)
+    time.sleep(0.05)
+    assert not seqs, "append slipped past a full window"
+    log.ack(1)  # window opens
+    t.join(timeout=2)
+    assert seqs == [3]
+    # death wakes a sync waiter with False
+    t2 = threading.Thread(target=log.mark_dead)
+    t2.start()
+    assert log.wait_acked(3, timeout=2) is False
+    t2.join()
+
+
+# -- bounded history logs -----------------------------------------------------
+
+
+def test_replication_log_full_window_stall_dies_not_wedges():
+    """A backup that stops acking WITHOUT dying (no VanError) must not
+    block appends — which run under the apply lock — forever: the bounded
+    wait expires and the log dies (primary degrades to unreplicated)."""
+    log = ReplicationLog(window=2, stall_timeout=0.2)
+    log.append("push", 0, None, {})
+    log.append("push", 0, None, {})
+    t0 = time.monotonic()
+    seq = log.append("push", 0, None, {})  # full window, nobody acking
+    assert seq == 3
+    assert 0.15 <= time.monotonic() - t0 < 5.0
+    assert log.dead and "stalled" in log.death_reason
+
+
+def test_ring_log_bounded_with_total():
+    log = RingLog(maxlen=8)
+    for i in range(100):
+        log.append(i)
+    assert len(log) == 8 and log.total == 100
+    assert list(log) == list(range(92, 100))
+    full = FullLog()
+    full.append(1)
+    assert full.total == 1 and list(full) == [1]
+
+
+def test_service_logs_are_rings_and_stats_ships_tail(request):
+    params = _params()
+    ps.init(backend="tpu", mode="async", num_workers=1, dc_lambda=0.0)
+    request.addfinalizer(ps.shutdown)
+    svc = AsyncPSService(_mkstore(params), bind="127.0.0.1", history=8)
+    w = connect_async(f"127.0.0.1:{svc.port}", 0, params)
+    try:
+        w.pull_all()
+        grads = {k: jnp.full_like(v, 0.1) for k, v in params.items()}
+        for _ in range(12):
+            w.push_all(grads)
+        assert isinstance(svc.apply_log, RingLog)
+        assert len(svc.apply_log) == 8 and svc.apply_log.total == 12
+        st = w.stats()
+        assert st["apply_log_total"] == 12
+        assert len(st["apply_log"]) == 8  # the tail, never the full list
+        # opt-in keeps everything (the replay-parity contract's shape)
+        svc2 = AsyncPSService(_mkstore(params), bind="127.0.0.1",
+                              record_full_history=True)
+        assert isinstance(svc2.apply_log, FullLog)
+        svc2.stop()
+    finally:
+        w.close()
+        svc.stop()
+
+
+# -- replication: follow, gate, dedup ----------------------------------------
+
+
+def test_backup_follows_primary_bitwise_and_serves_after_promotion(request):
+    params = _params()
+    ps.init(backend="tpu", mode="async", num_workers=1, dc_lambda=0.0)
+    request.addfinalizer(ps.shutdown)
+    prim, back, sess = _pair(params, ack="sync")
+    uri = f"127.0.0.1:{prim.port}|127.0.0.1:{back.port}"
+    w = connect_async(uri, 0, params, failover_timeout=10.0)
+    try:
+        w.pull_all()
+        grads = {k: jnp.full_like(v, 0.1) for k, v in params.items()}
+        for _ in range(3):
+            w.push_pull(grads)
+        # sync ack: every acknowledged commit is already on the backup
+        assert sess.lag == 0
+        assert prim._engine.version == back._engine.version == 3
+        a = prim._engine.pull_tree(worker=0)
+        b = back._engine.pull_tree(worker=0)
+        for k in a:
+            np.testing.assert_array_equal(np.asarray(a[k]),
+                                          np.asarray(b[k]), err_msg=k)
+        # a backup refuses worker traffic with the typed retry-able reply
+        ch = tv.Channel.connect("127.0.0.1", back.port)
+        kind, _, _, extra = tv.decode(
+            ch.request(tv.encode(tv.HELLO, 9, None)))
+        assert kind == tv.ERR and extra["backup"] is True
+        ch.close()
+        # kill + promote: the worker re-routes and continues
+        prim.kill()
+        back.promote(reason="test")
+        assert back.epoch == 1
+        w.push_pull(grads)
+        assert back._engine.version == 4
+        assert w._epochs[0] == 1
+        assert w.transport.failovers == 1
+        st = w.stats()
+        assert st["role"] == "primary" and st["epoch"] == 1
+    finally:
+        w.close()
+        back.stop()
+
+
+def test_attach_refuses_state_point_mismatch(request):
+    params = _params()
+    ps.init(backend="tpu", mode="async", num_workers=1, dc_lambda=0.0)
+    request.addfinalizer(ps.shutdown)
+    prim = AsyncPSService(_mkstore(params), bind="127.0.0.1")
+    back = AsyncPSService(_mkstore(params), bind="127.0.0.1", backup=True)
+    w = connect_async(f"127.0.0.1:{prim.port}", 0, params)
+    try:
+        w.pull_all()
+        w.push_all({k: jnp.full_like(v, 0.1) for k, v in params.items()})
+        # primary moved past the backup's state: deltas can't catch it up
+        with pytest.raises(ReplicationError, match="state-point mismatch"):
+            prim.attach_backup("127.0.0.1", back.port)
+    finally:
+        w.close()
+        prim.stop()
+        back.stop()
+
+
+def test_dedup_replay_applies_exactly_once(request):
+    """The same (nonce, seq) push twice: applied once, acked twice."""
+    params = _params()
+    ps.init(backend="tpu", mode="async", num_workers=1, dc_lambda=0.0)
+    request.addfinalizer(ps.shutdown)
+    svc = AsyncPSService(_mkstore(params), bind="127.0.0.1")
+    w = connect_async(f"127.0.0.1:{svc.port}", 0, params)
+    try:
+        w.pull_all()
+        sub = {k: np.full(np.asarray(v).shape, 0.1, np.float32)
+               for k, v in params.items()}
+        payload = tv.encode(tv.PUSH, 0, sub,
+                            extra={"pseq": 7, "pnonce": "abc"})
+        ch = tv.Channel.connect("127.0.0.1", svc.port)
+        kind, _, _, extra = tv.decode(ch.request(bytes(payload)))
+        assert kind == tv.OK and extra["dedup"] is False
+        v1 = svc._engine.version
+        # the replay (an in-flight push whose reply died): acked, unapplied
+        kind, _, _, extra = tv.decode(ch.request(bytes(payload)))
+        assert kind == tv.OK and extra["dedup"] is True
+        assert svc._engine.version == v1
+        assert svc.transport.dedup_hits == 1
+        # a NEWER seq from the same incarnation applies
+        payload2 = tv.encode(tv.PUSH, 0, sub,
+                             extra={"pseq": 8, "pnonce": "abc"})
+        kind, _, _, extra = tv.decode(ch.request(bytes(payload2)))
+        assert kind == tv.OK and extra["dedup"] is False
+        assert svc._engine.version == v1 + 1
+        # a new incarnation (different nonce) resets the stream
+        payload3 = tv.encode(tv.PUSH, 0, sub,
+                             extra={"pseq": 1, "pnonce": "xyz"})
+        kind, _, _, extra = tv.decode(ch.request(bytes(payload3)))
+        assert kind == tv.OK and extra["dedup"] is False
+        ch.close()
+    finally:
+        w.close()
+        svc.stop()
+
+
+def test_dedup_survives_promotion(request):
+    """A push applied at the primary and replicated, whose reply died with
+    it, is replayed at the promoted backup — and suppressed there."""
+    params = _params()
+    ps.init(backend="tpu", mode="async", num_workers=1, dc_lambda=0.0)
+    request.addfinalizer(ps.shutdown)
+    prim, back, _ = _pair(params, ack="sync")
+    try:
+        sub = {k: np.full(np.asarray(v).shape, 0.1, np.float32)
+               for k, v in params.items()}
+        payload = tv.encode(tv.PUSH, 0, sub,
+                            extra={"pseq": 3, "pnonce": "inc1"})
+        ch = tv.Channel.connect("127.0.0.1", prim.port)
+        kind, _, _, _ = tv.decode(ch.request(bytes(payload)))
+        assert kind == tv.OK
+        ch.close()
+        assert back._engine.version == 1  # replicated (sync ack)
+        prim.kill()
+        back.promote(reason="test")
+        # the worker never saw the reply and replays at the new primary
+        ch = tv.Channel.connect("127.0.0.1", back.port)
+        kind, _, _, extra = tv.decode(ch.request(bytes(payload)))
+        assert kind == tv.OK and extra["dedup"] is True
+        assert back._engine.version == 1  # exactly once
+        assert back.transport.dedup_hits == 1
+        ch.close()
+    finally:
+        back.stop()
+
+
+def test_async_ack_lag_bounded_by_window(request, monkeypatch):
+    params = _params(n=2)
+    ps.init(backend="tpu", mode="async", num_workers=1, dc_lambda=0.0)
+    request.addfinalizer(ps.shutdown)
+    prim = AsyncPSService(_mkstore(params), bind="127.0.0.1")
+    back = AsyncPSService(_mkstore(params), bind="127.0.0.1", backup=True)
+    # a slow backup: every replica apply takes a beat
+    orig = back._replica_apply
+
+    def slow_apply(op, worker, tensors, extra):
+        time.sleep(0.02)
+        orig(op, worker, tensors, extra)
+
+    monkeypatch.setattr(back, "_replica_apply", slow_apply)
+    window = 4
+    sess = prim.attach_backup("127.0.0.1", back.port, ack="async",
+                              window=window)
+    w = connect_async(f"127.0.0.1:{prim.port}", 0, params)
+    try:
+        w.pull_all()
+        grads = {k: jnp.full_like(v, 0.1) for k, v in params.items()}
+        worst = 0
+        for _ in range(16):
+            w.push_all(grads)
+            worst = max(worst, sess.lag)
+        assert worst <= window, f"lag {worst} exceeded window {window}"
+        assert worst > 0, "degenerate: the backup never lagged at all"
+        # the stream drains after the burst
+        deadline = time.monotonic() + 10
+        while sess.lag > 0 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert sess.lag == 0
+        assert back._engine.version == prim._engine.version == 16
+    finally:
+        w.close()
+        prim.stop()
+        back.stop()
+
+
+def test_dead_backup_degrades_primary_not_wedges(request, tmp_path):
+    params = _params(n=2)
+    ps.init(backend="tpu", mode="async", num_workers=1, dc_lambda=0.0)
+    request.addfinalizer(ps.shutdown)
+    prim, back, sess = _pair(params, ack="sync")
+    w = connect_async(f"127.0.0.1:{prim.port}", 0, params)
+    try:
+        w.pull_all()
+        grads = {k: jnp.full_like(v, 0.1) for k, v in params.items()}
+        w.push_all(grads)
+        back.kill()  # the backup dies mid-job
+        # sync-ack pushes must complete (degraded), not hang forever
+        for _ in range(3):
+            w.push_all(grads)
+        assert prim._engine.version == 4
+        assert sess.degraded
+        st = w.stats()
+        assert st["repl"]["degraded"] is True
+        # redundancy is RESTORABLE without restarting the primary: seed a
+        # fresh backup from a checkpoint of the live state and re-attach —
+        # the dead session is replaced, not "already attached"
+        ck = str(tmp_path / "reseed")
+        prim._store.save(ck)
+        st2 = _mkstore(params)
+        st2.restore(ck)
+        back2 = AsyncPSService(st2, bind="127.0.0.1", backup=True)
+        sess2 = prim.attach_backup("127.0.0.1", back2.port)
+        w.push_all(grads)
+        assert sess2.lag == 0  # replication is live again (sync ack)
+        assert back2._engine.version == prim._engine.version == 5
+        back2.stop()
+    finally:
+        w.close()
+        prim.stop()
+        back.stop()
+
+
+def test_zombie_primary_fenced_and_commit_survives(request):
+    """Split-brain containment: the backup promotes while the old primary
+    is still ALIVE and serving (asymmetric partition). The zombie's next
+    commit is refused by its own backup, it self-fences, the in-flight
+    reply becomes a retryable refusal, and the worker replays at the real
+    primary — the commit survives the fence, exactly once."""
+    params = _params()
+    ps.init(backend="tpu", mode="async", num_workers=1, dc_lambda=0.0)
+    request.addfinalizer(ps.shutdown)
+    prim, back, sess = _pair(params, ack="sync")
+    uri = f"127.0.0.1:{prim.port}|127.0.0.1:{back.port}"
+    w = connect_async(uri, 0, params, failover_timeout=10.0)
+    try:
+        w.pull_all()
+        grads = {k: jnp.full_like(v, 0.1) for k, v in params.items()}
+        w.push_pull(grads)
+        w.push_pull(grads)
+        # the partition: the backup promotes, the primary never died
+        back.promote(reason="partition-drill")
+        # zombie's next commit → backup refuses the stream → self-fence →
+        # retryable refusal → worker re-routes and replays
+        w.push_pull(grads)
+        assert prim.role == "fenced"
+        assert sess.fenced and sess.degraded
+        assert w._epochs[0] == 1 and w.transport.failovers >= 1
+        # the commit landed at the REAL primary, exactly once
+        assert back._engine.version == 3
+        # and further traffic flows through the new primary only
+        w.push_pull(grads)
+        assert back._engine.version == 4
+    finally:
+        w.close()
+        prim.stop()
+        back.stop()
+
+
+# -- failover through the bucketed transport ---------------------------------
+
+
+def test_bucketed_transport_failover_exactly_once(request):
+    params = _params(n=6, seed=3)
+    ps.init(backend="tpu", mode="async", num_workers=1, dc_lambda=0.0)
+    request.addfinalizer(ps.shutdown)
+    prim, back, _ = _pair(params, ack="sync")
+    uri = f"127.0.0.1:{prim.port}|127.0.0.1:{back.port}"
+    w = connect_async(uri, 0, params, bucket_bytes=1 << 10, pool_size=2,
+                      failover_timeout=10.0)
+    try:
+        w.pull_all()
+        grads = {k: jnp.full_like(v, 0.01) for k, v in params.items()}
+        for _ in range(3):
+            w.push_pull(grads)
+        prim.kill()
+        back.promote(reason="test")
+        for _ in range(3):
+            w.push_pull(grads)
+        # exactly-once across the re-route: 3 pre-kill + 3 post-kill
+        # logical pushes, plus the pulls — version counts whole-tree
+        # applies only
+        assert back._engine.version == 6
+        assert w.transport.failovers >= 1
+    finally:
+        w.close()
+        back.stop()
+
+
+# -- MNIST-MLP loss parity across a failover ----------------------------------
+
+
+def test_mnist_failover_loss_curve_bitwise_vs_unkilled(request):
+    """Kill the primary mid-training: with sync ack (and λ=0 — the DC
+    correction depends on pull history, which failover necessarily
+    perturbs), the post-failover loss curve is BITWISE the unkilled run's.
+    """
+    from ps_tpu.data.synthetic import mnist_batches
+    from ps_tpu.models.mlp import MLP, cross_entropy_loss
+
+    model = MLP(hidden=32)
+    params0 = model.init(jax.random.key(0),
+                         jnp.zeros((1, 28, 28, 1)))["params"]
+
+    @jax.jit
+    def grad_fn(p, images, labels):
+        def loss_fn(q):
+            return cross_entropy_loss(
+                model.apply({"params": q}, images), labels)
+        return jax.value_and_grad(loss_fn)(p)
+
+    steps, bs, kill_at = 10, 32, 5
+    ps.init(backend="tpu", mode="async", num_workers=1, dc_lambda=0.0)
+    request.addfinalizer(ps.shutdown)
+
+    def run(kill):
+        prim, back, _ = _pair(params0, ack="sync")
+        uri = f"127.0.0.1:{prim.port}|127.0.0.1:{back.port}"
+        w = connect_async(uri, 0, params0, failover_timeout=10.0)
+        losses = []
+        try:
+            p = w.pull_all()
+            for step, (images, labels) in enumerate(
+                    mnist_batches(bs, steps=steps)):
+                if kill and step == kill_at:
+                    prim.kill()
+                    back.promote(reason="drill")
+                loss, grads = grad_fn(p, jnp.asarray(images),
+                                      jnp.asarray(labels))
+                losses.append(float(loss))
+                p = w.push_pull(grads)
+        finally:
+            w.close()
+            if not kill:
+                prim.kill()
+            back.stop()
+        return losses
+
+    ref = run(kill=False)
+    drill = run(kill=True)
+    np.testing.assert_array_equal(np.array(drill), np.array(ref))
+    assert drill[-1] < drill[0], "model did not learn"
+
+
+# -- PromotionWatch: goodbye vs timeout ---------------------------------------
+
+
+class _FakeService:
+    def __init__(self):
+        self.reason = None
+        self.promoted = threading.Event()
+
+    def promote(self, reason):
+        self.reason = reason
+        self.promoted.set()
+        return 1
+
+
+def test_promotion_watch_goodbye_vs_timeout():
+    from ps_tpu.control.heartbeat import HeartbeatClient
+
+    # goodbye: a planned handoff promotes immediately (well under the
+    # death horizon)
+    svc = _FakeService()
+    watch = PromotionWatch(svc, primary_id=1, timeout_ms=2000)
+    hb = HeartbeatClient("127.0.0.1", watch.port, node_id=1, interval_ms=50)
+    watch.wait_for_primary()
+    t0 = time.monotonic()
+    hb.close(goodbye=True)
+    assert svc.promoted.wait(2), "goodbye never promoted"
+    assert svc.reason == "goodbye"
+    assert time.monotonic() - t0 < 1.5
+    watch.close()
+
+    # silence: promotion only after the horizon, reason 'timeout'
+    svc2 = _FakeService()
+    watch2 = PromotionWatch(svc2, primary_id=1, timeout_ms=400)
+    hb2 = HeartbeatClient("127.0.0.1", watch2.port, node_id=1,
+                          interval_ms=50)
+    watch2.wait_for_primary()
+    t0 = time.monotonic()
+    hb2.close(goodbye=False)  # abrupt death: just stops beating
+    assert svc2.promoted.wait(5), "silence never promoted"
+    assert svc2.reason == "timeout"
+    assert time.monotonic() - t0 >= 0.3  # not before the horizon
+    watch2.close()
+
+
+# -- sparse: replication, failover, and the checkpoint drain round ------------
+
+
+SPARSE_TABLES = {"deep": (64, 8), "wide": (64, 1)}
+
+
+def _one_device_mesh():
+    # a 1-device mesh: under the 8-virtual-device test env a mesh-less
+    # SparseEmbedding shards over every device, and two services' applies
+    # running collectives from concurrent threads deadlock
+    return jax.sharding.Mesh(np.array(jax.devices()[:1]), ("data",))
+
+
+def _sparse_tables(shard, nshards, seed=11, mesh=None):
+    mesh = mesh or _one_device_mesh()
+    tables = {}
+    for name, (total, dim) in SPARSE_TABLES.items():
+        lo, hi = row_range(shard, nshards, total)
+        emb = SparseEmbedding(hi - lo, dim, optimizer="sgd",
+                              learning_rate=0.1, mesh=mesh)
+        rng = np.random.default_rng([seed, dim])
+        emb.init(rng.normal(0, 0.01, (total, dim)).astype(np.float32)[lo:hi])
+        tables[name] = emb
+    return tables
+
+
+def _sparse_push(seed):
+    rng = np.random.default_rng(seed)
+    out = {}
+    for name, (total, dim) in SPARSE_TABLES.items():
+        ids = rng.integers(0, total, 16).astype(np.int32)
+        out[name] = (ids, rng.normal(0, 0.1, (16, dim)).astype(np.float32))
+    return out
+
+
+def test_sparse_replication_failover_bitwise(request):
+    ps.init(backend="tpu")
+    request.addfinalizer(ps.shutdown)
+    prim = SparsePSService(_sparse_tables(0, 1), bind="127.0.0.1")
+    back = SparsePSService(_sparse_tables(0, 1), bind="127.0.0.1",
+                           backup=True)
+    prim.attach_backup("127.0.0.1", back.port, ack="sync")
+    uri = f"127.0.0.1:{prim.port}|127.0.0.1:{back.port}"
+    spec = {n: (t, d) for n, (t, d) in SPARSE_TABLES.items()}
+    w = connect_sparse(uri, 0, spec, failover_timeout=10.0)
+    try:
+        for c in range(3):
+            w.push(_sparse_push(c))
+        assert back.versions == prim.versions
+        for name in SPARSE_TABLES:
+            np.testing.assert_array_equal(
+                np.asarray(prim._tables[name].table),
+                np.asarray(back._tables[name].table), err_msg=name)
+        prim.kill()
+        back.promote(reason="test")
+        w.push(_sparse_push(99))
+        rows = w.pull({n: np.arange(4, dtype=np.int32)
+                       for n in SPARSE_TABLES})
+        assert all(np.isfinite(r).all() for r in rows.values())
+        assert back.versions["deep"] == 4
+        assert w.transport.failovers >= 1
+    finally:
+        w.close()
+        back.stop()
+
+
+def test_sparse_checkpoint_cross_shard_atomic_under_pushes(request, tmp_path):
+    """The ported drain round's reason to exist (dense hammer, sparse
+    twin): every cycle here routes rows to BOTH shards, so in any
+    cross-shard-atomic snapshot the two shards' per-table push counts are
+    EQUAL. A snapshot torn by an in-flight cycle would capture (n, n+1).
+    Hammer checkpoints under a concurrent pusher and assert every snapshot
+    is untorn."""
+    ps.init(backend="tpu")
+    request.addfinalizer(ps.shutdown)
+    nshards = 2
+    total_rows = {n: t for n, (t, _) in SPARSE_TABLES.items()}
+    svcs = [SparsePSService(_sparse_tables(s, nshards), bind="127.0.0.1",
+                            shard=s, num_shards=nshards,
+                            total_rows=total_rows)
+            for s in range(nshards)]
+    uri = ",".join(f"127.0.0.1:{s.port}" for s in svcs)
+    spec = {n: (t, d) for n, (t, d) in SPARSE_TABLES.items()}
+    pusher = connect_sparse(uri, 0, spec)
+    ckpter = connect_sparse(uri, 1, spec)
+    stop = threading.Event()
+
+    def full_range_push(c):
+        # ids span the whole row space: every cycle addresses both shards
+        out = {}
+        for name, (total, dim) in SPARSE_TABLES.items():
+            ids = np.arange(total, dtype=np.int32)
+            rng = np.random.default_rng([c, dim])
+            out[name] = (ids,
+                         rng.normal(0, 0.01, (total, dim)).astype(np.float32))
+        return out
+
+    def push_loop():
+        c = 0
+        while not stop.is_set():
+            pusher.push(full_range_push(c))
+            c += 1
+
+    t = threading.Thread(target=push_loop)
+    t.start()
+    try:
+        for i in range(5):
+            ck = str(tmp_path / f"ck{i}")
+            ckpter.checkpoint_all(ck)
+            for name, (total, dim) in SPARSE_TABLES.items():
+                counts = []
+                for s in range(nshards):
+                    lo, hi = row_range(s, nshards, total)
+                    emb = SparseEmbedding(hi - lo, dim, optimizer="sgd",
+                                          learning_rate=0.1,
+                                          mesh=_one_device_mesh())
+                    emb.init(np.zeros((hi - lo, dim), np.float32))
+                    emb.restore(f"{ck}/shard{s}/{name}")
+                    counts.append(emb.push_count)
+                assert counts[0] == counts[1], \
+                    f"torn snapshot {i} for {name!r}: {counts}"
+    finally:
+        stop.set()
+        t.join(timeout=30)
+    assert not t.is_alive()
+    pusher.close()
+    ckpter.close()
+    for s in svcs:
+        s.stop()

@@ -1,0 +1,162 @@
+"""Real-OS-process failover drill — the acceptance scenario of the
+replication subsystem (ISSUE 4; Li et al. OSDI'14 §4.3 live server
+failover), with real processes and a real SIGKILL:
+
+  primary + warm backup (replication attached, heartbeat flowing)
+    → worker trains MNIST-MLP through the primary
+    → SIGKILL the primary mid-training (the worker's next push races
+      real process death)
+    → the backup's PromotionWatch declares it dead on the heartbeat
+      horizon and promotes — reason "timeout", never "goodbye"
+    → the worker re-routes through its replica set, replays its
+      in-flight push (dedup token: exactly once), and the job CONTINUES
+      — no restart, no restore.
+
+Sync-ack leg: the post-failover loss curve is BITWISE-IDENTICAL to an
+unkilled reference run of the same topology (every acknowledged commit
+was on the backup before the worker saw the ack; λ=0 so applies are
+pull-history-free). Async-ack leg: at most the ack window diverges — the
+pre-kill prefix is still bitwise, the run continues and learns.
+
+Slow-marked (three subprocesses × two runs per leg): excluded from
+tier-1, run explicitly via ``pytest -m slow tests/test_replica_failover.py``.
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+_WORKER = os.path.join(os.path.dirname(__file__), "mp_replica_worker.py")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+STEPS, KILL_AT = 12, 5
+
+
+def _free_port(udp=False):
+    kind = socket.SOCK_DGRAM if udp else socket.SOCK_STREAM
+    with socket.socket(socket.AF_INET, kind) as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _spawn(*args):
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.Popen(
+        [sys.executable, _WORKER, *map(str, args)],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+
+
+def _wait_file(path, timeout=180):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if os.path.exists(path):
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def _run_drill(out_dir, ack, kill):
+    """One full topology run; returns (worker.json, backup.json)."""
+    out_dir.mkdir()
+    prim_port, back_port = _free_port(), _free_port()
+    watch_port = _free_port(udp=True)
+    backup = _spawn("backup", back_port, out_dir, watch_port, 500)
+    primary = _spawn("primary", prim_port, out_dir, back_port,
+                     watch_port, ack)
+    procs = [backup, primary]
+    try:
+        assert _wait_file(out_dir / "primary.ready"), \
+            "primary never attached its backup:\n" + (
+                primary.communicate(timeout=5)[0]
+                if primary.poll() is not None else "(still running)")
+        uri = f"127.0.0.1:{prim_port}|127.0.0.1:{back_port}"
+        worker = _spawn("worker", uri, out_dir, STEPS,
+                        KILL_AT if kill else -1)
+        procs.append(worker)
+        if kill:
+            assert _wait_file(out_dir / "killpoint"), "worker never reached " \
+                "the kill step"
+            primary.send_signal(signal.SIGKILL)
+            primary.wait(timeout=10)
+            assert primary.returncode == -signal.SIGKILL
+        wout = worker.communicate(timeout=240)[0]
+        assert worker.returncode == 0, f"worker:\n{wout}"
+        with open(out_dir / "done", "w") as f:
+            f.write("1")
+        bout = backup.communicate(timeout=60)[0]
+        assert backup.returncode == 0, f"backup:\n{bout}"
+        if not kill:
+            pout = primary.communicate(timeout=60)[0]
+            assert primary.returncode == 0, f"primary:\n{pout}"
+        with open(out_dir / "worker.json") as f:
+            w = json.load(f)
+        with open(out_dir / "backup.json") as f:
+            b = json.load(f)
+        return w, b
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+            p.wait(timeout=10)
+
+
+@pytest.mark.slow
+def test_kill_primary_mid_push_sync_ack_bitwise_continuation(tmp_path):
+    """The headline acceptance drill: SIGKILL mid-training, promotion on
+    the heartbeat timeout, the job continues WITHOUT restart, and the
+    sync-ack loss curve is bitwise the unkilled reference's."""
+    ref_w, ref_b = _run_drill(tmp_path / "ref", "sync", kill=False)
+    assert ref_b["role"] == "backup"  # never promoted in the reference
+    assert len(ref_w["losses"]) == STEPS
+
+    drill_w, drill_b = _run_drill(tmp_path / "drill", "sync", kill=True)
+    # promotion happened, via the heartbeat TIMEOUT path (a SIGKILLed
+    # process sends no goodbye)
+    assert drill_b["role"] == "primary"
+    assert drill_b["promote_reason"] == "timeout"
+    assert drill_b["epoch"] == 1
+    # the worker re-routed (at least one failover) and finished every step
+    assert drill_w["failovers"] >= 1
+    assert drill_w["epochs"] == [1]
+    assert len(drill_w["losses"]) == STEPS
+    # bitwise continuation: killed curve == unkilled curve, loss for loss
+    np.testing.assert_array_equal(np.array(drill_w["losses"]),
+                                  np.array(ref_w["losses"]))
+    assert drill_w["losses"][-1] < drill_w["losses"][0], "did not learn"
+    # every step's push applied exactly once at the surviving replica:
+    # STEPS pushes + the replays suppressed by dedup (version counts
+    # whole-tree applies only)
+    assert drill_b["version"] == STEPS
+
+
+@pytest.mark.slow
+def test_kill_primary_mid_push_async_ack_bounded_divergence(tmp_path):
+    """Async ack trades the per-commit backup round trip for a bounded
+    window of loss on failover: the pre-kill prefix is still bitwise the
+    reference's, and the run continues and learns — but the post-kill
+    curve MAY diverge by whatever the window had not replicated."""
+    ref_w, _ = _run_drill(tmp_path / "ref", "async", kill=False)
+    drill_w, drill_b = _run_drill(tmp_path / "drill", "async", kill=True)
+    assert drill_b["role"] == "primary"
+    assert drill_b["promote_reason"] == "timeout"
+    assert len(drill_w["losses"]) == STEPS
+    # losses up to the kill step were computed from pre-kill params:
+    # identical to the reference
+    np.testing.assert_array_equal(
+        np.array(drill_w["losses"][:KILL_AT + 1]),
+        np.array(ref_w["losses"][:KILL_AT + 1]))
+    # after: bounded divergence — finite, and training still progresses
+    post = np.array(drill_w["losses"][KILL_AT + 1:])
+    assert np.isfinite(post).all()
+    assert drill_w["losses"][-1] < drill_w["losses"][0], "did not learn"

@@ -57,6 +57,30 @@ def test_bench_transport_smoke():
     assert d["transport"]["transport_buckets"] > 0
 
 
+def test_bench_failover_smoke():
+    """bench.py --model failover: the replication PR's acceptance gauge —
+    must report steady-state replication overhead (sync + async legs) and
+    a kill-to-first-successful-push latency with the backup promoted on
+    the heartbeat timeout. (Not marked slow: ~6 s at --quick scale.)"""
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py"),
+         "--model", "failover", "--quick"],
+        env=env, capture_output=True, text=True, timeout=420,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["metric"] == "failover_kill_to_first_push_s"
+    assert out["value"] > 0
+    d = out["detail"]
+    assert d["baseline_cycles_per_s"] > 0
+    assert d["sync_repl_cycles_per_s"] > 0
+    assert d["async_repl_cycles_per_s"] > 0
+    assert d["promote_reason"] == "timeout"
+
+
 @pytest.mark.slow
 def test_bench_dc_asgd_smoke():
     out = _run("bench_dc_asgd.py", "--applies", "12", "--eval-every", "6",

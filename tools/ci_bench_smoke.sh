@@ -1,8 +1,13 @@
 #!/usr/bin/env bash
-# Transport-lane smoke (<60 s): runs `bench.py --model transport --quick`
-# on the CPU backend and asserts that BOTH the bucketed-TCP lane and the
-# same-host shared-memory lane actually move data, printing the per-lane
-# GB/s. Referenced from the README next to tools/ci_tier1.sh.
+# Bench smoke (<60 s per leg), referenced from the README next to
+# tools/ci_tier1.sh:
+#   1. transport: `bench.py --model transport --quick` — asserts BOTH the
+#      bucketed-TCP lane and the same-host shared-memory lane move data,
+#      printing the per-lane GB/s.
+#   2. failover: `bench.py --model failover --quick` — spawns a
+#      primary+backup pair, severs the primary (SIGKILL-equivalent),
+#      asserts the heartbeat-triggered promotion completed and the worker's
+#      next push landed, printing the kill-to-recovery latency.
 #
 # Usage: tools/ci_bench_smoke.sh   (from the repo root)
 set -euo pipefail
@@ -30,4 +35,25 @@ assert det["shm_lane_stats"]["shm_frames"] > 0, \
     "shm lane negotiated but no frames rode the rings"
 print(f"  shm/tcp wire speedup: {det['shm_speedup_vs_bucketed_tcp']}x")
 print("transport smoke OK")
+EOF
+
+out=$(timeout -k 10 120 env JAX_PLATFORMS=cpu python bench.py --model failover --quick 2>/dev/null | tail -1)
+python - "$out" <<'EOF'
+import json
+import sys
+
+rec = json.loads(sys.argv[1])
+det = rec["detail"]
+assert det["promote_reason"] == "timeout", \
+    f"backup never promoted on the heartbeat timeout: {det['promote_reason']}"
+assert rec["value"] and rec["value"] > 0, "no post-failover push landed"
+assert det["baseline_cycles_per_s"] > 0 and det["sync_repl_cycles_per_s"] > 0
+print(f"  baseline          {det['baseline_cycles_per_s']:8.1f} cycles/s")
+print(f"  sync-ack pair     {det['sync_repl_cycles_per_s']:8.1f} cycles/s "
+      f"({det['sync_overhead_x']}x overhead)")
+print(f"  async-ack pair    {det['async_repl_cycles_per_s']:8.1f} cycles/s "
+      f"({det['async_overhead_x']}x overhead)")
+print(f"  kill -> first successful push: {rec['value']}s "
+      f"(heartbeat horizon {det['heartbeat_timeout_ms']}ms)")
+print("failover smoke OK")
 EOF
