@@ -498,6 +498,19 @@ def bench_transport(args, retried: bool):
     run_cycles(ws, 1)  # warm both sides' allocators
     serial_gbps = max(run_cycles(ws, cycles)[0] for _ in range(2))
 
+    # tracing overhead: the SAME serial worker with every op sampled
+    # (trace_sample=1.0 — every push_pull opens spans on both sides and
+    # carries the context header) vs the trace-off serial_gbps above.
+    # The off path must be free (<1% — the acceptance bar); the on path
+    # shows what full sampling costs, which is why trace_sample exists.
+    from ps_tpu import obs as _obs
+
+    _obs.tracer().sample = 1.0
+    trace_on_gbps = max(run_cycles(ws, cycles)[0] for _ in range(2))
+    _obs.tracer().sample = 0.0
+    trace_overhead_pct = (round(100.0 * (1.0 - trace_on_gbps / serial_gbps),
+                                2) if serial_gbps else None)
+
     # serial path with the legacy staging-bytearray framing: the delta to
     # serial_gbps is exactly the deleted per-frame staging copy
     wl = connect_async(uri, 1, tree, writev=False)
@@ -575,6 +588,8 @@ def bench_transport(args, retried: bool):
             "cycles": cycles,
             "retried": retried,
             "serial_gbps": round(serial_gbps, 3),
+            "trace_on_gbps": round(trace_on_gbps, 3),
+            "trace_overhead_pct": trace_overhead_pct,
             "serial_staged_gbps": round(serial_staged_gbps, 3),
             "writev_speedup_vs_staged": round(
                 serial_gbps / serial_staged_gbps, 3)
@@ -717,22 +732,90 @@ def bench_failover(args, retried: bool):
     prim_c.stop()
     back_c.stop()
 
-    # the drill: heartbeat-triggered promotion on abrupt primary death
+    wb.close()
+    prim.stop()
+    back.stop()
+
+    # the drill, traced end to end: TWO shards (shard 0 = primary + warm
+    # backup, shard 1 plain — the smallest "cluster" where a push fans
+    # out) with trace_sample=1.0, so the kill+promotion leaves one
+    # Perfetto timeline where the worker push span links to each
+    # primary's apply span and the backup's replica_append/ack spans.
+    import os
+
+    from ps_tpu import obs
+    from ps_tpu.backends.remote_async import shard_tree
+    from ps_tpu.kv import keys as keymod
+
+    obs.tracer().sample = 1.0
+
+    # the drill's own small tree, built so BOTH shards of the hash
+    # partition own keys (the bench tree's names may all land on one
+    # shard — then killing the other would drill nothing)
+    dtree = {}
+    want = {0: 3, 1: 3}
+    i = 0
+    while any(want.values()):
+        name = f"t{i:04d}/w"
+        s = keymod.shard_for_key(name, 2)
+        if want[s]:
+            want[s] -= 1
+            dtree[name] = rng.normal(0, 1, (256, 256)).astype(np.float32)
+        i += 1
+    dgrads = {k: rng.normal(0, 1e-3, v.shape).astype(np.float32)
+              for k, v in dtree.items()}
+
+    def mkshard(s):
+        st = ps.KVStore(optimizer="sgd", learning_rate=0.01, mode="async")
+        st.init(shard_tree(dtree, s, 2))
+        return st
+
+    s0p = AsyncPSService(mkshard(0), bind="127.0.0.1", shard=0,
+                         num_shards=2)
+    s0b = AsyncPSService(mkshard(0), bind="127.0.0.1", shard=0,
+                         num_shards=2, backup=True)
+    s0p.attach_backup("127.0.0.1", s0b.port, ack="sync")
+    s1 = AsyncPSService(mkshard(1), bind="127.0.0.1", shard=1,
+                        num_shards=2)
+    wd = connect_async(
+        f"127.0.0.1:{s0p.port}|127.0.0.1:{s0b.port},127.0.0.1:{s1.port}",
+        3, dtree, failover_timeout=30.0)
+    wd.pull_all()
+    wd.push_pull(dgrads)  # a traced steady-state cycle across both shards
     hb_timeout_ms = 400
-    watch = PromotionWatch(back, primary_id=1, timeout_ms=hb_timeout_ms)
+    watch = PromotionWatch(s0b, primary_id=1, timeout_ms=hb_timeout_ms)
     hb = HeartbeatClient("127.0.0.1", watch.port, node_id=1, interval_ms=50)
     watch.wait_for_primary()
     t_kill = time.monotonic()
-    prim.kill()   # sever everything NOW — what SIGKILL leaves behind
+    s0p.kill()    # sever everything NOW — what SIGKILL leaves behind
     hb.close()    # the dead process stops beating (no goodbye)
-    wb.push_pull(grads)  # rides the replica set through the promotion
+    wd.push_pull(dgrads)  # rides the replica set through the promotion
     kill_to_push_s = time.monotonic() - t_kill
-    promote_reason = back.promote_reason
-    promotion_s = back.promotion_s
-    failover_s = wb.transport.failover_s
+    promote_reason = s0b.promote_reason
+    promotion_s = s0b.promotion_s
+    failover_s = wd.transport.failover_s
+    obs.tracer().sample = 0.0
+
+    # export the merged timeline + verify the cross-hop span linkage the
+    # obs layer exists for: worker op -> primary apply -> backup append
+    spans = obs.tracer().spans()
+    worker_ids = {s.span_id for s in spans if s.cat == "worker"}
+    server_applies = [s for s in spans if s.cat == "server"
+                      and s.name in ("push", "push_pull", "bucket_push")
+                      and s.parent_id in worker_ids]
+    srv_ids = {s.span_id for s in server_applies}
+    n_append = sum(1 for s in spans if s.name == "replica_append"
+                   and s.parent_id in srv_ids)
+    n_ack = sum(1 for s in spans if s.name == "replica_ack_wait"
+                and s.parent_id in srv_ids)
+    trace_linked = bool(server_applies and n_append and n_ack)
+    trace_path = obs.tracer().export_chrome(os.path.join(
+        os.environ.get("PS_TRACE_DIR") or ".", "failover_trace.json"))
+    flight_events = obs.flight().total
     watch.close()
-    wb.close()
-    back.stop()
+    wd.close()
+    s0b.stop()
+    s1.stop()
     ps.shutdown()
 
     print(json.dumps({
@@ -758,6 +841,11 @@ def bench_failover(args, retried: bool):
             "promotion_s": promotion_s,
             "worker_failover_s": round(failover_s, 4),
             "kill_to_first_push_s": round(kill_to_push_s, 3),
+            "drill_shards": 2,
+            "trace_file": trace_path,
+            "trace_spans": len(spans),
+            "trace_linked": trace_linked,
+            "flight_events": flight_events,
             "note": (
                 "loopback van, serial push_pull on one dense async shard; "
                 "sync/async legs replicate every commit to a warm backup "
@@ -769,7 +857,11 @@ def bench_failover(args, retried: bool):
                 "PromotionWatch promotes on the heartbeat timeout, and "
                 "kill_to_first_push_s is wall clock from the kill to the "
                 "worker's next successful push_pull (detection + "
-                "promotion + re-route + apply)"
+                "promotion + re-route + apply); the drill itself runs "
+                "2 shards (shard 0 replicated) with trace_sample=1.0 — "
+                "trace_file is the Perfetto timeline and trace_linked "
+                "asserts the worker push span parents the primary apply "
+                "span and the backup's replica_append/ack spans"
             ),
         },
     }))

@@ -10,12 +10,14 @@ from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import numpy as np
 import optax
 
+from ps_tpu import obs
 from ps_tpu.control import tensor_van as tv
 from ps_tpu.optim.dc import delay_compensate
 from ps_tpu.utils.metrics import TransportStats
@@ -56,6 +58,32 @@ def parse_replica_uri(uri: str):
         primaries.append(cands[0])
         sets.append(cands)
     return primaries, sets
+
+
+class _OpScope:
+    """The per-op observability scope :meth:`BucketedTransportMixin._op`
+    returns — a plain slotted object, not a generator contextmanager, so
+    the unsampled hot path allocates one small object and nothing else."""
+
+    __slots__ = ("_transport", "_name", "_sp", "_t0")
+
+    def __init__(self, transport, name: str, sp):
+        self._transport = transport
+        self._name = name
+        self._sp = sp
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        self._sp.__enter__()
+        return self._sp
+
+    def __exit__(self, *exc):
+        try:
+            self._sp.__exit__(*exc)
+        finally:
+            self._transport.record_op(
+                self._name, time.perf_counter() - self._t0)
+        return False
 
 
 #: Default fusion-bucket size for the pipelined transport. ~4 MiB is the
@@ -425,6 +453,33 @@ class BucketedTransportMixin:
         self._compressor = (GradCompressor(policy, stats=self.transport)
                             if policy is not None else None)
 
+    def _op(self, name: str, **args) -> "_OpScope":
+        """One logical transport op's observability envelope: a root
+        trace span (sampled per ``trace_sample`` — the NOOP singleton
+        otherwise) AND an always-on latency histogram sample. Use::
+
+            with self._op("push") as sp:
+                ...  # sp.wire() propagates the context, None unsampled
+
+        The span/histogram cover the op end to end, failover retries
+        included — the latency a training loop actually feels."""
+        sp = obs.tracer().span(name, cat="worker")
+        if sp:
+            sp.set(worker=getattr(self, "worker", 0), **args)
+        return _OpScope(self.transport, name, sp)
+
+    @staticmethod
+    def _tc_extra(extra: Optional[dict], sp) -> Optional[dict]:
+        """Merge a span's wire context into a frame's ``extra`` (returns
+        ``extra`` unchanged — possibly None — when the op is unsampled,
+        so untraced frames are byte-identical to the pre-obs wire)."""
+        wire = sp.wire() if sp else None
+        if wire is None:
+            return extra
+        out = dict(extra or {})
+        out[obs.WIRE_KEY] = wire
+        return out
+
     def _encode_push_tree(self, arrays: Dict[str, np.ndarray]
                           ) -> Tuple[Dict[str, np.ndarray], List[str]]:
         """Apply the compression policy to one server's push payload;
@@ -716,6 +771,9 @@ class BucketedTransportMixin:
             self._open_pumps([i])
         dt = time.monotonic() - t0
         self.transport.record_failover(dt)
+        obs.record_event("failover", shard=i, addr=f"{addr[0]}:{addr[1]}",
+                         epoch=self._epochs[i], seconds=round(dt, 4),
+                         cause=repr(cause))
         logging.getLogger(__name__).warning(
             "%s %d re-routed to %s:%d (epoch %d) in %.2fs",
             self._failure_noun, i, *addr, self._epochs[i], dt,

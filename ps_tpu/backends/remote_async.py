@@ -36,6 +36,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ps_tpu import obs
 from ps_tpu.backends.common import (
     DEFAULT_BUCKET_BYTES,
     BucketAssembler,
@@ -419,6 +420,9 @@ class AsyncPSService(VanService):
                 # of only in server stderr (codec-PR satellite)
                 "stale_epochs": self.transport.stale_epochs,
                 "stale_epoch_buckets": self.transport.stale_epoch_buckets,
+                # the extended STATS frame (ps_tpu/obs): rate gauges plus
+                # p50/p99/p999 latency distributions — what ps_top renders
+                "metrics": self.transport.metrics_snapshot(),
             }
             out.update(self.replica_state())
             return tv.encode(tv.OK, worker, None, extra=out)
@@ -1051,13 +1055,18 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
     def pull_all(self) -> Any:
         """Fetch current params (each server records this worker's snapshot
         of its subtree)."""
-        if self.bucket_bytes is not None:
-            self.flush()
+        with self._op("pull") as sp:
+            if self.bucket_bytes is not None:
+                self.flush()
+                return self._with_failover(
+                    lambda: self._merge_host_params(
+                        self._pull_buckets(tc=sp.wire())))
+            extra = self._tc_extra(None, sp)
             return self._with_failover(
-                lambda: self._merge_host_params(self._pull_buckets()))
-        return self._with_failover(lambda: self._merge_params(self._fanout({
-            i: tv.encode(tv.PULL, self.worker, None) for i in self._active
-        })))
+                lambda: self._merge_params(self._fanout({
+                    i: tv.encode(tv.PULL, self.worker, None, extra=extra)
+                    for i in self._active
+                })))
 
     def push_all(self, grads) -> None:
         """Push a gradient tree; each owner applies its subtree immediately
@@ -1069,25 +1078,29 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         replication stream) acks without re-applying."""
         by_owner = self._split_by_owner(grads)
         pseq = self._next_push_seq()
-        if self.bucket_bytes is not None:
-            self.flush()
-            self._with_failover(
-                lambda: self._push_buckets_sync(by_owner, pseq=pseq))
-            return
+        with self._op("push") as sp:
+            tc = sp.wire()
+            if self.bucket_bytes is not None:
+                self.flush()
+                self._with_failover(
+                    lambda: self._push_buckets_sync(by_owner, pseq=pseq,
+                                                    tc=tc))
+                return
 
-        def once():
-            msgs = self._fanout({
-                i: self._encode_serial_push(tv.PUSH, sub, pseq=pseq)
-                for i, sub in by_owner.items()
-            })
-            for i, msg in msgs.items():
-                kind, _, _, extra = tv.decode(msg)
-                if kind != tv.OK:
-                    raise RuntimeError(
-                        f"server {i} error: {extra.get('error')}")
-                self.versions[i] = int(extra["version"])
+            def once():
+                msgs = self._fanout({
+                    i: self._encode_serial_push(tv.PUSH, sub, pseq=pseq,
+                                                tc=tc)
+                    for i, sub in by_owner.items()
+                })
+                for i, msg in msgs.items():
+                    kind, _, _, extra = tv.decode(msg)
+                    if kind != tv.OK:
+                        raise RuntimeError(
+                            f"server {i} error: {extra.get('error')}")
+                    self.versions[i] = int(extra["version"])
 
-        self._with_failover(once)
+            self._with_failover(once)
 
     def push_pull(self, grads) -> Any:
         """push_all + pull_all in ONE round trip per server (the async
@@ -1097,29 +1110,34 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         whole tree and snapshots the same atomic pull)."""
         by_owner = self._split_by_owner(grads)
         pseq = self._next_push_seq()
-        if self.bucket_bytes is not None:
-            self.flush()  # a cycle racing a serial call would reorder epochs
+        with self._op("push_pull") as sp:
+            tc = sp.wire()
+            if self.bucket_bytes is not None:
+                self.flush()  # a cycle racing a serial call would
+                # reorder epochs
 
-            def once_bucketed():
-                self._push_buckets_sync(by_owner, pseq=pseq)
-                return self._merge_host_params(self._pull_buckets())
+                def once_bucketed():
+                    self._push_buckets_sync(by_owner, pseq=pseq, tc=tc)
+                    return self._merge_host_params(self._pull_buckets(tc=tc))
 
-            return self._with_failover(once_bucketed)
-        return self._with_failover(
-            lambda: self._merge_params(self._fanout({
-                i: self._encode_serial_push(tv.PUSH_PULL, sub, pseq=pseq)
-                for i, sub in by_owner.items()
-            })))
+                return self._with_failover(once_bucketed)
+            return self._with_failover(
+                lambda: self._merge_params(self._fanout({
+                    i: self._encode_serial_push(tv.PUSH_PULL, sub,
+                                                pseq=pseq, tc=tc)
+                    for i, sub in by_owner.items()
+                })))
 
     # -- bucketed, pipelined transport (worker half) --------------------------
 
     def _encode_serial_push(self, kind: int, sub: Dict[str, np.ndarray],
-                            pseq: Optional[int] = None):
+                            pseq: Optional[int] = None, tc=None):
         """One serial push frame, compressed per the policy (the packed-key
         list rides the frame's extra, as on the bucketed path) and tagged
-        with the (nonce, seq) dedup token. With ``writev`` on, the frame
-        travels as zero-copy parts — the grad tensors go to the kernel as
-        iovecs instead of through a staging bytearray (the measurable
+        with the (nonce, seq) dedup token plus the op's trace context
+        (``tc``, when sampled). With ``writev`` on, the frame travels as
+        zero-copy parts — the grad tensors go to the kernel as iovecs
+        instead of through a staging bytearray (the measurable
         serial-path win at BERT-size trees)."""
         sub, enc = self._encode_push_tree(sub)
         extra = {}
@@ -1128,6 +1146,8 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         if pseq is not None:
             extra["pseq"] = pseq
             extra["pnonce"] = self._transport_nonce
+        if tc is not None:
+            extra[obs.WIRE_KEY] = tc
         extra = extra or None
         if self.writev:
             return tv.encode_parts(kind, self.worker, sub, extra)
@@ -1142,7 +1162,7 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
             )
 
     def _push_buckets_sync(self, by_owner: Dict[int, Dict[str, np.ndarray]],
-                           pseq: Optional[int] = None) -> None:
+                           pseq: Optional[int] = None, tc=None) -> None:
         """Slice each owner's subtree into fusion buckets, stripe them over
         the connection pool, wait for every ack, and adopt the committed
         versions. The engine sees ONE whole-tree apply per server, exactly
@@ -1167,14 +1187,15 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
             # shm ring's)
             enc_bucket = plan.bucket_encoder(self.writev)
             for b in range(plan.nbuckets):
-                payload = enc_bucket(
-                    tv.BUCKET_PUSH, self.worker, sub, b,
-                    extra={"epoch": epoch,
-                           "nonce": self._transport_nonce,
-                           "pseq": pseq,
-                           "pnonce": self._transport_nonce,
-                           "enc": enc},
-                )
+                extra = {"epoch": epoch,
+                         "nonce": self._transport_nonce,
+                         "pseq": pseq,
+                         "pnonce": self._transport_nonce,
+                         "enc": enc}
+                if tc is not None:
+                    extra[obs.WIRE_KEY] = tc
+                payload = enc_bucket(tv.BUCKET_PUSH, self.worker, sub, b,
+                                     extra=extra)
                 futs.append((i, pumps[b % len(pumps)].submit(payload)))
         for i, fut in futs:
             reply = self._bucket_reply(i, fut)
@@ -1185,7 +1206,7 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
             if extra.get("committed"):
                 self.versions[i] = int(extra["version"])
 
-    def _pull_buckets(self) -> Dict[str, np.ndarray]:
+    def _pull_buckets(self, tc=None) -> Dict[str, np.ndarray]:
         """Bucketed pull: bucket 0 snapshots each server's subtree (and
         names the bucket count); the rest stream over the pool. Requests go
         out front-of-model first, so the keys the next forward needs first
@@ -1193,12 +1214,18 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         self._pull_epoch += 1
         epoch = self._pull_epoch
         pull_spec = self._pull_compress_spec()
+
+        def _extra(b: int, **kw) -> dict:
+            out = {"epoch": epoch, "bucket": b, **kw}
+            if tc is not None:
+                out[obs.WIRE_KEY] = tc
+            return out
+
         first = {
             i: self._pumps[i][0].submit(tv.encode(
                 tv.BUCKET_PULL, self.worker, None,
-                extra={"epoch": epoch, "bucket": 0,
-                       "bucket_bytes": self.bucket_bytes,
-                       "compress": pull_spec},
+                extra=_extra(0, bucket_bytes=self.bucket_bytes,
+                             compress=pull_spec),
             ))
             for i in self._active
         }
@@ -1225,7 +1252,7 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
             pumps = self._pumps[i]
             for b in range(1, n):
                 payload = tv.encode(tv.BUCKET_PULL, self.worker, None,
-                                    extra={"epoch": epoch, "bucket": b})
+                                    extra=_extra(b))
                 rest.append((i, pumps[b % len(pumps)].submit(payload)))
         for i, fut in rest:
             reply = self._bucket_reply(i, fut)
@@ -1273,11 +1300,16 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
     def _run_cycle(self, by_owner, pseq: int, pending: PendingCycle) -> None:
         t0 = time.perf_counter()
         try:
-            def once():
-                self._push_buckets_sync(by_owner, pseq=pseq)
-                return self._merge_host_params(self._pull_buckets())
+            # the background cycle is its own trace root (the caller's
+            # op returned long ago); push/pull bucket frames parent to it
+            with self._op("cycle", pseq=pseq) as sp:
+                tc = sp.wire()
 
-            params = self._with_failover(once)
+                def once():
+                    self._push_buckets_sync(by_owner, pseq=pseq, tc=tc)
+                    return self._merge_host_params(self._pull_buckets(tc=tc))
+
+                params = self._with_failover(once)
         except BaseException as e:
             pending._fail(e)
         else:
@@ -1377,6 +1409,9 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
             self.flush()  # land (or fail fast) in-flight background cycles
         except Exception:
             pass  # a dead server is exactly why we are reconnecting
+        obs.record_event("reconnect", worker=self.worker,
+                         servers=len(self._addrs),
+                         new_addrs=addrs is not None)
         saved = self._saved_transport_state()
         self._close_transport()
         for ch in self._chs:

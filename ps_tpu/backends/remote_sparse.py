@@ -37,6 +37,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from ps_tpu import obs
 from ps_tpu.backends.common import (
     BucketedTransportMixin,
     BucketPlan,
@@ -362,6 +363,8 @@ class SparsePSService(VanService):
                 "apply_log_total": log_total,
                 "stale_epochs": self.transport.stale_epochs,
                 "stale_epoch_buckets": self.transport.stale_epoch_buckets,
+                # extended STATS (ps_tpu/obs): gauges + latency quantiles
+                "metrics": self.transport.metrics_snapshot(),
             }
             out.update(self.replica_state())
             return tv.encode(tv.OK, worker, None, extra=out)
@@ -842,15 +845,17 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         if self._pending_cycles:
             self.flush()  # a pull must not overtake an in-flight push
         reqs, routes = self._build_pull(requests)
+        with self._op("pull") as sp:
+            extra = self._tc_extra(None, sp)
 
-        def once():
-            msgs = self._fanout({
-                i: tv.encode(tv.ROW_PULL, self.worker, t)
-                for i, t in reqs.items()
-            })
-            return self._merge_rows(requests, routes, msgs)
+            def once():
+                msgs = self._fanout({
+                    i: tv.encode(tv.ROW_PULL, self.worker, t, extra=extra)
+                    for i, t in reqs.items()
+                })
+                return self._merge_rows(requests, routes, msgs)
 
-        return self._with_failover(once)
+            return self._with_failover(once)
 
     def _build_pull(self, requests):
         reqs: Dict[int, Dict[str, np.ndarray]] = {}
@@ -901,31 +906,34 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         reassembled push as one atomic unit either way."""
         reqs = self._build_push(pushes, dedupe)
         pseq, pfan = self._next_push_seq(), sorted(reqs)
-        if self.bucket_bytes is not None:
-            self.flush()  # keep per-worker push order == epoch order
-            self._with_failover(
-                lambda: self._push_buckets_sync(reqs, pseq=pseq, pfan=pfan))
-            return
+        with self._op("push") as sp:
+            tc = sp.wire()
+            if self.bucket_bytes is not None:
+                self.flush()  # keep per-worker push order == epoch order
+                self._with_failover(
+                    lambda: self._push_buckets_sync(reqs, pseq=pseq,
+                                                    pfan=pfan, tc=tc))
+                return
 
-        def once():
-            msgs = self._fanout({
-                i: self._encode_serial_push(tv.ROW_PUSH, t,
-                                            pseq=pseq, pfan=pfan)
-                for i, t in reqs.items()
-            })
-            for i, m in msgs.items():
-                self._check(i, m)
+            def once():
+                msgs = self._fanout({
+                    i: self._encode_serial_push(tv.ROW_PUSH, t,
+                                                pseq=pseq, pfan=pfan, tc=tc)
+                    for i, t in reqs.items()
+                })
+                for i, m in msgs.items():
+                    self._check(i, m)
 
-        self._with_failover(once)
+            self._with_failover(once)
 
     def _encode_serial_push(self, kind: int, t: Dict[str, np.ndarray],
                             pseq: Optional[int] = None,
-                            pfan: Optional[List[int]] = None):
+                            pfan: Optional[List[int]] = None, tc=None):
         """One serial row-push frame, grads compressed per the policy
         (zero-copy parts when ``writev`` is on, as in the dense worker),
         tagged with the (nonce, cycle seq, fanout) token — the dedup key
         under failover replay AND what the checkpoint drain round compares
-        across shards."""
+        across shards — plus the op's trace context when sampled."""
         t, enc = self._encode_push_tree(t)
         extra = {}
         if enc:
@@ -933,6 +941,8 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         if pseq is not None:
             extra.update({"pseq": pseq, "pnonce": self._transport_nonce,
                           "pfan": pfan})
+        if tc is not None:
+            extra[obs.WIRE_KEY] = tc
         extra = extra or None
         if self.writev:
             return tv.encode_parts(kind, self.worker, t, extra)
@@ -942,7 +952,8 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
 
     def _push_buckets_sync(self, reqs: Dict[int, Dict[str, np.ndarray]],
                            pseq: Optional[int] = None,
-                           pfan: Optional[List[int]] = None) -> None:
+                           pfan: Optional[List[int]] = None,
+                           tc=None) -> None:
         """Stripe each server's ``{table/ids, table/grads}`` payload over
         the pool as byte-sliced fusion buckets; the completing bucket's
         reply carries the committed versions. ``pseq``/``pfan`` tag every
@@ -961,15 +972,16 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
             # zero-copy frames when writev is on (see the dense twin)
             enc_bucket = plan.bucket_encoder(self.writev)
             for b in range(plan.nbuckets):
-                payload = enc_bucket(
-                    tv.ROW_BUCKET_PUSH, self.worker, t, b,
-                    extra={"epoch": epoch,
-                           "nonce": self._transport_nonce,
-                           "pseq": pseq,
-                           "pnonce": self._transport_nonce,
-                           "pfan": pfan,
-                           "enc": enc},
-                )
+                extra = {"epoch": epoch,
+                         "nonce": self._transport_nonce,
+                         "pseq": pseq,
+                         "pnonce": self._transport_nonce,
+                         "pfan": pfan,
+                         "enc": enc}
+                if tc is not None:
+                    extra[obs.WIRE_KEY] = tc
+                payload = enc_bucket(tv.ROW_BUCKET_PUSH, self.worker, t, b,
+                                     extra=extra)
                 futs.append((i, pumps[b % len(pumps)].submit(payload)))
         for i, fut in futs:
             reply = self._bucket_reply(i, fut)
@@ -1001,8 +1013,10 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
 
             t0 = _time.perf_counter()
             try:
-                self._with_failover(lambda: self._push_buckets_sync(
-                    reqs, pseq=pseq, pfan=pfan))
+                with self._op("cycle", pseq=pseq) as sp:
+                    tc = sp.wire()
+                    self._with_failover(lambda: self._push_buckets_sync(
+                        reqs, pseq=pseq, pfan=pfan, tc=tc))
             except BaseException as e:
                 pending._fail(e)
             else:
@@ -1030,15 +1044,18 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                 name = name_ids.split("/")[0]
                 reqs.setdefault(i, {})[f"{name}/pull_ids"] = v
 
-        def once():
-            msgs = self._fanout({
-                i: self._encode_serial_push(tv.ROW_PUSH_PULL, t,
-                                            pseq=pseq, pfan=pfan)
-                for i, t in reqs.items()
-            })
-            return self._merge_rows(requests, routes, msgs)
+        with self._op("push_pull") as sp:
+            tc = sp.wire()
 
-        return self._with_failover(once)
+            def once():
+                msgs = self._fanout({
+                    i: self._encode_serial_push(tv.ROW_PUSH_PULL, t,
+                                                pseq=pseq, pfan=pfan, tc=tc)
+                    for i, t in reqs.items()
+                })
+                return self._merge_rows(requests, routes, msgs)
+
+            return self._with_failover(once)
 
     def checkpoint_all(self, path: str) -> Dict[str, int]:
         """Trigger a coordinated, CROSS-SHARD-ATOMIC checkpoint — the
@@ -1146,6 +1163,9 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
             self.flush()  # land (or fail fast) in-flight background pushes
         except Exception:
             pass  # a dead server is exactly why we are reconnecting
+        obs.record_event("reconnect", worker=self.worker,
+                         servers=len(self._addrs),
+                         new_addrs=addrs is not None)
         saved = self._saved_transport_state()
         self._close_transport()
         for ch in self._chs:

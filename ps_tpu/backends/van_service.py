@@ -40,6 +40,7 @@ import threading
 import time
 from typing import Dict, List, Optional
 
+from ps_tpu import obs
 from ps_tpu.backends.common import BucketAssembler, send_payload
 from ps_tpu.control import tensor_van as tv
 from ps_tpu.utils.metrics import TransportStats
@@ -212,6 +213,13 @@ class VanService:
         self.promotion_s: Optional[float] = None  # promote() call duration
         self.goodbyes = 0  # workers that sent SHUTDOWN (clean departures)
         self._goodbye_cond = threading.Condition()
+        # observability (ps_tpu/obs): request counter into the process
+        # registry (several services in one process merge by name), and
+        # the opt-in /metrics endpoint — a no-op unless PS_METRICS_PORT
+        # is set (start_metrics_server is idempotent per process)
+        self._req_counter = obs.default_registry().counter(
+            "ps_server_requests_total", "frames served (all kinds)")
+        obs.start_metrics_server()
         self._accept_thread = threading.Thread(
             target=self._accept_loop, daemon=True
         )
@@ -265,6 +273,21 @@ class VanService:
         backup refuses them with a typed, retry-able reply (the worker's
         failover loop keys off ``extra["backup"]`` to wait out the
         promotion instead of failing the job)."""
+        # server-side tracing hook — THE one chokepoint every kind passes
+        # through: a frame whose header carries a propagated trace
+        # context gets a span named for its kind, parented to the
+        # sender's span (the worker op, or the primary's apply for
+        # replica appends). Untraced frames cost one dict lookup.
+        ctx = obs.from_wire(extra)
+        if ctx is not None:
+            with obs.tracer().span(tv.kind_name(kind), cat="server",
+                                   parent=ctx).set(worker=worker,
+                                                   role=self.role):
+                return self._dispatch_traced(kind, worker, tensors, extra)
+        return self._dispatch_traced(kind, worker, tensors, extra)
+
+    def _dispatch_traced(self, kind: int, worker: int, tensors,
+                         extra) -> bytes:
         if kind in self._REPLICA_KINDS:
             return self._handle_replica(kind, worker, tensors, extra)
         if self.role != "primary" and kind != tv.STATS:
@@ -351,6 +374,8 @@ class VanService:
             self.epoch = self._primary_epoch + 1
             self.promote_reason = reason
         self.promotion_s = _time.perf_counter() - t0
+        obs.record_event("promotion", reason=reason, epoch=self.epoch,
+                         promotion_s=round(self.promotion_s, 6))
         logging.getLogger(__name__).warning(
             "backup promoted to primary (reason=%s, epoch %d) in %.1fms",
             reason, self.epoch, self.promotion_s * 1e3,
@@ -405,6 +430,8 @@ class VanService:
             if self.role != "primary":
                 return
             self.role = "fenced"
+        obs.record_event("self_fence", peer_epoch=int(peer_epoch),
+                         epoch=self.epoch)
         logging.getLogger(__name__).error(
             "FENCED: this shard's backup promoted to primary (epoch %d) "
             "while we were still serving — refusing all worker traffic "
@@ -420,7 +447,14 @@ class VanService:
         s = self._backup_session
         if s is None or s.degraded:
             return None
-        return s.publish(op, worker, tensors, meta or {})
+        meta = dict(meta or {})
+        # propagate the serve span (if this commit is being traced) so
+        # the backup's replica_append span parents to THIS apply — the
+        # worker→primary→backup chain stays one trace
+        ctx = obs.tracer().current()
+        if ctx is not None:
+            meta[obs.WIRE_KEY] = [ctx.trace_id, ctx.span_id]
+        return s.publish(op, worker, tensors, meta)
 
     def _await_replication(self, seq: Optional[int]) -> None:
         """Sync-ack gate (call OUTSIDE the apply lock, before sending the
@@ -435,7 +469,10 @@ class VanService:
         if s is None:
             return
         if seq is not None and s.ack_mode == "sync":
-            s.wait_acked(seq)
+            # `child` piggybacks on the serve span: untraced requests get
+            # the NOOP (never a fresh sampling decision mid-server)
+            with obs.tracer().child("replica_ack_wait", cat="server"):
+                s.wait_acked(seq)
         # checked for EVERY commit (even unreplicated ones after the
         # degrade): once fenced, no reply may tell a worker its commit
         # stuck at this zombie
@@ -448,7 +485,11 @@ class VanService:
     def replica_state(self) -> dict:
         """Role/epoch/replication introspection (REPLICA_STATE, and merged
         into both services' STATS replies)."""
-        out = {"role": self.role, "epoch": self.epoch}
+        out = {"role": self.role, "epoch": self.epoch,
+               # wall clock for the NTP-style trace-clock probe
+               # (ps_tpu/obs/clock.py): REPLICA_STATE is the cheapest
+               # round trip every role answers, so offsets ride it
+               "now": time.time()}
         s = self._backup_session
         if s is not None:
             out["repl"] = s.state()
@@ -491,6 +532,9 @@ class VanService:
                 # so a fleet-wide rash of abandoned pushes shows up in the
                 # worker's StepLogger instead of only in server stderr
                 self.transport.record_stale_epoch(len(asm._seen))
+                obs.record_event("stale_epoch", worker=worker,
+                                 epoch=asm.epoch, superseded_by=epoch,
+                                 buckets=len(asm._seen))
                 logging.getLogger(__name__).warning(
                     "worker %d abandoned push epoch %d (%d/%d buckets); "
                     "superseded by epoch %d", worker, asm.epoch,
@@ -622,6 +666,7 @@ class VanService:
                     self._inflight += 1
                 try:
                     kind, worker, tensors, extra = tv.decode(msg)
+                    self._req_counter.inc()
                     goodbye = kind == tv.SHUTDOWN
                     new_lane = None
                     if goodbye:

@@ -47,6 +47,14 @@ Environment variables honored by :meth:`Config.from_env`:
   primaries block (the bounded ack window; default 256)
 - ``PS_FAILOVER_TIMEOUT_MS`` — worker side: how long a shard's replica set
   is retried (promotion wait included) before the typed failure surfaces
+- ``PS_TRACE_SAMPLE``        — distributed-tracing sample rate in [0, 1]
+  (ps_tpu/obs: 0 = off, the default — the unsampled path costs nothing)
+- ``PS_TRACE_DIR``           — directory for trace exports and flight-
+  recorder dumps (default '.')
+- ``PS_METRICS_PORT``        — opt-in Prometheus /metrics HTTP endpoint
+  per process (0 = ephemeral port; unset = no endpoint)
+- ``PS_FLIGHT_EVENTS``       — flight-recorder ring capacity (default
+  4096 typed events)
 - ``DMLC_ROLE``, ``DMLC_NUM_WORKER``, ``DMLC_NUM_SERVER``,
   ``DMLC_PS_ROOT_URI``/``_PORT`` are accepted as aliases where the meaning
   is knowable, so reference-family launcher scripts keep working.
@@ -136,6 +144,22 @@ class Config:
       failover_timeout_ms: worker side — how long each shard's replica
         set is retried (covering detection + promotion) before a
         ServerFailureError surfaces.
+      trace_sample: distributed-tracing sample rate in [0, 1] (README
+        "Observability"; ps_tpu/obs). A sampled worker op propagates its
+        trace context in the van frame headers, so the whole
+        worker→primary→backup chain lands in per-process span rings and
+        exports to one merged Perfetto timeline. 0 (default) = off; the
+        unsampled hot path is a no-op singleton plus one dict lookup.
+      trace_dir: where trace exports and flight-recorder dumps are
+        written (default: the working directory).
+      metrics_port: opt-in Prometheus-text /metrics HTTP endpoint for
+        this process (0 = ephemeral port, read it off the server; None =
+        no endpoint). Loopback-bound, like every other unauthenticated
+        endpoint here.
+      flight_events: flight-recorder ring capacity — the last N typed
+        events (failover, degrade, stale epoch, shm spill, reconnect,
+        self-fence, promotion, peer death) dumped as JSONL on unhandled
+        VanError or SIGUSR2.
       heartbeat_base_port: enable the control-plane failure detector for
         multi-process runs. Without ``peer_hosts``, process i's monitor binds
         base_port+i on this host (single-host/localhost topology). With
@@ -209,6 +233,14 @@ class Config:
     replica_ack: str = "sync"
     replica_window: int = 256
     failover_timeout_ms: int = 10_000
+    # observability (ps_tpu/obs, README "Observability"): trace sampling
+    # (0 = off), trace/flight output dir, the opt-in /metrics endpoint,
+    # and the flight-recorder ring size. apply_obs() pushes these into
+    # the process-global obs singletons.
+    trace_sample: float = 0.0
+    trace_dir: Optional[str] = None
+    metrics_port: Optional[int] = None
+    flight_events: int = 4096
     heartbeat_base_port: Optional[int] = None
     peer_hosts: Optional[str] = None
     heartbeat_bind: Optional[str] = None
@@ -314,6 +346,25 @@ class Config:
             raise ValueError("replica_window must be >= 1")
         if self.failover_timeout_ms < 1:
             raise ValueError("failover_timeout_ms must be >= 1")
+        if not (0.0 <= self.trace_sample <= 1.0):
+            raise ValueError(
+                f"trace_sample {self.trace_sample} outside [0, 1]")
+        if self.metrics_port is not None and self.metrics_port < 0:
+            raise ValueError("metrics_port must be >= 0 (0 = ephemeral) "
+                             "or None (no endpoint)")
+        if self.flight_events < 1:
+            raise ValueError("flight_events must be >= 1")
+
+    def apply_obs(self) -> None:
+        """Push the observability knobs into the process-global obs
+        singletons (tracer sample rate, dump dir, flight-ring size) and
+        start the /metrics endpoint when ``metrics_port`` is set —
+        launchers call this once after building the Config."""
+        from ps_tpu import obs
+
+        obs.configure(sample=self.trace_sample, trace_dir=self.trace_dir,
+                      flight_events=self.flight_events,
+                      metrics_port=self.metrics_port)
 
     def compress_spec(self) -> Optional[dict]:
         """The normalized codec spec dict workers pass to
@@ -405,6 +456,17 @@ class Config:
             kwargs["replica_window"] = int(env["PS_REPLICA_WINDOW"])
         if "PS_FAILOVER_TIMEOUT_MS" in env:
             kwargs["failover_timeout_ms"] = int(env["PS_FAILOVER_TIMEOUT_MS"])
+        if "PS_TRACE_SAMPLE" in env:
+            kwargs["trace_sample"] = float(env["PS_TRACE_SAMPLE"] or 0)
+        if "PS_TRACE_DIR" in env:
+            kwargs["trace_dir"] = env["PS_TRACE_DIR"] or None
+        if "PS_METRICS_PORT" in env:
+            # "" explicitly selects no endpoint
+            kwargs["metrics_port"] = (int(env["PS_METRICS_PORT"])
+                                      if env["PS_METRICS_PORT"].strip()
+                                      else None)
+        if "PS_FLIGHT_EVENTS" in env:
+            kwargs["flight_events"] = int(env["PS_FLIGHT_EVENTS"])
         if "PS_HEARTBEAT_BASE_PORT" in env:
             kwargs["heartbeat_base_port"] = int(env["PS_HEARTBEAT_BASE_PORT"])
         if "PS_PEER_HOSTS" in env:

@@ -198,9 +198,15 @@ class FailureDetector:
 
     def check(self) -> None:
         """Raise if any peer that ever beat us has gone silent (a clean
-        goodbye-leave never raises)."""
+        goodbye-leave never raises). The death lands in the flight
+        recorder BEFORE the raise — the black box must hold the first
+        detection even if the raise takes the process down."""
         dead = self.server.dead()
         if dead:
+            from ps_tpu import obs
+
+            obs.record_event("peer_dead", node=self.node_id,
+                             dead=sorted(dead))
             raise WorkerFailureError(dead)
 
     def left(self) -> List[int]:

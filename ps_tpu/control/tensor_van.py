@@ -67,6 +67,28 @@ REPLICA_APPEND = 17   # primary -> backup: ONE sequenced committed event
 REPLICA_PROMOTE = 18  # operator/watchdog -> backup: promote to primary now
 #                       (bumps the shard-table epoch; workers re-route)
 REPLICA_STATE = 19    # -> any service: role/epoch/replication-lag probe
+#                       (reply also carries the server's wall clock "now" —
+#                       the NTP-style probe ps_tpu/obs/clock.py rides for
+#                       cross-process trace-timeline alignment)
+
+#: human names per kind — span labels (ps_tpu/obs/trace.py), ps_top, and
+#: flight-recorder events all resolve through here so a new kind gets a
+#: readable name in every surface at once
+KIND_NAMES = {
+    HELLO: "hello", PULL: "pull", PUSH: "push", PUSH_PULL: "push_pull",
+    STATS: "stats", SHUTDOWN: "shutdown", OK: "ok", ERR: "err",
+    ROW_PULL: "row_pull", ROW_PUSH: "row_push",
+    ROW_PUSH_PULL: "row_push_pull", CHECKPOINT: "checkpoint",
+    BUCKET_PUSH: "bucket_push", BUCKET_PULL: "bucket_pull",
+    ROW_BUCKET_PUSH: "row_bucket_push", SHM_SETUP: "shm_setup",
+    REPLICA_HELLO: "replica_hello", REPLICA_APPEND: "replica_append",
+    REPLICA_PROMOTE: "replica_promote", REPLICA_STATE: "replica_state",
+}
+
+
+def kind_name(kind: int) -> str:
+    return KIND_NAMES.get(kind, f"kind{kind}")
+
 
 _HDR = struct.Struct("<BIQ")  # kind, worker_id, meta_len
 

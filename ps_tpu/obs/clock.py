@@ -1,0 +1,64 @@
+"""Cross-process clock alignment for the merged trace timeline.
+
+Spans stamp ``time.time()``, so merging two processes' traces needs each
+process's wall-clock offset against a common reference. The native
+heartbeat plane is one-way (C++ beat threads, no reply to time), so the
+offset rides the van instead: an NTP-style probe over the existing
+``REPLICA_STATE`` kind — the cheapest round trip every service (primary,
+backup, sparse) already answers, whose reply now carries the server's
+``now``. The classic estimate applies: for each probe,
+``offset = t_server - (t_send + t_recv)/2``, and the probe with the
+SMALLEST round trip wins (its midpoint assumption has the least room to
+be wrong — the same min-RTT filter NTP uses). On loopback this lands
+within tens of microseconds; across hosts it is bounded by the path
+asymmetry, which is exactly the bound any software clock sync has.
+
+Usage: ``off = ClockSync().probe(channel)`` at the worker, then
+``tracer.clock_offset_us = off`` before ``export_chrome`` — every
+process exports in the REFERENCE server's clock and
+:func:`~ps_tpu.obs.trace.merge_chrome` is a pure concatenation.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+__all__ = ["ClockSync"]
+
+
+class ClockSync:
+    """Min-RTT NTP-style offset estimator over a van channel."""
+
+    def __init__(self):
+        self.offset_us: Optional[float] = None  # add to local ts → server ts
+        self.rtt_us: Optional[float] = None     # best probe's round trip
+        self.probes = 0
+
+    def observe(self, t_send: float, t_recv: float,
+                t_server: float) -> None:
+        """Feed one request/reply timing triple (seconds, ``time.time()``
+        bases). Piggyback path: any reply that carries a server ``now``
+        can refine the estimate without a dedicated probe."""
+        rtt = max(t_recv - t_send, 0.0) * 1e6
+        self.probes += 1
+        if self.rtt_us is None or rtt < self.rtt_us:
+            self.rtt_us = rtt
+            self.offset_us = (t_server - (t_send + t_recv) / 2.0) * 1e6
+
+    def probe(self, ch, worker: int = 0, n: int = 8) -> float:
+        """``n`` REPLICA_STATE round trips on ``ch``; returns the min-RTT
+        offset estimate in µs (also kept in :attr:`offset_us`)."""
+        from ps_tpu.control import tensor_van as tv
+
+        for _ in range(max(int(n), 1)):
+            t0 = time.time()
+            reply = ch.request(tv.encode(tv.REPLICA_STATE, worker, None))
+            t1 = time.time()
+            kind, _, _, extra = tv.decode(reply)
+            if kind != tv.OK or "now" not in extra:
+                raise RuntimeError(
+                    "clock probe failed: peer's REPLICA_STATE reply "
+                    "carries no 'now' (pre-observability server?)")
+            self.observe(t0, t1, float(extra["now"]))
+        return self.offset_us

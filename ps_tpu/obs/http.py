@@ -1,0 +1,112 @@
+"""Opt-in Prometheus /metrics endpoint, one per process.
+
+``metrics_port`` (env ``PS_METRICS_PORT``) starts a tiny threaded HTTP
+server bound to ``bind`` (loopback by default — same exposure policy as
+every other unauthenticated endpoint here) serving the process registry
+as Prometheus text exposition at ``/metrics``. Port 0 binds an ephemeral
+port (read ``.port``); unset/None serves nothing — the endpoint costs
+zero unless asked for.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+__all__ = ["MetricsServer", "start_metrics_server", "stop_metrics_server"]
+
+
+class MetricsServer:
+    """Threaded HTTP server for one registry's /metrics."""
+
+    def __init__(self, port: int = 0, bind: str = "127.0.0.1",
+                 registry=None):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        from ps_tpu.obs.metrics import default_registry
+
+        reg = registry if registry is not None else default_registry()
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 — http.server API
+                if self.path.split("?")[0] not in ("/metrics", "/"):
+                    self.send_response(404)
+                    self.end_headers()
+                    return
+                try:
+                    body = reg.render_prometheus().encode()
+                except Exception as e:  # scrape must see the failure
+                    self.send_response(500)
+                    self.end_headers()
+                    self.wfile.write(repr(e).encode())
+                    return
+                self.send_response(200)
+                self.send_header(
+                    "Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # scrapes are not stderr news
+                pass
+
+        self._httpd = ThreadingHTTPServer((bind, int(port)), Handler)
+        self._httpd.daemon_threads = True
+        self._t = threading.Thread(target=self._httpd.serve_forever,
+                                   daemon=True, name="ps-metrics-http")
+        self._t.start()
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def close(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._t.join(timeout=5)
+
+
+_server: Optional[MetricsServer] = None
+_lock = threading.Lock()
+
+
+def start_metrics_server(port: Optional[int] = None,
+                         bind: str = "127.0.0.1") -> Optional[MetricsServer]:
+    """Start (or return) the process's /metrics server. ``port=None``
+    reads ``PS_METRICS_PORT``; still-None means disabled (returns None).
+    Idempotent: the first successful start wins — later calls return the
+    live server regardless of the port they asked for (one process, one
+    scrape target)."""
+    import os
+
+    global _server
+    if port is None:
+        v = os.environ.get("PS_METRICS_PORT")
+        if v is None or v.strip() == "":
+            return _server
+        port = int(v)
+    with _lock:
+        if _server is None:
+            try:
+                _server = MetricsServer(port=port, bind=bind)
+            except OSError as e:
+                # a second process on the host with the same fixed port
+                # (primary + backup services, mp drills): the opt-in
+                # endpoint must NEVER take the data plane down with it
+                import logging
+
+                logging.getLogger(__name__).warning(
+                    "/metrics endpoint disabled: could not bind %s:%s "
+                    "(%s) — another process on this host probably holds "
+                    "the port; give each process its own PS_METRICS_PORT",
+                    bind, port, e)
+                return None
+        return _server
+
+
+def stop_metrics_server() -> None:
+    global _server
+    with _lock:
+        if _server is not None:
+            _server.close()
+            _server = None

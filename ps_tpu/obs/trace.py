@@ -1,0 +1,277 @@
+"""Distributed tracing for the PS data plane.
+
+One worker push is a chain of work in three processes: the worker encodes
+and sends, the primary stages/applies and replicates, the backup applies
+and acks. SURVEY.md §6 names tracing a first-class build target; this
+module is the minimal production shape of it:
+
+- a :class:`TraceContext` ``(trace_id, span_id)`` travels in the van
+  frame's ``extra`` header (key ``"tc"``) on push/pull/bucket/replica
+  kinds, so each hop parents its span to the hop before it;
+- spans land in a per-process bounded ring (the RingLog discipline — a
+  long-lived server must never hold O(requests) trace memory);
+- :meth:`Tracer.export_chrome` writes Chrome-trace-event JSON that
+  Perfetto / ``chrome://tracing`` opens directly, and
+  :func:`merge_chrome` concatenates several processes' exports into ONE
+  timeline (after :class:`~ps_tpu.obs.clock.ClockSync` offsets align
+  their wall clocks).
+
+Sampling is decided ONCE, at the root span (the worker op): the
+``trace_sample`` knob (env ``PS_TRACE_SAMPLE``, default 0) gates root
+creation, and every downstream hop simply follows the header — an
+unsampled op costs one dict lookup per hop and nothing else, so the off
+path stays off the profile.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import List, NamedTuple, Optional
+
+__all__ = [
+    "TraceContext", "Span", "Tracer", "NOOP", "WIRE_KEY",
+    "merge_chrome",
+]
+
+#: the van-frame ``extra`` key a propagated context rides under:
+#: ``extra["tc"] == [trace_id, parent_span_id]``
+WIRE_KEY = "tc"
+
+
+class TraceContext(NamedTuple):
+    """What a hop needs to parent its span to the hop before it."""
+
+    trace_id: str
+    span_id: str
+
+
+def from_wire(extra: Optional[dict]) -> Optional[TraceContext]:
+    """The propagated context of a received frame, or None (unsampled)."""
+    tc = (extra or {}).get(WIRE_KEY)
+    if not tc:
+        return None
+    try:
+        return TraceContext(str(tc[0]), str(tc[1]))
+    except (IndexError, TypeError):
+        return None
+
+
+class _NoopSpan:
+    """The unsampled span: every method a real span has, all free.
+
+    A singleton, so ``tracer.span(...)`` on the off path allocates
+    nothing and the call sites need no ``if sampled`` branches."""
+
+    __slots__ = ()
+
+    def ctx(self) -> Optional[TraceContext]:
+        return None
+
+    def wire(self) -> Optional[list]:
+        return None
+
+    def set(self, **args) -> "_NoopSpan":
+        return self
+
+    def __enter__(self) -> "_NoopSpan":
+        return self
+
+    def __exit__(self, *exc) -> None:
+        return None
+
+    def __bool__(self) -> bool:
+        return False
+
+
+NOOP = _NoopSpan()
+
+
+class Span:
+    """One timed unit of work, parented into a trace.
+
+    Use as a context manager; the span records wall-clock start
+    (``time.time()`` µs — alignable across processes by a clock offset)
+    and a monotonic duration, and lands in its tracer's ring on exit."""
+
+    __slots__ = ("name", "cat", "trace_id", "span_id", "parent_id",
+                 "args", "ts_us", "dur_us", "_t0", "_tracer", "_tid")
+
+    def __init__(self, tracer: "Tracer", name: str, cat: str,
+                 trace_id: str, span_id: str, parent_id: Optional[str]):
+        self.name = name
+        self.cat = cat
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent_id = parent_id
+        self.args: dict = {}
+        self.ts_us = 0.0
+        self.dur_us = 0.0
+        self._t0 = 0.0
+        self._tracer = tracer
+        self._tid = 0
+
+    def ctx(self) -> TraceContext:
+        """The context downstream hops parent to."""
+        return TraceContext(self.trace_id, self.span_id)
+
+    def wire(self) -> list:
+        """The ``extra[WIRE_KEY]`` value that propagates this span."""
+        return [self.trace_id, self.span_id]
+
+    def set(self, **args) -> "Span":
+        """Attach key=value annotations (worker id, byte counts, ...)."""
+        self.args.update(args)
+        return self
+
+    def __enter__(self) -> "Span":
+        self.ts_us = time.time() * 1e6
+        self._t0 = time.perf_counter()
+        self._tid = threading.get_ident()
+        self._tracer._push_current(self)
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.dur_us = (time.perf_counter() - self._t0) * 1e6
+        if exc_type is not None:
+            self.args.setdefault("error", repr(exc))
+        self._tracer._pop_current(self)
+        self._tracer._record(self)
+
+    def __bool__(self) -> bool:
+        return True
+
+
+def _new_id() -> str:
+    return os.urandom(8).hex()
+
+
+class Tracer:
+    """Per-process span factory + bounded ring + exporter.
+
+    ``sample`` gates ROOT spans only (a span created with an explicit
+    ``parent`` context is always recorded — the root already paid for the
+    trace). ``clock_offset_us`` is added to every exported timestamp so
+    several processes' dumps merge onto one timeline (estimated by
+    :class:`~ps_tpu.obs.clock.ClockSync` against a reference server)."""
+
+    def __init__(self, service: str = "ps", capacity: int = 8192,
+                 sample: float = 0.0):
+        import collections
+
+        self.service = service
+        self.sample = float(sample)
+        self.clock_offset_us = 0.0
+        self.pid = os.getpid()
+        self._ring = collections.deque(maxlen=int(capacity))
+        self._tls = threading.local()
+        self.dropped = 0  # roots not sampled are NOT drops; ring evictions are
+        self._total = 0
+
+    # -- span creation ---------------------------------------------------------
+
+    def span(self, name: str, cat: str = "ps",
+             parent: Optional[TraceContext] = None):
+        """A new span: child of ``parent`` when given, else a root that is
+        sampled with probability ``sample`` (NOOP otherwise)."""
+        if parent is None:
+            if self.sample <= 0.0:
+                return NOOP
+            if self.sample < 1.0:
+                import random
+
+                if random.random() >= self.sample:
+                    return NOOP
+            return Span(self, name, cat, _new_id(), _new_id(), None)
+        return Span(self, name, cat, parent.trace_id, _new_id(),
+                    parent.span_id)
+
+    def child(self, name: str, cat: str = "ps"):
+        """A span under the CURRENT thread's open span — NOOP when no
+        traced work is in progress (never a fresh sampling decision, so
+        internal waits can't spawn orphan root traces)."""
+        cur = self.current()
+        return self.span(name, cat, parent=cur) if cur is not None else NOOP
+
+    def current(self) -> Optional[TraceContext]:
+        """The innermost open span's context on this thread, if any."""
+        stack = getattr(self._tls, "stack", None)
+        return stack[-1].ctx() if stack else None
+
+    def _push_current(self, span: Span) -> None:
+        stack = getattr(self._tls, "stack", None)
+        if stack is None:
+            stack = self._tls.stack = []
+        stack.append(span)
+
+    def _pop_current(self, span: Span) -> None:
+        stack = getattr(self._tls, "stack", None)
+        if stack and stack[-1] is span:
+            stack.pop()
+        elif stack and span in stack:  # exited out of order: still remove
+            stack.remove(span)
+
+    def _record(self, span: Span) -> None:
+        if len(self._ring) == self._ring.maxlen:
+            self.dropped += 1
+        self._ring.append(span)
+        self._total += 1
+
+    # -- introspection / export ------------------------------------------------
+
+    def spans(self) -> List[Span]:
+        return list(self._ring)
+
+    def clear(self) -> None:
+        self._ring.clear()
+        self.dropped = 0
+
+    def chrome_events(self) -> List[dict]:
+        """Chrome-trace ``X`` events (+ a process_name metadata record),
+        timestamps shifted by ``clock_offset_us``."""
+        events: List[dict] = [{
+            "ph": "M", "name": "process_name", "pid": self.pid, "tid": 0,
+            "args": {"name": self.service},
+        }]
+        for s in self.spans():
+            events.append({
+                "ph": "X", "name": s.name, "cat": s.cat,
+                "pid": self.pid, "tid": s._tid,
+                "ts": s.ts_us + self.clock_offset_us,
+                "dur": max(s.dur_us, 0.001),
+                "args": {"trace_id": s.trace_id, "span_id": s.span_id,
+                         "parent_id": s.parent_id, **s.args},
+            })
+        return events
+
+    def export_chrome(self, path: Optional[str] = None) -> str:
+        """Write the ring as Perfetto-openable JSON; returns the path
+        (default: ``<trace_dir>/trace-<service>-<pid>.json``)."""
+        if path is None:
+            base = os.environ.get("PS_TRACE_DIR") or "."
+            path = os.path.join(base, f"trace-{self.service}-{self.pid}.json")
+        os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+        with open(path, "w") as f:
+            json.dump({"traceEvents": self.chrome_events()}, f)
+        return path
+
+
+def merge_chrome(sources, path: str) -> str:
+    """Concatenate several Chrome-trace exports (file paths, event lists,
+    or ``{"traceEvents": ...}`` dicts) into one file — the whole-cluster
+    timeline. Each process's export should already carry its clock offset
+    (applied at export time); this is a pure concatenation."""
+    events: List[dict] = []
+    for src in sources:
+        if isinstance(src, str):
+            with open(src) as f:
+                src = json.load(f)
+        if isinstance(src, dict):
+            src = src.get("traceEvents", [])
+        events.extend(src)
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "w") as f:
+        json.dump({"traceEvents": events}, f)
+    return path

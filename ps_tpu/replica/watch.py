@@ -81,6 +81,10 @@ class PromotionWatch:
             state = self.server.state(self.primary_id)
             if state in ("left", "dead"):
                 reason = "goodbye" if state == "left" else "timeout"
+                from ps_tpu import obs
+
+                obs.record_event("promotion_watch_fired",
+                                 primary_id=self.primary_id, reason=reason)
                 t0 = time.monotonic()
                 self.service.promote(reason=reason)
                 self.promoted_reason = reason

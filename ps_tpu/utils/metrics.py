@@ -54,9 +54,44 @@ class TransportStats:
     1.0 means the worker never waited, 0.0 means fully serial.
     """
 
+    #: the latency surfaces that get full distributions: per-op client
+    #: latency (push/pull/push_pull), per-bucket request rounds, caller
+    #: blocking waits (the flush barrier), the sync replica-ack gate, and
+    #: worker-side failover re-routes. Means hide the p99 that matters
+    #: for sync replica_ack and failover; the histograms don't.
+    HIST_NAMES = (
+        ("push_s", "ps_push_seconds", "client push op latency"),
+        ("pull_s", "ps_pull_seconds", "client pull op latency"),
+        ("push_pull_s", "ps_push_pull_seconds",
+         "client push_pull cycle latency"),
+        ("cycle_s", "ps_cycle_seconds",
+         "background push->pull transport cycle (push_pull_async)"),
+        ("bucket_s", "ps_bucket_seconds",
+         "one fusion-bucket request/reply round"),
+        ("blocked_s", "ps_blocked_seconds",
+         "caller waits on the flush barrier / pending cycles"),
+        ("repl_ack_wait_s", "ps_replica_ack_wait_seconds",
+         "serve-thread waits on the sync replica ack"),
+        ("failover_s", "ps_failover_seconds",
+         "worker shard re-routes to a promoted replica"),
+    )
+
     def __init__(self, window: int = 256):
+        from ps_tpu.obs.metrics import Histogram, default_registry
+
         self._lock = threading.Lock()
         self._bucket_window: Deque = collections.deque(maxlen=window)
+        # log2-bucket latency distributions (ps_tpu/obs/metrics): the
+        # point samples this class has always accumulated now ALSO land
+        # in histograms, registered into the process registry so the
+        # /metrics endpoint and ps_top see p50/p99/p999 — same-name
+        # instruments from several TransportStats merge at render
+        reg = default_registry()
+        self.hist: Dict[str, Histogram] = {}
+        for key, prom, help_ in self.HIST_NAMES:
+            h = Histogram(prom, help_)
+            self.hist[key] = h
+            reg.register(h)
         self.buckets = 0
         self.bucket_bytes = 0
         self.bucket_seconds = 0.0
@@ -145,8 +180,16 @@ class TransportStats:
             self.repl_entries += 1
             self.repl_bytes += int(nbytes)
 
+    def record_op(self, name: str, seconds: float) -> None:
+        """One client-side logical transport op (``push``/``pull``/
+        ``push_pull``) end to end — the latency a training loop feels."""
+        h = self.hist.get(name + "_s")
+        if h is not None:
+            h.record(seconds)
+
     def record_repl_ack_wait(self, seconds: float) -> None:
         """Time one serve thread spent blocked on a sync replica ack."""
+        self.hist["repl_ack_wait_s"].record(seconds)
         with self._lock:
             self.repl_ack_wait_s += float(seconds)
 
@@ -166,6 +209,7 @@ class TransportStats:
 
     def record_failover(self, seconds: float) -> None:
         """One worker-side shard re-route to a promoted replica."""
+        self.hist["failover_s"].record(seconds)
         with self._lock:
             self.failovers += 1
             self.failover_s += float(seconds)
@@ -207,6 +251,7 @@ class TransportStats:
             return self.codec_raw_bytes / self.codec_enc_bytes
 
     def record_bucket(self, nbytes: int, seconds: float) -> None:
+        self.hist["bucket_s"].record(seconds)
         with self._lock:
             self.buckets += 1
             self.bucket_bytes += int(nbytes)
@@ -219,6 +264,7 @@ class TransportStats:
             self.busy_s += float(busy_s)
 
     def record_blocked(self, seconds: float) -> None:
+        self.hist["blocked_s"].record(seconds)
         with self._lock:
             self.blocked_s += float(seconds)
 
@@ -309,6 +355,39 @@ class TransportStats:
         if d[24] > 0:
             out["failovers"] = int(d[24])
             out["failover_s"] = round(d[25], 4)
+        # latency DISTRIBUTIONS (ps_tpu/obs): quantiles of everything the
+        # histograms saw — lifetime, not interval (a p99 over an interval
+        # delta of log buckets is computable but the lifetime tail is
+        # what pages people). Only nonempty instruments report, so
+        # serial/unreplicated runs see no new keys.
+        lat = self.latency_quantiles()
+        if lat:
+            out["lat"] = lat
+        return out
+
+    def latency_quantiles(self) -> Dict[str, dict]:
+        """``{name: {count, mean, p50, p99, p999, max}}`` for every
+        histogram that recorded at least once — what the extended STATS
+        frame ships and ``ps_top`` renders (the PR-4 ``repl_ack_wait_s``/
+        ``failover_s`` point samples, now as distributions)."""
+        out: Dict[str, dict] = {}
+        for k, h in self.hist.items():
+            s = h.summary()
+            if s is not None:
+                out[k] = s
+        return out
+
+    def metrics_snapshot(self) -> dict:
+        """Everything a remote poller needs from this endpoint's stats in
+        one json-ready dict: the rate gauges plus the quantiles (the
+        extended STATS frame's ``metrics`` field)."""
+        out: dict = {"bucket_gbps": round(self.bucket_gbps(), 4)}
+        lane = self.lane()
+        if lane != "tcp":
+            out["lane"] = lane
+        lat = self.latency_quantiles()
+        if lat:
+            out["lat"] = lat
         return out
 
 

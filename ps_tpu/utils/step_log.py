@@ -75,7 +75,15 @@ class StepLogger:
         """Out-of-band run event (server failover, backup promotion,
         replication degradation): always printed — regardless of the
         ``every`` cadence, these are the lines an operator greps for —
-        and appended to the JSONL stream as ``{"event": name, ...}``."""
+        appended to the JSONL stream as ``{"event": name, ...}``, and
+        mirrored into the process flight recorder (ps_tpu/obs/flight) so
+        the step log and the post-mortem black box tell the same story."""
+        try:
+            from ps_tpu import obs
+
+            obs.record_event(name, **fields)
+        except Exception:
+            pass  # the log line must print even if obs is broken
         if self._jsonl is not None:
             self._jsonl.write(json.dumps({"event": name, **fields}) + "\n")
             self._jsonl.flush()

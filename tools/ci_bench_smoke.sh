@@ -7,7 +7,12 @@
 #   2. failover: `bench.py --model failover --quick` — spawns a
 #      primary+backup pair, severs the primary (SIGKILL-equivalent),
 #      asserts the heartbeat-triggered promotion completed and the worker's
-#      next push landed, printing the kill-to-recovery latency.
+#      next push landed, printing the kill-to-recovery latency — and that
+#      the traced 2-shard drill produced a linked Perfetto trace.
+#   3. obs (<30 s): spawns a replicated pair with the /metrics endpoint
+#      on, pushes traffic, scrapes /metrics mid-run and asserts the
+#      counters moved, then runs `tools/ps_top.py --once` against the
+#      pair and checks both roles render.
 #
 # Usage: tools/ci_bench_smoke.sh   (from the repo root)
 set -euo pipefail
@@ -48,6 +53,12 @@ assert det["promote_reason"] == "timeout", \
     f"backup never promoted on the heartbeat timeout: {det['promote_reason']}"
 assert rec["value"] and rec["value"] > 0, "no post-failover push landed"
 assert det["baseline_cycles_per_s"] > 0 and det["sync_repl_cycles_per_s"] > 0
+assert det["trace_linked"], \
+    "failover drill trace: worker->primary->backup span chain is broken"
+assert det["trace_spans"] > 0 and det["flight_events"] > 0
+print(f"  trace: {det['trace_spans']} spans -> {det['trace_file']} "
+      f"(linked={det['trace_linked']}); "
+      f"{det['flight_events']} flight event(s)")
 print(f"  baseline          {det['baseline_cycles_per_s']:8.1f} cycles/s")
 print(f"  sync-ack pair     {det['sync_repl_cycles_per_s']:8.1f} cycles/s "
       f"({det['sync_overhead_x']}x overhead)")
@@ -56,4 +67,76 @@ print(f"  async-ack pair    {det['async_repl_cycles_per_s']:8.1f} cycles/s "
 print(f"  kill -> first successful push: {rec['value']}s "
       f"(heartbeat horizon {det['heartbeat_timeout_ms']}ms)")
 print("failover smoke OK")
+EOF
+
+# obs leg (<30 s): live /metrics scrape mid-traffic + ps_top --once
+timeout -k 10 60 env JAX_PLATFORMS=cpu python - <<'EOF'
+import json
+import subprocess
+import sys
+import urllib.request
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import jax.numpy as jnp
+
+import ps_tpu as ps
+from ps_tpu import obs
+from ps_tpu.backends.remote_async import AsyncPSService, connect_async
+
+srv = obs.start_metrics_server(0)  # ephemeral port, this process
+params = {f"p{i}/w": jnp.asarray(np.full((64, 8), 0.5, np.float32))
+          for i in range(4)}
+ps.init(backend="tpu", mode="async", num_workers=1, dc_lambda=0.0)
+st = ps.KVStore(optimizer="sgd", learning_rate=0.1, mode="async")
+st.init(params)
+prim = AsyncPSService(st, bind="127.0.0.1")
+st2 = ps.KVStore(optimizer="sgd", learning_rate=0.1, mode="async")
+st2.init(params)
+back = AsyncPSService(st2, bind="127.0.0.1", backup=True)
+prim.attach_backup("127.0.0.1", back.port, ack="sync")
+uri = f"127.0.0.1:{prim.port}|127.0.0.1:{back.port}"
+w = connect_async(uri, 0, params)
+w.pull_all()
+grads = {k: jnp.full_like(v, 0.01) for k, v in params.items()}
+
+def scrape():
+    url = f"http://127.0.0.1:{srv.port}/metrics"
+    text = urllib.request.urlopen(url, timeout=5).read().decode()
+    out = {}
+    for line in text.splitlines():
+        if line.startswith("#") or not line.strip():
+            continue
+        name, val = line.rsplit(" ", 1)
+        out[name] = float(val)
+    return out
+
+before = scrape()["ps_server_requests_total"]
+for _ in range(5):
+    w.push_pull(grads)
+mid = scrape()  # mid-bench: the pair is still serving
+assert mid["ps_server_requests_total"] > before, \
+    "/metrics counters did not move under traffic"
+assert mid.get("ps_replica_ack_wait_seconds_count", 0) > 0, \
+    "replica-ack histogram empty under sync replication"
+print(f"  /metrics: requests {before:.0f} -> "
+      f"{mid['ps_server_requests_total']:.0f}, ack-hist count "
+      f"{mid['ps_replica_ack_wait_seconds_count']:.0f}")
+
+top = subprocess.run(
+    [sys.executable, "tools/ps_top.py", "--servers", uri,
+     "--once", "--json"],
+    capture_output=True, text=True, timeout=30)
+assert top.returncode == 0, top.stderr
+rows = json.loads(top.stdout)
+roles = sorted(r.get("role") for r in rows)
+assert roles == ["backup", "primary"], roles
+assert all("lat" in (r.get("metrics") or {}) for r in rows
+           if r.get("role") == "primary"), "primary STATS carries no lat"
+print(f"  ps_top --once: {len(rows)} endpoint(s), roles {roles}")
+
+w.close(); back.stop(); prim.stop(); ps.shutdown()
+print("obs smoke OK")
 EOF

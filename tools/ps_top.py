@@ -1,0 +1,167 @@
+#!/usr/bin/env python
+"""ps_top — live cluster table for the PS data plane.
+
+Polls STATS across every shard's replica set (the same ``|``/``,`` URI
+grammar workers use) and renders one line per endpoint: role, epoch,
+version, applies, replication lag/degradation, dedup/stale counters, and
+the latency p99s the new histogram layer exports (README
+"Observability"). Backups answer STATS too (the one data-plane kind a
+backup serves), so the table shows the WHOLE fleet, not just primaries.
+
+Usage::
+
+    python tools/ps_top.py --servers "h0:p0|b0:q0,h1:p1" [--interval 2]
+    python tools/ps_top.py --servers ... --once          # one table, exit
+    python tools/ps_top.py --servers ... --once --json   # machine-readable
+
+``--once --json`` prints one JSON object per endpoint (a list), for CI
+smoke checks and scripting (tools/ci_bench_smoke.sh's obs leg).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+# tools/ run from the repo root; make that explicit for direct execution
+sys.path.insert(0, ".")
+
+from ps_tpu.backends.common import parse_replica_uri  # noqa: E402
+from ps_tpu.control import tensor_van as tv  # noqa: E402
+
+COLS = [
+    ("shard", 5), ("addr", 21), ("role", 8), ("epoch", 5), ("version", 9),
+    ("applies", 9), ("lag", 5), ("repl", 8), ("dedup", 6), ("stale", 6),
+    ("gbps", 7), ("ack_p99_ms", 10), ("bkt_p99_ms", 10),
+]
+
+
+def poll_endpoint(host: str, port: int, timeout_ms: int = 2000) -> dict:
+    """One STATS round trip; errors come back as ``{"error": ...}`` so a
+    dead member renders as a row, not a crash."""
+    try:
+        ch = tv.Channel.connect(host, port, timeout_ms=timeout_ms,
+                                retries=1, max_wait_s=0.5)
+    except (tv.VanError, OSError) as e:
+        return {"error": str(e)}
+    try:
+        kind, _, _, extra = tv.decode(
+            ch.request(tv.encode(tv.STATS, 0, None)))
+        if kind != tv.OK:
+            return {"error": extra.get("error", "STATS refused")}
+        return extra
+    except (tv.VanError, OSError) as e:
+        return {"error": str(e)}
+    finally:
+        ch.close()
+
+
+def poll_fleet(uri: str) -> list:
+    """STATS for every member of every shard's replica set, flattened to
+    ``[{shard, addr, ...stats}]`` in URI order."""
+    _, sets = parse_replica_uri(uri)
+    rows = []
+    for shard, members in enumerate(sets):
+        for host, port in members:
+            st = poll_endpoint(host, port)
+            st["shard"] = shard
+            st["addr"] = f"{host}:{port}"
+            rows.append(st)
+    return rows
+
+
+def _p99_ms(st: dict, which: str):
+    lat = (st.get("metrics") or {}).get("lat") or {}
+    q = lat.get(which)
+    return round(q["p99"] * 1e3, 2) if q else None
+
+
+def _version_of(st: dict):
+    v = st.get("version")
+    if v is None and isinstance(st.get("versions"), dict):
+        v = sum(st["versions"].values())  # sparse: per-table versions
+    return v
+
+
+def render_row(st: dict) -> dict:
+    """The table's view of one endpoint's STATS extra."""
+    if "error" in st:
+        return {"shard": st.get("shard"), "addr": st.get("addr"),
+                "role": "DOWN", "epoch": "-", "version": "-",
+                "applies": "-", "lag": "-", "repl": st["error"][:24],
+                "dedup": "-", "stale": "-", "gbps": "-",
+                "ack_p99_ms": "-", "bkt_p99_ms": "-"}
+    repl = st.get("repl") or {}
+    repl_state = ("degraded" if repl.get("degraded")
+                  else repl.get("ack", "-") if repl else "-")
+    metrics = st.get("metrics") or {}
+    return {
+        "shard": st["shard"],
+        "addr": st["addr"],
+        "role": st.get("role", "?"),
+        "epoch": st.get("epoch", 0),
+        "version": _version_of(st),
+        "applies": st.get("apply_log_total", "-"),
+        "lag": repl.get("lag", st.get("replica_applied_seq", "-")),
+        "repl": repl_state,
+        "dedup": st.get("dedup_hits", 0),
+        "stale": st.get("stale_epochs", 0),
+        "gbps": metrics.get("bucket_gbps", 0.0),
+        # `or "-"` would eat a legitimate 0.0 ms p99 (sub-5µs acks round
+        # to zero); only a MISSING histogram renders as no-data
+        "ack_p99_ms": _opt(_p99_ms(st, "repl_ack_wait_s")),
+        "bkt_p99_ms": _opt(_p99_ms(st, "bucket_s")),
+    }
+
+
+def _opt(v):
+    return "-" if v is None else v
+
+
+def print_table(rows: list, stream=sys.stdout) -> None:
+    hdr = "  ".join(f"{name:>{w}}" for name, w in COLS)
+    print(hdr, file=stream)
+    print("-" * len(hdr), file=stream)
+    for st in rows:
+        r = render_row(st)
+        print("  ".join(f"{str(r[name])[:w]:>{w}}" for name, w in COLS),
+              file=stream)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--servers", required=True,
+                    help="replica-set URI, as workers take it: "
+                         '"h0:p0|b0:q0,h1:p1"')
+    ap.add_argument("--interval", type=float, default=2.0,
+                    help="refresh cadence in seconds (live mode)")
+    ap.add_argument("--once", action="store_true",
+                    help="print one table (or --json blob) and exit")
+    ap.add_argument("--json", action="store_true",
+                    help="with --once: raw per-endpoint STATS as JSON")
+    args = ap.parse_args(argv)
+
+    if args.once:
+        rows = poll_fleet(args.servers)
+        if args.json:
+            print(json.dumps(rows, default=str))
+        else:
+            print_table(rows)
+        return 0
+    try:
+        while True:
+            rows = poll_fleet(args.servers)
+            sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
+            print(f"ps_top  {time.strftime('%H:%M:%S')}  "
+                  f"({args.servers})")
+            print_table(rows)
+            sys.stdout.flush()
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
