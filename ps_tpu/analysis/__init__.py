@@ -1,0 +1,33 @@
+"""Repo-aware static analysis for the PS data plane (``tools/pslint.py``).
+
+Four rule families over the ``ps_tpu`` tree (README "Static analysis"):
+
+- **PSL1xx concurrency** (:mod:`ps_tpu.analysis.locks`): blocking calls
+  under hot locks, foreign condition waits, logging I/O in critical
+  sections, inconsistent lock-acquisition order.
+- **PSL2xx wire protocol** (:mod:`ps_tpu.analysis.wire`): every van
+  message kind named (KIND_NAMES) and handled (dispatch coverage);
+  producer/consumer symmetry of ``extra[...]`` header keys.
+- **PSL3xx resource safety** (:mod:`ps_tpu.analysis.resources`):
+  RecvBufferPool borrow/return pairing, shm segment close/unlink
+  pairing, span open/close exception safety, non-daemon threads.
+- **PSL4xx knob/doc drift** (:mod:`ps_tpu.analysis.knobs`): Config field
+  ↔ ``PS_*`` env mirror ↔ README ↔ config docstrings, four-way.
+
+Run as a gate: ``python tools/pslint.py ps_tpu/`` must exit 0; the
+tier-1 test ``tests/test_analysis.py::test_repo_lints_clean`` enforces
+the same. Suppress a deliberate violation inline, with a reason::
+
+    blocking_call()  # pslint: disable=PSL101 -- bounded by stall_timeout
+
+(the reason is mandatory — PSL001 fires on a bare suppression).
+"""
+
+from ps_tpu.analysis.core import (  # noqa: F401
+    Finding,
+    RepoIndex,
+    all_rules,
+    run_lint,
+)
+
+__all__ = ["Finding", "RepoIndex", "all_rules", "run_lint"]

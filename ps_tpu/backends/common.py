@@ -92,6 +92,11 @@ class _OpScope:
 #: per tree and the pipeline has something to overlap.
 DEFAULT_BUCKET_BYTES = 4 << 20
 
+#: Default drain_to deadline (checkpoint coordinators produce it on the
+#: wire; servers fall back to it for hand-rolled frames). One constant so
+#: the dense/sparse coordinators and both server sides cannot drift.
+DRAIN_TO_TIMEOUT_S = 30.0
+
 # one bucket slice: (key, dtype_str, shape, lo, hi) — byte range [lo, hi)
 # within the key's contiguous row-major buffer
 Slice = Tuple[str, str, list, int, int]

@@ -39,6 +39,7 @@ import numpy as np
 from ps_tpu import obs
 from ps_tpu.backends.common import (
     DEFAULT_BUCKET_BYTES,
+    DRAIN_TO_TIMEOUT_S,
     BucketAssembler,
     BucketedTransportMixin,
     BucketPlan,
@@ -188,7 +189,7 @@ class AsyncPSService(VanService):
                 self.event_log.append(["pull", worker])
             # pulls replicate too: the DC apply depends on what each worker
             # last pulled, so the backup's _stale bookkeeping must follow
-            rseq = self._replicate("pull", worker)
+            rseq = self._replicate("pull", worker)  # pslint: disable=PSL101 -- deliberate backpressure: a full ack window MUST stall commits under the apply lock (that IS the bounded-lag contract), and stall_timeout degrades a corpse instead of wedging
         self._await_replication(rseq)
         host = {k: np.asarray(v) for k, v in kv.items()}
         if self.writev:
@@ -245,7 +246,7 @@ class AsyncPSService(VanService):
             # replicate the post-decode host tree (it owns its buffers by
             # now), carrying the dedup token so a promoted backup
             # suppresses the same replays its primary would have
-            rseq = self._replicate("push", worker, grads,
+            rseq = self._replicate("push", worker, grads,  # pslint: disable=PSL101 -- deliberate backpressure: a full ack window MUST stall commits under the apply lock (that IS the bounded-lag contract), and stall_timeout degrades a corpse instead of wedging
                                    {"pseq": pseq, "pnonce": pnonce})
         return rseq, False
 
@@ -279,13 +280,13 @@ class AsyncPSService(VanService):
         )
         if tree is None:
             return tv.encode(tv.OK, worker, None,
-                             extra={"staged": int(extra["bucket"])})
+                             extra={"staged": int(extra["bucket"])})  # pslint: disable=PSL203 -- debug-visibility ack field: names the staged bucket on the wire for packet-level triage; workers need only the OK
         tree = decode_tree(tree, extra.get("enc"), stats=self.transport)
         rseq, dedup = self._apply_push(worker, tree, copy=False, extra=extra)
         self._await_replication(rseq)
         return tv.encode(tv.OK, worker, None, extra={
             "version": self._engine.version, "committed": True,
-            "dedup": dedup,
+            "dedup": dedup,  # pslint: disable=PSL203 -- exactly-once visibility: asserted by the tests/test_replica.py replay drills; workers treat a dedup'd ack like any other
         })
 
     def _bucket_pull(self, worker: int, extra) -> bytes:
@@ -303,7 +304,7 @@ class AsyncPSService(VanService):
                 version = self._engine.version
                 with self._log_lock:
                     self.event_log.append(["pull", worker])
-                rseq = self._replicate("pull", worker)
+                rseq = self._replicate("pull", worker)  # pslint: disable=PSL101 -- deliberate backpressure: a full ack window MUST stall commits under the apply lock (that IS the bounded-lag contract), and stall_timeout degrades a corpse instead of wedging
             self._await_replication(rseq)
             # contiguous host conversion ONCE; per-bucket encodes then slice
             # it zero-copy (jax arrays convert contiguous, but be explicit)
@@ -483,7 +484,7 @@ class AsyncPSService(VanService):
                 self._pause_cond.notify_all()
             return tv.encode(tv.OK, worker, None,
                              extra={"version": self._engine.version,
-                                    "forced": True})
+                                    "forced": True})  # pslint: disable=PSL203 -- operator-recovery receipt: marks the reply of a force-resume so drills/operators can tell it from a normal resume
         err = self._ckpt_token_error(phase, extra)
         if err is not None:
             # covers both a foreign coordinator racing a live checkpoint
@@ -499,7 +500,8 @@ class AsyncPSService(VanService):
             import time as _time
 
             targets = {int(w): int(n) for w, n in extra["targets"].items()}
-            deadline = _time.monotonic() + float(extra.get("timeout", 30.0))
+            deadline = _time.monotonic() + float(
+                extra.get("timeout", DRAIN_TO_TIMEOUT_S))
             with self._engine._lock:
                 self._drain_targets = targets
                 self._pause_cond.notify_all()
@@ -532,7 +534,7 @@ class AsyncPSService(VanService):
             self._store.save(path)
             version = self._engine.version
         return tv.encode(tv.OK, worker, None,
-                         extra={"version": version, "path": path})
+                         extra={"version": version, "path": path})  # pslint: disable=PSL203 -- save receipt: echoes the resolved server-side path (ckpt_root may have rewritten it) for operators reading the reply in drills/logs
 
     def _set_draining(self) -> None:
         with self._engine._lock:
@@ -1376,8 +1378,12 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                 for extra in paused.values() for w, n in targets.items()
             )
             if lagging:
+                # the drain deadline is the coordinator's to set — the
+                # server defaults it, but an unproduced knob is a dead
+                # knob (pslint PSL203 found exactly that drift here)
                 self._checkpoint_round({"dir": path, "phase": "drain_to",
-                                        "targets": targets},
+                                        "targets": targets,
+                                        "timeout": DRAIN_TO_TIMEOUT_S},
                                        per_server=tokens)
             saves = self._checkpoint_round({"dir": path, "phase": "save"},
                                            per_server=tokens)

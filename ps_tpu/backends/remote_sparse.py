@@ -39,6 +39,7 @@ import numpy as np
 
 from ps_tpu import obs
 from ps_tpu.backends.common import (
+    DRAIN_TO_TIMEOUT_S,
     BucketedTransportMixin,
     BucketPlan,
     ServerFailureError,
@@ -273,7 +274,7 @@ class SparsePSService(VanService):
             self._pause_cond.notify_all()  # a drain_to waiter may watch
             with self._log_lock:
                 self.apply_log.append(worker)
-            rseq = self._replicate("push", worker, wire, {
+            rseq = self._replicate("push", worker, wire, {  # pslint: disable=PSL101 -- deliberate backpressure: a full ack window MUST stall commits under the apply lock (that IS the bounded-lag contract), and stall_timeout degrades a corpse instead of wedging
                 "pseq": pseq, "pnonce": pnonce, "pfan": pfan,
             })
         return rseq, False
@@ -436,7 +437,8 @@ class SparsePSService(VanService):
 
             targets = {int(w): (t[0], int(t[1]))
                        for w, t in extra.get("targets", {}).items()}
-            deadline = _time.monotonic() + float(extra.get("timeout", 30.0))
+            deadline = _time.monotonic() + float(
+                extra.get("timeout", DRAIN_TO_TIMEOUT_S))
 
             def lagging(w, nonce, seq):
                 rec = self._applied_pseq.get(w)
@@ -1092,7 +1094,10 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                     i: dict(tokens.get(i, {}), targets=drain.get(i, {}))
                     for i in range(len(self._chs))
                 }
-                self._checkpoint_round({"dir": path, "phase": "drain_to"},
+                # the drain deadline is the coordinator's to set, and the
+                # dense and sparse coordinators must agree on who owns it
+                self._checkpoint_round({"dir": path, "phase": "drain_to",
+                                        "timeout": DRAIN_TO_TIMEOUT_S},
                                        per_server=per_server)
             saves = self._checkpoint_round({"dir": path, "phase": "save"},
                                            per_server=tokens)

@@ -308,7 +308,7 @@ class VanService:
                 return tv.encode(tv.ERR, worker, None, extra={
                     "error": f"cannot promote a {self.role} service",
                 })
-            epoch = self.promote(reason=str(extra.get("reason", "request")))
+            epoch = self.promote(reason=str(extra.get("reason", "request")))  # pslint: disable=PSL203 -- REPLICA_PROMOTE is an operator/test-sent frame; in-tree promotion goes through PromotionWatch.promote(), so no in-tree encoder produces "reason"
             return tv.encode(tv.OK, worker, None,
                              extra={"epoch": epoch, "role": self.role})
         if self.role != "backup":
@@ -412,7 +412,7 @@ class VanService:
                 # checkpoint, seed the new backup from it, re-attach)
             hello = self._replica_hello_extra()
             hello.update({"epoch": self.epoch, "ack": ack})
-            session = BackupSession(host, port, hello, ack=ack,
+            session = BackupSession(host, port, hello, ack=ack,  # pslint: disable=PSL101 -- attach-time only (before worker traffic, or quiesced): the dial+HELLO must be atomic with the state-point snapshot the lock protects, and connect_timeout_ms bounds it
                                     window=window, compress=compress,
                                     stats=self.transport,
                                     stall_timeout=stall_timeout)
@@ -524,34 +524,50 @@ class VanService:
         the whole staged epoch, so a retry starts clean instead of
         completing against poisoned state.
         """
-        with self._stage_lock:
-            asm = self._push_stage.get(worker)
-            if asm is not None and (asm.epoch != epoch
-                                    or getattr(asm, "nonce", None) != nonce):
-                # observable, not just a log line: STATS carries the counts
-                # so a fleet-wide rash of abandoned pushes shows up in the
-                # worker's StepLogger instead of only in server stderr
-                self.transport.record_stale_epoch(len(asm._seen))
+        stale = None  # (epoch, staged, nbuckets) of a dropped stale epoch
+        try:
+            with self._stage_lock:
+                asm = self._push_stage.get(worker)
+                if asm is not None and (asm.epoch != epoch
+                                        or getattr(asm, "nonce",
+                                                   None) != nonce):
+                    # record the drop, but account/log it OUTSIDE the
+                    # stage lock: metrics/flight/logging do their own
+                    # locking and I/O, and every bucket of every worker
+                    # serializes here
+                    stale = (asm.epoch, len(asm._seen), asm.nbuckets)
+                    asm = None
+                if asm is None:
+                    asm = BucketAssembler(epoch, nbuckets)
+                    asm.nonce = nonce
+                    self._push_stage[worker] = asm
+                try:
+                    complete = asm.add(bucket, raw, slices, epoch)
+                except Exception:
+                    self._push_stage.pop(worker, None)
+                    raise
+                if complete:
+                    del self._push_stage[worker]
+        finally:
+            # finally, not fallthrough: a malformed first bucket of the
+            # SUPERSEDING epoch raises out of the block above, and the
+            # dropped stale epoch must still reach the black box — the
+            # double-fault is exactly when the record matters most
+            if stale is not None:
+                # observable, not just a log line: STATS carries the
+                # counts so a fleet-wide rash of abandoned pushes shows
+                # up in the worker's StepLogger instead of only in
+                # server stderr
+                old_epoch, staged, nbuckets = stale
+                self.transport.record_stale_epoch(staged)
                 obs.record_event("stale_epoch", worker=worker,
-                                 epoch=asm.epoch, superseded_by=epoch,
-                                 buckets=len(asm._seen))
+                                 epoch=old_epoch, superseded_by=epoch,
+                                 buckets=staged)
                 logging.getLogger(__name__).warning(
                     "worker %d abandoned push epoch %d (%d/%d buckets); "
-                    "superseded by epoch %d", worker, asm.epoch,
-                    len(asm._seen), asm.nbuckets, epoch,
+                    "superseded by epoch %d", worker, old_epoch,
+                    staged, nbuckets, epoch,
                 )
-                asm = None
-            if asm is None:
-                asm = BucketAssembler(epoch, nbuckets)
-                asm.nonce = nonce
-                self._push_stage[worker] = asm
-            try:
-                complete = asm.add(bucket, raw, slices, epoch)
-            except Exception:
-                self._push_stage.pop(worker, None)
-                raise
-            if complete:
-                del self._push_stage[worker]
         return asm.finish() if complete else None
 
     # -- checkpoint ownership tokens ------------------------------------------

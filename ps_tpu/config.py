@@ -13,6 +13,10 @@ Environment variables honored by :meth:`Config.from_env`:
 - ``PS_COORDINATOR_URI``   — multi-host coordinator ``host:port`` (tpu backend)
 - ``PS_NUM_PROCESSES``     — multi-host process count
 - ``PS_PROCESS_ID``        — this process's id
+- ``PS_MODE``              — 'sync' or 'async' (delay-compensated)
+- ``PS_DC_LAMBDA``         — DC-ASGD delay-compensation coefficient
+  (async mode; default 0.04)
+- ``PS_SEED``              — global PRNG seed
 - ``PS_ROLE``              — cross-process PS deployments: 'server' or
   'worker' (unset = the SPMD single-controller topology)
 - ``PS_SERVER_URIS``       — worker side: ``h0:p0,h1:p1,...`` naming every
@@ -55,6 +59,13 @@ Environment variables honored by :meth:`Config.from_env`:
   per process (0 = ephemeral port; unset = no endpoint)
 - ``PS_FLIGHT_EVENTS``       — flight-recorder ring capacity (default
   4096 typed events)
+- ``PS_HEARTBEAT_BASE_PORT`` — enable the UDP failure detector; process
+  i's monitor binds base_port+i (single-host layout)
+- ``PS_PEER_HOSTS``          — multi-host monitor addresses, entry i for
+  process i (``host`` or ``host:port``, comma-separated)
+- ``PS_HEARTBEAT_BIND``      — monitor listen address override
+- ``PS_HEARTBEAT_INTERVAL_MS`` / ``PS_HEARTBEAT_TIMEOUT_MS`` — beat
+  cadence and the silent-horizon declaring a peer dead
 - ``DMLC_ROLE``, ``DMLC_NUM_WORKER``, ``DMLC_NUM_SERVER``,
   ``DMLC_PS_ROOT_URI``/``_PORT`` are accepted as aliases where the meaning
   is knowable, so reference-family launcher scripts keep working.
@@ -100,6 +111,16 @@ class Config:
         multi-host runs. ``None`` means single-host.
       num_processes / process_id: multi-host topology for
         ``jax.distributed.initialize``.
+      role: cross-process PS deployments — 'server' or 'worker' (None =
+        the SPMD single-controller topology with no PS processes).
+      server_uris: worker side — ``h0:p0,h1:p1,...`` naming every server
+        of the partition (``|``-separated replica sets per shard).
+      worker_id: this worker's id within the cross-process job.
+      shard / num_shards: server side — this server's index in / the
+        size of the key (or row-range) partition.
+      ckpt_root: server side — confine CHECKPOINT saves under this root
+        (client paths relative-only, ``..`` refused); None keeps the
+        legacy client-names-the-path behavior (loopback binds only).
       mesh_shape: optional explicit mesh shape, e.g. ``{'data': 8}`` or
         ``{'data': 4, 'model': 2}``. Default: all devices on one 'data' axis.
       mode: 'sync' or 'async' (async = stale apply with delay compensation).
@@ -184,7 +205,7 @@ class Config:
     coordinator_uri: Optional[str] = None
     num_processes: int = 1
     process_id: int = 0
-    mesh_shape: Optional[dict] = None
+    mesh_shape: Optional[dict] = None  # pslint: disable=PSL402 -- a structured {axis: size} dict, not env-spellable; launchers pass it programmatically
     mode: str = "sync"
     dc_lambda: float = 0.04
     seed: int = 0
@@ -402,6 +423,8 @@ class Config:
             kwargs["process_id"] = int(env["PS_PROCESS_ID"])
         if "PS_MODE" in env:
             kwargs["mode"] = env["PS_MODE"]
+        if "PS_DC_LAMBDA" in env:
+            kwargs["dc_lambda"] = float(env["PS_DC_LAMBDA"])
         if "PS_SEED" in env:
             kwargs["seed"] = int(env["PS_SEED"])
         if "PS_ROLE" in env:

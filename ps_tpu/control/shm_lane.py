@@ -595,6 +595,18 @@ def accept_upgrade(ch, extra: dict, stats=None) -> ServerShmLane:
     except Exception:
         c2s.close()
         raise
+    # the offer names the segment size it created; the attach must see
+    # exactly that (a truncated/raced segment would corrupt ring framing
+    # at the first wrap) — this is also what keeps the advertised
+    # "bytes" header field honest (pslint PSL203: produced AND consumed)
+    want = int(extra.get("bytes") or 0)
+    if want and (len(c2s.buf) != want or len(s2c.buf) != want):
+        c2s.close()
+        s2c.close()
+        raise ValueError(
+            f"shm lane refused: segment size mismatch (offer says {want} "
+            f"bytes, attached {len(c2s.buf)}/{len(s2c.buf)})"
+        )
     try:
         return ServerShmLane(ch, tx=ShmRing(s2c.buf), rx=ShmRing(c2s.buf),
                              segs=[c2s, s2c], stats=stats)

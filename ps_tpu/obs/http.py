@@ -85,6 +85,7 @@ def start_metrics_server(port: Optional[int] = None,
         if v is None or v.strip() == "":
             return _server
         port = int(v)
+    err: Optional[OSError] = None
     with _lock:
         if _server is None:
             try:
@@ -92,16 +93,21 @@ def start_metrics_server(port: Optional[int] = None,
             except OSError as e:
                 # a second process on the host with the same fixed port
                 # (primary + backup services, mp drills): the opt-in
-                # endpoint must NEVER take the data plane down with it
-                import logging
+                # endpoint must NEVER take the data plane down with it.
+                # The warning is emitted below, after the lock: logging
+                # does its own locking + I/O (pslint PSL103).
+                err = e
+        server = _server
+    if err is not None:
+        import logging
 
-                logging.getLogger(__name__).warning(
-                    "/metrics endpoint disabled: could not bind %s:%s "
-                    "(%s) — another process on this host probably holds "
-                    "the port; give each process its own PS_METRICS_PORT",
-                    bind, port, e)
-                return None
-        return _server
+        logging.getLogger(__name__).warning(
+            "/metrics endpoint disabled: could not bind %s:%s "
+            "(%s) — another process on this host probably holds "
+            "the port; give each process its own PS_METRICS_PORT",
+            bind, port, err)
+        return None
+    return server
 
 
 def stop_metrics_server() -> None:

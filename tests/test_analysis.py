@@ -1,0 +1,770 @@
+"""pslint (ps_tpu/analysis): every rule family fires on its seeded
+violation fixture AND the repo itself lints clean — both tier-1.
+
+The fixture corpus writes tiny modules with exactly one planted bug per
+test into tmp_path and asserts the expected rule id at the expected
+line; the clean-repo test runs the full gate over ``ps_tpu/`` with the
+same context the CLI uses, which is what "the analysis layer makes these
+bugs un-committable" means in practice.
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from ps_tpu.analysis import run_lint
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _write(tmp_path, name, src):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(src))
+    return str(p)
+
+
+def _lint(tmp_path, rules=None, readme=None, context=()):
+    return run_lint([str(tmp_path)], context=context, readme=readme,
+                    rules=rules)
+
+
+def _rules_of(findings):
+    return [f.rule for f in findings]
+
+
+# -- PSL1xx concurrency --------------------------------------------------------
+
+
+def test_psl101_direct_blocking_under_lock(tmp_path):
+    _write(tmp_path, "m.py", """
+        import threading
+        import time
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad(self):
+                with self._lock:
+                    time.sleep(1)
+        """)
+    f = [x for x in _lint(tmp_path, rules=["PSL1"]) if x.rule == "PSL101"]
+    assert len(f) == 1 and "sleep" in f[0].message
+    assert f[0].line == 11
+
+
+def test_psl101_transitive_blocking_via_method(tmp_path):
+    _write(tmp_path, "m.py", """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def outer(self):
+                with self._lock:
+                    self.helper()
+
+            def helper(self):
+                self._ch.recv()
+        """)
+    f = [x for x in _lint(tmp_path, rules=["PSL1"]) if x.rule == "PSL101"]
+    assert len(f) == 1 and "helper" in f[0].message
+
+
+def test_psl101_blocking_via_constructor(tmp_path):
+    _write(tmp_path, "m.py", """
+        import threading
+
+        class Dialer:
+            def __init__(self, host):
+                self._ch = connect(host)
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def attach(self):
+                with self._lock:
+                    self._d = Dialer("h")
+        """)
+    f = [x for x in _lint(tmp_path, rules=["PSL1"]) if x.rule == "PSL101"]
+    assert len(f) == 1 and "__init__" in f[0].message
+
+
+def test_psl101_condition_wait_on_own_lock_is_exempt(tmp_path):
+    _write(tmp_path, "m.py", """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._pause_cond = threading.Condition(self._lock)
+                self._other_cond = threading.Condition()
+
+            def ok(self):
+                with self._lock:
+                    self._pause_cond.wait()
+
+            def also_ok(self):
+                with self._other_cond:
+                    self._other_cond.wait()
+
+            def bad(self):
+                with self._lock:
+                    self._other_cond.wait()
+        """)
+    f = [x for x in _lint(tmp_path, rules=["PSL1"]) if x.rule == "PSL101"]
+    assert len(f) == 1
+    assert f[0].line == 20  # only the foreign-condition wait
+
+
+def test_psl101_engine_apply_under_foreign_lock(tmp_path):
+    _write(tmp_path, "m.py", """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._stage_lock = threading.Lock()
+
+            def ok(self):
+                with self._lock:
+                    self._engine.push_tree({})
+
+            def bad(self):
+                with self._stage_lock:
+                    self._engine.push_tree({})
+        """)
+    f = [x for x in _lint(tmp_path, rules=["PSL1"]) if x.rule == "PSL101"]
+    assert len(f) == 1 and "_stage_lock" in f[0].message
+
+
+def test_psl102_lock_order_cycle(tmp_path):
+    _write(tmp_path, "m.py", """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+
+            def one(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+
+            def two(self):
+                with self._b_lock:
+                    with self._a_lock:
+                        pass
+        """)
+    f = [x for x in _lint(tmp_path, rules=["PSL1"]) if x.rule == "PSL102"]
+    assert len(f) == 1 and "deadlock" in f[0].message
+
+
+def test_psl101_blocking_call_as_context_manager(tmp_path):
+    """`with connect(...) as c:` under a held lock blocks exactly like a
+    plain-statement dial — the with-item context expr is scanned too."""
+    _write(tmp_path, "m.py", """
+        import threading
+
+        def connect(h, p):
+            pass
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad(self, h, p):
+                with self._lock:
+                    with connect(h, p) as c:
+                        c.use()
+        """)
+    f = [x for x in _lint(tmp_path, rules=["PSL1"]) if x.rule == "PSL101"]
+    assert len(f) == 1 and "connect" in f[0].message
+    assert f[0].line == 13
+
+
+def test_psl102_three_lock_cycle_no_reversed_pair(tmp_path):
+    """A->B, B->C, C->A: a classic deadlock cycle where no single pair
+    is ever acquired in opposite orders — pairwise checks miss it."""
+    _write(tmp_path, "m.py", """
+        import threading
+
+        class S:
+            def __init__(self):
+                self._a_lock = threading.Lock()
+                self._b_lock = threading.Lock()
+                self._c_lock = threading.Lock()
+
+            def one(self):
+                with self._a_lock:
+                    with self._b_lock:
+                        pass
+
+            def two(self):
+                with self._b_lock:
+                    with self._c_lock:
+                        pass
+
+            def three(self):
+                with self._c_lock:
+                    with self._a_lock:
+                        pass
+        """)
+    f = [x for x in _lint(tmp_path, rules=["PSL1"]) if x.rule == "PSL102"]
+    assert len(f) == 1 and "cycle" in f[0].message \
+        and "deadlock" in f[0].message
+
+
+def test_psl103_logging_under_lock(tmp_path):
+    _write(tmp_path, "m.py", """
+        import logging
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad(self):
+                with self._lock:
+                    logging.getLogger(__name__).warning("x")
+        """)
+    f = [x for x in _lint(tmp_path, rules=["PSL1"]) if x.rule == "PSL103"]
+    assert len(f) == 1
+
+
+def test_psl101_os_path_join_is_not_a_thread_join(tmp_path):
+    _write(tmp_path, "m.py", """
+        import os
+        import threading
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def ok(self):
+                with self._lock:
+                    p = os.path.join("a", "b")
+                    s = ",".join(["x", "y"])
+                    return p, s
+
+            def bad(self):
+                with self._lock:
+                    self._t.join(timeout=5)
+        """)
+    f = [x for x in _lint(tmp_path, rules=["PSL1"]) if x.rule == "PSL101"]
+    assert len(f) == 1 and f[0].line == 17
+
+
+# -- PSL2xx wire protocol ------------------------------------------------------
+
+_KIND_MODULE = """
+    # fixture twin of ps_tpu/control/tensor_van.py
+    HELLO = 0
+    PUSH = 2
+    OK = 6
+    ERR = 7
+    LOST = 9
+
+    KIND_NAMES = {HELLO: "hello", PUSH: "push", OK: "ok", ERR: "err"}
+
+    def _handle(kind, worker, tensors, extra):
+        if kind == HELLO:
+            return b"ok"
+        if kind == PUSH:
+            return b"ok"
+        return b"err"
+    """
+
+
+def test_psl201_kind_without_name(tmp_path):
+    _write(tmp_path, "van.py", _KIND_MODULE)
+    f = [x for x in _lint(tmp_path, rules=["PSL2"]) if x.rule == "PSL201"]
+    assert len(f) == 1 and "LOST" in f[0].message
+
+
+def test_psl202_kind_without_handler(tmp_path):
+    _write(tmp_path, "van.py", _KIND_MODULE)
+    f = [x for x in _lint(tmp_path, rules=["PSL2"]) if x.rule == "PSL202"]
+    # LOST has no handler; OK/ERR are reply-only and exempt
+    assert len(f) == 1 and "LOST" in f[0].message
+
+
+def test_psl202_frozenset_membership_counts_as_handled(tmp_path):
+    _write(tmp_path, "van.py", """
+        HELLO = 0
+        REPLICA_APPEND = 17
+        KIND_NAMES = {HELLO: "hello", REPLICA_APPEND: "replica_append"}
+        _REPLICA_KINDS = frozenset({REPLICA_APPEND})
+
+        def _dispatch(kind):
+            if kind in _REPLICA_KINDS:
+                return b"replica"
+            if kind == HELLO:
+                return b"hello"
+        """)
+    assert not [x for x in _lint(tmp_path, rules=["PSL2"])
+                if x.rule == "PSL202"]
+
+
+def test_psl203_consumed_but_never_produced(tmp_path):
+    _write(tmp_path, "srv.py", """
+        from ps_tpu.control import tensor_van as tv
+
+        def handle(extra):
+            return extra.get("ghost_key")
+        """)
+    f = [x for x in _lint(tmp_path, rules=["PSL2"]) if x.rule == "PSL203"]
+    assert len(f) == 1 and "ghost_key" in f[0].message \
+        and f[0].severity == "P1"
+
+
+def test_psl203_produced_but_never_consumed(tmp_path):
+    _write(tmp_path, "wk.py", """
+        from ps_tpu.control import tensor_van as tv
+
+        def send(ch, worker):
+            ch.send(tv.encode(2, worker, None, extra={"dead_key": 1}))
+        """)
+    f = [x for x in _lint(tmp_path, rules=["PSL2"]) if x.rule == "PSL203"]
+    assert len(f) == 1 and "dead_key" in f[0].message \
+        and f[0].severity == "P2"
+
+
+def test_psl203_symmetric_key_is_clean(tmp_path):
+    _write(tmp_path, "both.py", """
+        from ps_tpu.control import tensor_van as tv
+
+        def send(ch, worker):
+            ch.send(tv.encode(2, worker, None, extra={"live_key": 1}))
+
+        def handle(extra):
+            return extra.get("live_key")
+        """)
+    assert not [x for x in _lint(tmp_path, rules=["PSL2"])
+                if x.rule == "PSL203"]
+
+
+def test_psl203_module_level_consumer_is_seen(tmp_path):
+    """Header keys read at module scope (scripts' toplevel) join the
+    symmetry sets via the module pseudo-entry."""
+    _write(tmp_path, "script.py", """
+        from ps_tpu.control import tensor_van as tv
+
+        extra = tv.decode(b"")[3]
+        ghost = extra.get("toplevel_ghost")
+        """)
+    f = [x for x in _lint(tmp_path, rules=["PSL2"]) if x.rule == "PSL203"]
+    assert any("toplevel_ghost" in x.message for x in f)
+
+
+def test_psl203_context_consumer_keeps_producer_clean(tmp_path):
+    prod = tmp_path / "prod"
+    prod.mkdir()
+    _write(prod, "wk.py", """
+        from ps_tpu.control import tensor_van as tv
+
+        def send(ch, worker):
+            ch.send(tv.encode(4, worker, None, extra={"stats_key": 1}))
+        """)
+    tool = _write(tmp_path, "tool.py", """
+        def render(row):
+            return row.get("stats_key")
+        """)
+    f = run_lint([str(prod)], context=[tool], rules=["PSL2"])
+    assert not [x for x in f if x.rule == "PSL203"]
+    # ...and findings never anchor in context files
+    f2 = run_lint([str(prod)], rules=["PSL2"])
+    assert [x.rule for x in f2] == ["PSL203"]
+
+
+# -- PSL3xx resource safety ----------------------------------------------------
+
+
+def test_psl301_stranded_borrow(tmp_path):
+    _write(tmp_path, "m.py", """
+        def bad(pool, n):
+            buf = pool.borrow(n)
+            if buf is None:
+                raise RuntimeError("no buffer")
+            fill(buf)
+        """)
+    f = [x for x in _lint(tmp_path, rules=["PSL3"]) if x.rule == "PSL301"]
+    assert len(f) == 1
+
+
+def test_psl301_ret_or_ownership_transfer_is_clean(tmp_path):
+    _write(tmp_path, "m.py", """
+        def ok_ret(pool, n):
+            buf = pool.borrow(n)
+            fill(buf)
+            pool.ret(buf)
+
+        def ok_escape(pool, n):
+            buf = pool.borrow(n)
+            return memoryview(buf)
+        """)
+    assert not [x for x in _lint(tmp_path, rules=["PSL3"])
+                if x.rule == "PSL301"]
+
+
+def test_psl302_segments_without_unlink(tmp_path):
+    _write(tmp_path, "m.py", """
+        def bad(size):
+            a = _create(size)
+            b = _create(size)
+            return negotiate(a, b)
+        """)
+    f = [x for x in _lint(tmp_path, rules=["PSL3"]) if x.rule == "PSL302"]
+    assert len(f) == 1 and "unlink" in f[0].message
+
+
+def test_psl302_shm_open_without_os_close(tmp_path):
+    _write(tmp_path, "m.py", """
+        import _posixshmem
+
+        def bad(name):
+            fd = _posixshmem.shm_open(name, 0, mode=0o600)
+            return mmap_it(fd)
+        """)
+    f = [x for x in _lint(tmp_path, rules=["PSL3"]) if x.rule == "PSL302"]
+    assert len(f) == 1 and "os.close" in f[0].message
+
+
+def test_psl303_span_never_entered(tmp_path):
+    _write(tmp_path, "m.py", """
+        def bad(tracer):
+            tracer.span("op", cat="worker")
+            do_work()
+        """)
+    f = [x for x in _lint(tmp_path, rules=["PSL3"]) if x.rule == "PSL303"]
+    assert len(f) == 1 and "never entered" in f[0].message
+
+
+def test_psl303_with_or_passed_span_is_clean(tmp_path):
+    _write(tmp_path, "m.py", """
+        def ok_with(tracer):
+            with tracer.span("op").set(worker=0):
+                do_work()
+
+        def ok_passed(tracer):
+            sp = tracer.span("op")
+            return Scope(sp)
+        """)
+    assert not [x for x in _lint(tmp_path, rules=["PSL3"])
+                if x.rule == "PSL303"]
+
+
+def test_psl303_manual_enter_without_finally_exit(tmp_path):
+    _write(tmp_path, "m.py", """
+        def bad(sp):
+            sp.__enter__()
+            do_work()
+            sp.__exit__(None, None, None)
+
+        def ok(sp):
+            sp.__enter__()
+            try:
+                do_work()
+            finally:
+                sp.__exit__(None, None, None)
+        """)
+    f = [x for x in _lint(tmp_path, rules=["PSL3"]) if x.rule == "PSL303"]
+    assert len(f) == 1 and f[0].line == 3
+
+
+def test_psl304_non_daemon_thread_never_joined(tmp_path):
+    _write(tmp_path, "m.py", """
+        import threading
+
+        class S:
+            def start_bad(self):
+                self._t = threading.Thread(target=self._loop)
+                self._t.start()
+
+            def start_ok(self):
+                self._t2 = threading.Thread(target=self._loop, daemon=True)
+                self._t2.start()
+
+            def start_joined(self):
+                self._t3 = threading.Thread(target=self._loop)
+                self._t3.start()
+                self._t3.join()
+        """)
+    f = [x for x in _lint(tmp_path, rules=["PSL3"]) if x.rule == "PSL304"]
+    assert len(f) == 1 and f[0].line == 6
+
+
+# -- PSL4xx knob drift ---------------------------------------------------------
+
+
+def _knob_fixture(tmp_path):
+    readme = tmp_path / "README.md"
+    readme.write_text("Knobs: `PS_A`, `PS_B`. Legacy: `PS_GONE`.\n")
+    _write(tmp_path, "config.py", '''
+        """Fixture config.
+
+        Env vars: ``PS_A``.
+        """
+
+        import dataclasses
+        import os
+
+
+        @dataclasses.dataclass
+        class Config:
+            """Fixture.
+
+            Attributes:
+              a: documented knob.
+              b: documented knob.
+            """
+
+            a: int = 0
+            b: int = 0
+            undocumented: int = 0
+
+            @classmethod
+            def from_env(cls, **overrides):
+                env = os.environ
+                kwargs = {}
+                if "PS_A" in env:
+                    kwargs["a"] = int(env["PS_A"])
+                if "PS_B" in env:
+                    kwargs["b"] = int(env["PS_B"])
+                kwargs.update(overrides)
+                return cls(**kwargs)
+        ''')
+    _write(tmp_path, "other.py", """
+        import os
+
+        def secret_knob():
+            return os.environ.get("PS_HIDDEN")
+        """)
+    return str(readme)
+
+
+def test_psl401_402_403_404_405(tmp_path):
+    readme = _knob_fixture(tmp_path)
+    f = _lint(tmp_path, rules=["PSL4"], readme=readme)
+    by_rule = {}
+    for x in f:
+        by_rule.setdefault(x.rule, []).append(x.message)
+    # undocumented field, field without env mirror, env not in module
+    # docstring, env not in README, documented-but-dead env
+    assert any("undocumented" in m for m in by_rule.get("PSL401", []))
+    assert any("'undocumented'" in m for m in by_rule.get("PSL402", []))
+    assert any("PS_B" in m for m in by_rule.get("PSL403", []))
+    assert any("PS_HIDDEN" in m for m in by_rule.get("PSL404", []))
+    assert any("PS_GONE" in m for m in by_rule.get("PSL405", []))
+    # PS_A is fully mirrored: never reported by any rule
+    assert not any("PS_A " in m for ms in by_rule.values() for m in ms)
+
+
+def test_psl405_context_reader_keeps_knob_alive(tmp_path):
+    """A documented env var read ONLY by a context file (an operator
+    tool) is not doc rot — context readers count as consumers."""
+    readme = tmp_path / "README.md"
+    readme.write_text("Set `PS_TOOL_ONLY` for the tool.\n")
+    code = tmp_path / "code"
+    code.mkdir()
+    _write(code, "m.py", "x = 1\n")
+    tool = tmp_path / "tool"
+    tool.mkdir()
+    _write(tool, "t.py", """
+        import os
+
+        PORT = os.environ.get("PS_TOOL_ONLY")
+        """)
+    f = run_lint([str(code)], context=[str(tool)], readme=str(readme),
+                 rules=["PSL4"])
+    assert not [x for x in f if "PS_TOOL_ONLY" in x.message]
+    # without the context evidence the same knob IS doc rot
+    f2 = run_lint([str(code)], readme=str(readme), rules=["PSL4"])
+    assert [x for x in f2
+            if x.rule == "PSL405" and "PS_TOOL_ONLY" in x.message]
+
+
+def test_psl404_dmlc_alias_substring_is_not_matched(tmp_path):
+    readme = tmp_path / "README.md"
+    readme.write_text("Aliases: `DMLC_PS_ROOT_URI` works.\n")
+    _write(tmp_path, "m.py", """
+        import os
+
+        def alias():
+            return os.environ.get("DMLC_PS_ROOT_URI")
+        """)
+    f = _lint(tmp_path, rules=["PSL4"], readme=str(readme))
+    assert not [x for x in f if "PS_ROOT_URI" in x.message]
+
+
+# -- suppressions --------------------------------------------------------------
+
+
+def test_suppression_with_reason_silences(tmp_path):
+    _write(tmp_path, "m.py", """
+        import threading
+        import time
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad(self):
+                with self._lock:
+                    time.sleep(1)  # pslint: disable=PSL101 -- fixture: deliberate
+        """)
+    f = _lint(tmp_path, rules=["PSL1"])
+    assert not f
+
+
+def test_suppression_without_reason_is_psl001(tmp_path):
+    _write(tmp_path, "m.py", """
+        import threading
+        import time
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad(self):
+                with self._lock:
+                    time.sleep(1)  # pslint: disable=PSL101
+        """)
+    rules = _rules_of(_lint(tmp_path, rules=["PSL1"]))
+    assert "PSL001" in rules  # the bare suppression is itself a finding
+    assert "PSL101" not in rules  # ...but it does suppress
+
+
+def test_suppression_on_wrong_line_does_not_silence(tmp_path):
+    _write(tmp_path, "m.py", """
+        # pslint: disable=PSL101 -- wrong line, must not apply
+        import threading
+        import time
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad(self):
+                with self._lock:
+                    time.sleep(1)
+        """)
+    assert "PSL101" in _rules_of(_lint(tmp_path, rules=["PSL1"]))
+
+
+# -- the repo gate -------------------------------------------------------------
+
+
+def _repo_context():
+    return ([os.path.join(REPO, "tools"), os.path.join(REPO, "bench.py")],
+            os.path.join(REPO, "README.md"))
+
+
+def test_repo_lints_clean():
+    """THE gate: ps_tpu/ must stay clean (fix or suppress-with-reason)."""
+    context, readme = _repo_context()
+    findings = run_lint([os.path.join(REPO, "ps_tpu")],
+                        context=context, readme=readme)
+    assert findings == [], "\n" + "\n".join(f.format() for f in findings)
+
+
+def test_repo_suppressions_all_carry_reasons():
+    from ps_tpu.analysis.core import RepoIndex
+
+    context, readme = _repo_context()
+    idx = RepoIndex([os.path.join(REPO, "ps_tpu")], context=context,
+                    readme=readme)
+    for sf in idx.files:
+        for line, (ids, reason) in sf.suppressions.items():
+            assert reason, f"{sf.path}:{line} suppression has no reason"
+
+
+def test_cli_gate_exits_zero():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "pslint.py"),
+         os.path.join(REPO, "ps_tpu")],
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "clean" in proc.stderr
+
+
+def test_cli_reports_findings_nonzero(tmp_path):
+    _write(tmp_path, "m.py", """
+        import threading
+        import time
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad(self):
+                with self._lock:
+                    time.sleep(1)
+        """)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "pslint.py"),
+         str(tmp_path), "--no-default-context", "--json"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 1
+    import json
+
+    findings = json.loads(proc.stdout)
+    assert any(f["rule"] == "PSL101" for f in findings)
+
+
+def test_list_rules():
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "pslint.py"),
+         "--list-rules"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 0
+    for family in ("PSL1", "PSL2", "PSL3", "PSL4"):
+        assert family in proc.stdout
+
+
+def test_nonexistent_path_fails_the_gate(tmp_path):
+    """A typo'd/renamed root must be PSL000, never a silent 'clean'."""
+    f = run_lint([str(tmp_path / "no_such_dir")])
+    assert any(x.rule == "PSL000" for x in f)
+
+
+def test_unknown_rules_selection_is_an_error():
+    """--rules with a typo must error out, not skip every family and
+    report clean."""
+    with pytest.raises(ValueError, match="PSL9"):
+        run_lint([os.path.join(REPO, "ps_tpu", "analysis")],
+                 rules=["PSL9"])
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "pslint.py"),
+         os.path.join(REPO, "ps_tpu", "analysis"), "--rules", "PSL9"],
+        capture_output=True, text=True, timeout=120)
+    assert proc.returncode == 2
+
+
+def test_concrete_rule_id_selects_its_family(tmp_path):
+    """--rules PSL101 (a concrete id, the natural spot-check spelling)
+    runs the PSL1 family and keeps only PSL101 findings."""
+    _write(tmp_path, "m.py", """
+        import threading
+        import time
+        import logging
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad(self):
+                with self._lock:
+                    time.sleep(1)
+                    logging.warning("held")
+        """)
+    f = _lint(tmp_path, rules=["PSL101"])
+    assert _rules_of(f) == ["PSL101"]  # the PSL103 logging hit filtered

@@ -173,6 +173,25 @@ def test_tsan_van_clean():
     assert "TSAN: clean" in proc.stdout
 
 
+@pytest.mark.slow
+def test_asan_van_clean():
+    """The memory-safety sibling: the same native driver under
+    AddressSanitizer (leaks included) + UndefinedBehaviorSanitizer
+    (tools/asan_van.sh) with zero reports."""
+    import shutil
+
+    if shutil.which("g++") is None:
+        pytest.skip("no g++")
+    script = os.path.join(_REPO, "tools", "asan_van.sh")
+    proc = subprocess.run([script], capture_output=True, text=True,
+                          timeout=300)
+    if "libasan" in proc.stderr and proc.returncode != 0 and (
+            "cannot find" in proc.stderr or "No such file" in proc.stderr):
+        pytest.skip("libasan unavailable")
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "ASAN/UBSAN: clean" in proc.stdout
+
+
 # -- the jax coordination seam the clean-abort path rides ---------------------
 
 

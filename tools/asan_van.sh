@@ -1,0 +1,20 @@
+#!/usr/bin/env bash
+# AddressSanitizer + UndefinedBehaviorSanitizer run for the native van —
+# the memory-safety sibling of tools/tsan_van.sh (same driver, different
+# sanitizers: TSan sees races, ASan sees heap/stack misuse and leaks in
+# the handle lifecycle, UBSan sees signed overflow / bad casts in the
+# framing math). Wired into tools/ci_lint.sh and
+# tests/test_failure.py::test_asan_van_clean (slow-marked), runnable
+# standalone from the repo root: tools/asan_van.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+out=$(mktemp -d)
+trap 'rm -rf "$out"' EXIT
+g++ -std=c++17 -O1 -g -fsanitize=address,undefined -fno-omit-frame-pointer \
+    ps_tpu/native/van.cpp tools/tsan_van.cpp -o "$out/asan_van" -lpthread
+# halt_on_error: any report fails the leg; detect_leaks catches lost
+# Conn/Listener/Server handles (the drivers close everything they open)
+ASAN_OPTIONS="halt_on_error=1 detect_leaks=1" \
+UBSAN_OPTIONS="halt_on_error=1 print_stacktrace=1" \
+    "$out/asan_van"
+echo "ASAN/UBSAN: clean"

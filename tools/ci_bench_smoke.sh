@@ -15,7 +15,12 @@
 #      pair and checks both roles render.
 #
 # Usage: tools/ci_bench_smoke.sh   (from the repo root)
+#
+# Leg 0 (< 30 s): tools/ci_lint.sh — pslint static analysis + the
+# TSan and ASan/UBSan native-van legs; a lint finding or sanitizer
+# report fails the smoke before any bench runs.
 set -euo pipefail
+bash "$(dirname "$0")/ci_lint.sh"
 out=$(timeout -k 10 120 env JAX_PLATFORMS=cpu python bench.py --model transport --quick 2>/dev/null | tail -1)
 python - "$out" <<'EOF'
 import json

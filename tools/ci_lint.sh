@@ -1,0 +1,27 @@
+#!/usr/bin/env bash
+# Static-analysis + native-sanitizer CI leg (total budget < 120 s):
+#   1. pslint  — repo-aware AST lint of ps_tpu/ (README "Static
+#      analysis": concurrency, wire protocol, resource safety, knob
+#      drift); exit nonzero on any unsuppressed finding.
+#   2. TSan    — the native van's full concurrent surface (heartbeat,
+#      TCP echo, tv_send_vec, shm-ring primitives, cross-thread sever)
+#      under ThreadSanitizer.
+#   3. ASan+UBSan — the same driver under AddressSanitizer (leak
+#      detection on) + UndefinedBehaviorSanitizer.
+#
+# Usage: tools/ci_lint.sh   (from the repo root; first leg of
+# tools/ci_bench_smoke.sh)
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+t0=$SECONDS
+echo "== pslint =="
+timeout -k 10 60 python tools/pslint.py ps_tpu/
+
+echo "== tsan van =="
+timeout -k 10 60 bash tools/tsan_van.sh
+
+echo "== asan+ubsan van =="
+timeout -k 10 60 bash tools/asan_van.sh
+
+echo "ci_lint: all legs clean in $((SECONDS - t0))s"

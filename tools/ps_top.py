@@ -32,8 +32,9 @@ from ps_tpu.backends.common import parse_replica_uri  # noqa: E402
 from ps_tpu.control import tensor_van as tv  # noqa: E402
 
 COLS = [
-    ("shard", 5), ("addr", 21), ("role", 8), ("epoch", 5), ("version", 9),
-    ("applies", 9), ("lag", 5), ("repl", 8), ("dedup", 6), ("stale", 6),
+    ("shard", 5), ("addr", 21), ("role", 8), ("promoted", 14),
+    ("epoch", 5), ("version", 9),
+    ("applies", 9), ("lag", 5), ("repl", 14), ("dedup", 6), ("stale", 6),
     ("gbps", 7), ("ack_p99_ms", 10), ("bkt_p99_ms", 10),
 ]
 
@@ -89,18 +90,30 @@ def render_row(st: dict) -> dict:
     """The table's view of one endpoint's STATS extra."""
     if "error" in st:
         return {"shard": st.get("shard"), "addr": st.get("addr"),
-                "role": "DOWN", "epoch": "-", "version": "-",
+                "role": "DOWN", "promoted": "-", "epoch": "-",
+                "version": "-",
                 "applies": "-", "lag": "-", "repl": st["error"][:24],
                 "dedup": "-", "stale": "-", "gbps": "-",
                 "ack_p99_ms": "-", "bkt_p99_ms": "-"}
     repl = st.get("repl") or {}
+    # a live session renders "<ack mode>@<acked seq>" so an operator sees
+    # the stream advancing between refreshes; degraded wins the cell
     repl_state = ("degraded" if repl.get("degraded")
-                  else repl.get("ack", "-") if repl else "-")
+                  else f"{repl.get('ack', '?')}@{repl.get('acked_seq', 0)}"
+                  if repl else "-")
+    # a promoted ex-backup names why (goodbye = planned handoff, timeout
+    # = death horizon) and how long the flip took
+    promoted = "-"
+    if st.get("promote_reason"):
+        ms = st.get("promotion_s")
+        promoted = st["promote_reason"] + (
+            f"/{ms * 1e3:.0f}ms" if isinstance(ms, (int, float)) else "")
     metrics = st.get("metrics") or {}
     return {
         "shard": st["shard"],
         "addr": st["addr"],
         "role": st.get("role", "?"),
+        "promoted": promoted,
         "epoch": st.get("epoch", 0),
         "version": _version_of(st),
         "applies": st.get("apply_log_total", "-"),
@@ -120,13 +133,22 @@ def _opt(v):
     return "-" if v is None else v
 
 
+def _cell(v, w: int) -> str:
+    """Over-wide cells keep their TAIL: the low-order digits of
+    `async@<acked_seq>` / the ms of `timeout/<ms>` are the part that
+    moves between refreshes — truncating the head keeps the table
+    showing advancement instead of a frozen prefix."""
+    s = str(v)
+    return s if len(s) <= w else "…" + s[-(w - 1):]
+
+
 def print_table(rows: list, stream=sys.stdout) -> None:
     hdr = "  ".join(f"{name:>{w}}" for name, w in COLS)
     print(hdr, file=stream)
     print("-" * len(hdr), file=stream)
     for st in rows:
         r = render_row(st)
-        print("  ".join(f"{str(r[name])[:w]:>{w}}" for name, w in COLS),
+        print("  ".join(f"{_cell(r[name], w):>{w}}" for name, w in COLS),
               file=stream)
 
 

@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""pslint — repo-aware static analysis for the ps-tpu data plane.
+
+Usage::
+
+    python tools/pslint.py ps_tpu/              # the CI/tier-1 gate
+    python tools/pslint.py ps_tpu/ --json       # machine-readable
+    python tools/pslint.py path/a.py path/b.py  # spot-check files
+    python tools/pslint.py --list-rules
+
+Exit status: 0 = clean (every finding fixed or suppressed-with-reason),
+1 = findings, 2 = usage error.
+
+By default, when the linted paths live inside this repository, the
+repo's ``README.md`` joins as the doc side of the knob-drift rules and
+``tools/*.py`` + ``bench.py`` join as *context* (consumers of STATS/
+trace header keys live there; context files are read for evidence but
+never reported on). ``--no-default-context`` disables that, ``--context``
+adds more roots, ``--readme`` points elsewhere.
+
+See ``ps_tpu/analysis/`` for the rule families and the README's
+"Static analysis" section for the suppression syntax and how to add a
+rule.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+if _REPO not in sys.path:
+    sys.path.insert(0, _REPO)
+
+from ps_tpu.analysis import all_rules, run_lint  # noqa: E402
+
+
+def _default_context(paths, repo):
+    """tools/ + bench.py as read-only evidence when linting repo code."""
+    out = []
+    tools = os.path.join(repo, "tools")
+    if os.path.isdir(tools):
+        out.append(tools)
+    bench = os.path.join(repo, "bench.py")
+    if os.path.isfile(bench):
+        out.append(bench)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="pslint", description=__doc__)
+    ap.add_argument("paths", nargs="*", help="files/dirs to lint")
+    ap.add_argument("--context", action="append", default=[],
+                    help="extra read-only evidence roots (repeatable)")
+    ap.add_argument("--readme", default=None,
+                    help="README path for the knob-drift rules "
+                         "(default: the repo's README.md)")
+    ap.add_argument("--no-default-context", action="store_true",
+                    help="do not auto-add tools/ + bench.py + README.md")
+    ap.add_argument("--rules", default=None,
+                    help="comma-separated rule-family prefixes "
+                         "(e.g. PSL1,PSL4); default: all")
+    ap.add_argument("--json", action="store_true", dest="as_json",
+                    help="emit findings as a JSON array")
+    ap.add_argument("--list-rules", action="store_true")
+    args = ap.parse_args(argv)
+
+    if args.list_rules:
+        for prefix, (doc, _fn) in sorted(all_rules().items()):
+            print(f"{prefix}xx  {doc}")
+        return 0
+    if not args.paths:
+        ap.error("no paths given (try: python tools/pslint.py ps_tpu/)")
+
+    context = list(args.context)
+    readme = args.readme
+    if not args.no_default_context:
+        context += _default_context(args.paths, _REPO)
+        if readme is None:
+            cand = os.path.join(_REPO, "README.md")
+            readme = cand if os.path.isfile(cand) else None
+    # never lint what is also context; never let pslint lint itself into
+    # its own evidence twice
+    rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
+             if args.rules else None)
+
+    try:
+        findings = run_lint(args.paths, context=context, readme=readme,
+                            rules=rules)
+    except ValueError as e:
+        ap.error(str(e))  # unknown --rules selection: exit 2, not 'clean'
+    if args.as_json:
+        print(json.dumps([vars(f) for f in findings], indent=2))
+    else:
+        for f in findings:
+            print(f.format())
+        sev = {}
+        for f in findings:
+            sev[f.severity] = sev.get(f.severity, 0) + 1
+        if findings:
+            counts = ", ".join(f"{k}: {v}" for k, v in sorted(sev.items()))
+            print(f"pslint: {len(findings)} finding(s) ({counts})",
+                  file=sys.stderr)
+        else:
+            print("pslint: clean", file=sys.stderr)
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

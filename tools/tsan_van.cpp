@@ -1,15 +1,22 @@
-// ThreadSanitizer driver for the native control-plane van (SURVEY.md §6:
+// ThreadSanitizer / ASan+UBSan driver for the native van (SURVEY.md §6:
 // "any C++ control-plane code gets TSAN/ASAN"). Exercises every public ABI
 // function from multiple threads concurrently — monitor rx thread, client tx
-// threads, host poll threads, goodbye-while-beating, start/stop churn — so
-// TSAN can observe any data race in van.cpp's threading model.
+// threads, host poll threads, goodbye-while-beating, start/stop churn, the
+// vectored tv_send_vec data path, the shm-ring primitives (tv_memcpy +
+// release/acquire cursors + tv_wait_u64) under a real two-thread SPSC ring
+// workload mirroring ps_tpu/control/shm_lane.py, and the cross-thread
+// tv_shutdown sever Channel.close() relies on — so the sanitizers can
+// observe any race/UB in van.cpp's threading model.
 //
-// Build + run: tools/tsan_van.sh (clean exit + no TSAN report = pass).
+// Build + run: tools/tsan_van.sh (TSan) / tools/asan_van.sh (ASan+UBSan);
+// clean exit + no sanitizer report = pass. Both run in tools/ci_lint.sh.
 
 #include <atomic>
 #include <chrono>
 #include <cstdint>
 #include <cstdio>
+#include <cstdlib>
+#include <cstring>
 #include <thread>
 #include <vector>
 
@@ -29,8 +36,17 @@ void* tv_accept(void* h, int timeout_ms);
 void tv_listener_close(void* h);
 void* tv_connect(const char* host, int port, int timeout_ms);
 int tv_send(void* h, const void* buf, uint64_t n);
+int tv_send_vec(void* h, const void** bufs, const uint64_t* lens, int n);
+int tv_poll_readable(void* h, int timeout_ms);
+void tv_memcpy(void* dst, const void* src, uint64_t n);
+void tv_prefault(void* addr, uint64_t n, int mode);
+uint64_t tv_load_u64(const void* addr);
+void tv_store_u64(void* addr, uint64_t v);
+int tv_wait_u64(const void* addr, uint64_t last, int timeout_us,
+                int skip_spin);
 int64_t tv_recv_size(void* h);
 int tv_recv_into(void* h, void* buf, uint64_t n);
+void tv_shutdown(void* h);
 void tv_close(void* h);
 }
 
@@ -71,12 +87,19 @@ int main() {
   hb_client_goodbye(clients[0]);
   hb_client_stop(clients[0]);
   hb_client_stop(clients[1]);  // silent death
-  sleep_ms(400);               // past the horizon: states move under pollers
-
+  // poll to a generous deadline instead of one fixed sleep past the
+  // horizon: sanitizer overhead + sandboxed kernels stretch the beat
+  // timeline, and a CI leg must not flake on scheduler jitter — the
+  // states still move UNDER the concurrent poller threads either way
   uint32_t buf[16];
-  int alive = hb_server_poll(srv, 0, buf, 16);
-  int dead = hb_server_poll(srv, 1, buf, 16);
-  int left = hb_server_poll(srv, 2, buf, 16);
+  int alive = 0, dead = 0, left = 0;
+  for (int tries = 0; tries < 100; ++tries) {
+    sleep_ms(50);
+    alive = hb_server_poll(srv, 0, buf, 16);
+    dead = hb_server_poll(srv, 1, buf, 16);
+    left = hb_server_poll(srv, 2, buf, 16);
+    if (alive == 2 && dead == 1 && left == 1) break;
+  }
   stop.store(true);
   for (auto& t : pollers) t.join();
   hb_client_stop(clients[2]);
@@ -137,6 +160,203 @@ int main() {
     std::fprintf(stderr, "tensor van frames lost/corrupted\n");
     return 1;
   }
+
+  // --- vectored sends: tv_send_vec from 3 client threads, each frame
+  // gathered from several live chunks (the zero-copy writev path), echoed
+  // back whole by the same recv_size/recv_into framing
+  void* vlst = tv_listen("127.0.0.1", 0, 8);
+  if (!vlst) { std::fprintf(stderr, "tv_listen (vec) failed\n"); return 1; }
+  int vport = tv_listener_port(vlst);
+  std::thread vserver([&] {
+    std::vector<std::thread> handlers;
+    for (int i = 0; i < 3; ++i) {
+      void* conn = tv_accept(vlst, 2000);
+      if (!conn) break;
+      handlers.emplace_back([conn] {
+        for (;;) {
+          int64_t n = tv_recv_size(conn);
+          if (n < 0) break;
+          std::vector<char> buf(n);
+          if (!tv_recv_into(conn, buf.data(), n)) break;
+          if (!tv_send(conn, buf.data(), n)) break;
+        }
+        tv_close(conn);
+      });
+    }
+    for (auto& h : handlers) h.join();
+  });
+  std::atomic<int> vec_ok{0};
+  std::vector<std::thread> vtx;
+  for (int t = 0; t < 3; ++t) {
+    vtx.emplace_back([&, t] {
+      void* c = tv_connect("127.0.0.1", vport, 2000);
+      if (!c) return;
+      // chunks of uneven sizes, including an empty one (iovec is skipped)
+      std::vector<char> a(7 + t, (char)('a' + t));
+      std::vector<char> b(1 << 14, (char)('A' + t));
+      std::vector<char> d(333, (char)t);
+      for (int i = 0; i < 12; ++i) {
+        const void* bufs[4] = {a.data(), b.data(), nullptr, d.data()};
+        uint64_t lens[4] = {a.size(), b.size(), 0, d.size()};
+        if (!tv_send_vec(c, bufs, lens, 4)) break;
+        uint64_t total = a.size() + b.size() + d.size();
+        int64_t n = tv_recv_size(c);
+        if (n != (int64_t)total) break;
+        std::vector<char> back(n);
+        if (!tv_recv_into(c, back.data(), n)) break;
+        bool match = std::memcmp(back.data(), a.data(), a.size()) == 0 &&
+                     std::memcmp(back.data() + a.size(), b.data(),
+                                 b.size()) == 0 &&
+                     std::memcmp(back.data() + a.size() + b.size(),
+                                 d.data(), d.size()) == 0;
+        vec_ok.fetch_add(match ? 1 : 0);
+      }
+      tv_close(c);
+    });
+  }
+  for (auto& t : vtx) t.join();
+  vserver.join();
+  tv_listener_close(vlst);
+  std::printf("tv_send_vec ok=%d\n", vec_ok.load());
+  if (vec_ok.load() != 36) {
+    std::fprintf(stderr, "vectored frames lost/corrupted\n");
+    return 1;
+  }
+
+  // --- shm-ring primitives: one SPSC byte ring (the shm_lane.py layout:
+  // [0:8) tail, [8:16) head, data after a 64-byte header; frames are
+  // [u64 len][bytes] and never wrap — a wrap sentinel restarts at 0),
+  // producer and consumer on separate threads moving bytes through
+  // tv_memcpy with cursors published/read through the release/acquire
+  // atomics and blocking through tv_wait_u64. TSAN validates that the
+  // cursor ordering contract alone makes the payload bytes safe.
+  {
+    constexpr uint64_t kCap = 1 << 16;
+    constexpr uint64_t kWrap = ~0ull;
+    constexpr int kFrames = 4000;
+    std::vector<unsigned char> seg(64 + kCap);
+    unsigned char* base = seg.data();
+    tv_prefault(base, seg.size(), 1);  // creator zero-fill
+    tv_prefault(base, seg.size(), 2);  // attacher rewrite
+    tv_prefault(base, seg.size(), 0);  // read-touch
+    unsigned char* data = base + 64;
+    void* tail_addr = base + 0;
+    void* head_addr = base + 8;
+    std::atomic<uint64_t> produced_sum{0};
+    std::thread producer([&] {
+      uint64_t tail = 0;
+      std::vector<unsigned char> payload(4096);
+      for (int i = 0; i < kFrames; ++i) {
+        uint64_t n = (uint64_t)((i % 37) * 73 + 9);
+        for (uint64_t j = 0; j < n; ++j)
+          payload[j] = (unsigned char)((i + j) & 0xff);
+        uint64_t need = 8 + n;
+        for (;;) {
+          uint64_t pos = tail % kCap;
+          uint64_t contig = kCap - pos;
+          uint64_t skip = contig < need ? contig : 0;
+          uint64_t head = tv_load_u64(head_addr);
+          if (kCap - (tail - head) >= skip + need) {
+            if (skip) {
+              if (contig >= 8) std::memcpy(data + pos, &kWrap, 8);
+              tail += skip;
+              pos = 0;
+            }
+            std::memcpy(data + pos, &n, 8);
+            tv_memcpy(data + pos + 8, payload.data(), n);
+            tail += need;
+            tv_store_u64(tail_addr, tail);
+            break;
+          }
+          tv_wait_u64(head_addr, head, 1000, i % 2);
+        }
+        uint64_t s = 0;
+        for (uint64_t j = 0; j < n; ++j) s += payload[j];
+        produced_sum.fetch_add(s);
+      }
+    });
+    uint64_t consumed_sum = 0;
+    int got = 0;
+    uint64_t head = 0;
+    std::vector<unsigned char> out(4096);
+    while (got < kFrames) {
+      uint64_t tail = tv_load_u64(tail_addr);
+      if (head == tail) {
+        tv_wait_u64(tail_addr, tail, 1000, got % 2);
+        continue;
+      }
+      uint64_t pos = head % kCap;
+      uint64_t contig = kCap - pos;
+      if (contig < 8) {
+        head += contig;
+        tv_store_u64(head_addr, head);
+        continue;
+      }
+      uint64_t n;
+      std::memcpy(&n, data + pos, 8);
+      if (n == kWrap) {
+        head += contig;
+        tv_store_u64(head_addr, head);
+        continue;
+      }
+      tv_memcpy(out.data(), data + pos + 8, n);
+      for (uint64_t j = 0; j < n; ++j) consumed_sum += out[j];
+      head += 8 + n;
+      tv_store_u64(head_addr, head);
+      ++got;
+    }
+    producer.join();
+    std::printf("ring frames=%d sum=%llu\n", got,
+                (unsigned long long)consumed_sum);
+    if (consumed_sum != produced_sum.load()) {
+      std::fprintf(stderr, "ring payload corrupted across threads\n");
+      return 1;
+    }
+  }
+
+  // --- cross-thread sever: a reader blocked in tv_recv_size is woken by
+  // tv_shutdown from another thread (Channel.close()'s contract), then
+  // the fd is freed by the reader's own tv_close; tv_poll_readable sees
+  // the EOF as "readable"
+  {
+    void* slst = tv_listen("127.0.0.1", 0, 4);
+    if (!slst) { std::fprintf(stderr, "tv_listen (sever) failed\n"); return 1; }
+    int sport = tv_listener_port(slst);
+    void* cli = tv_connect("127.0.0.1", sport, 2000);
+    void* srvconn = tv_accept(slst, 2000);
+    if (!cli || !srvconn) {
+      std::fprintf(stderr, "sever setup failed\n");
+      return 1;
+    }
+    if (tv_poll_readable(cli, 0) != 0) {
+      std::fprintf(stderr, "poll_readable: idle socket reported readable\n");
+      return 1;
+    }
+    std::atomic<int> woke{0};
+    std::thread reader([&] {
+      int64_t n = tv_recv_size(cli);  // blocks until the sever
+      woke.store(n < 0 ? 1 : 2);
+    });
+    sleep_ms(50);
+    tv_shutdown(cli);  // cross-thread, non-freeing: reader wakes with EOF
+    reader.join();
+    // the free happens only after every other user is provably out of
+    // the handle — the deferred-close contract Channel._hlock enforces
+    // in Python (shutdown may race reads; tv_close may not race anything)
+    tv_close(cli);
+    if (woke.load() != 1) {
+      std::fprintf(stderr, "severed reader did not wake with EOF\n");
+      return 1;
+    }
+    if (tv_poll_readable(srvconn, 100) != 1) {
+      std::fprintf(stderr, "peer death not visible as readable/EOF\n");
+      return 1;
+    }
+    tv_close(srvconn);
+    tv_listener_close(slst);
+    std::printf("cross-thread sever: OK\n");
+  }
+
   std::printf("tsan van driver: OK\n");
   return 0;
 }
