@@ -33,6 +33,27 @@ class ServerFailureError(RuntimeError):
         self.server = server
 
 
+class TableMovedError(RuntimeError):
+    """The shard TABLE moved under this worker (a live rebalance migrated
+    keys between shards — ps_tpu/elastic). Typed apart from
+    :class:`ServerFailureError` because the remedy differs: the server is
+    alive and healthy, only the key→shard assignment changed, so the
+    worker must re-fetch the table from its coordinator and re-route —
+    cycling the shard's replica set (the primary-died remedy) would just
+    find the same refusal at every member.
+
+    ``table_epoch`` is the refusing server's table epoch: the worker
+    waits for a FETCHED table past its own before retrying, so a refusal
+    raced against the coordinator's publish converges instead of
+    spinning."""
+
+    def __init__(self, message: str, server: Optional[int] = None,
+                 table_epoch: int = 0):
+        super().__init__(message)
+        self.server = server
+        self.table_epoch = int(table_epoch)
+
+
 class BackupNotServing(Exception):
     """A replica answered HELLO but is an unpromoted backup — retryable
     (the failover loop waits out the promotion)."""
@@ -624,10 +645,16 @@ class BucketedTransportMixin:
         """The typed error for an ERR reply mid-stream: a 'not serving'
         refusal (an unpromoted backup, a zombie fenced mid-commit) maps to
         the same retryable failure a dead connection raises — the failover
-        loop re-routes and replays; anything else is a real application
-        error and surfaces as-is."""
+        loop re-routes and replays; a 'moved' refusal (the shard table
+        changed under a live rebalance) maps to the table-refresh path;
+        anything else is a real application error and surfaces as-is."""
+        host, port = self._addrs[i]
+        if extra.get("moved"):
+            return TableMovedError(
+                f"{self._failure_noun} {i} ({host}:{port}) refused: "
+                f"{extra.get('error')}", server=i,
+                table_epoch=int(extra.get("table_epoch") or 0))
         if extra.get("backup"):
-            host, port = self._addrs[i]
             return ServerFailureError(
                 f"{self._failure_noun} {i} ({host}:{port}) is not "
                 f"serving: {extra.get('error')}", server=i)
@@ -784,38 +811,74 @@ class BucketedTransportMixin:
             self._failure_noun, i, *addr, self._epochs[i], dt,
         )
 
+    def _on_table_moved(self, err: TableMovedError,
+                        deadline: float) -> None:
+        """Hook: refresh the shard table and re-route (elastic workers
+        override). The default — a worker with no coordinator — cannot
+        recover: the topology it was launched with is simply wrong now."""
+        raise TableMovedError(
+            f"{err} — this worker has no coordinator configured "
+            f"(connect with coordinator=... / PS_COORD_URI for elastic "
+            f"membership), so it cannot re-fetch the shard table",
+            server=err.server, table_epoch=err.table_epoch) from err
+
+    def _on_server_lost(self, err: ServerFailureError,
+                        deadline: float) -> None:
+        """Hook: a shard failed with NO replica left to cycle to — the
+        last chance before the op surfaces the failure. Elastic workers
+        override it to re-discover the fleet from their coordinator (a
+        replacement member may have taken the dead shard's slot over);
+        the default surfaces the failure unchanged."""
+        raise err
+
     def _with_failover(self, fn):
         """Run one transport operation; on a typed server failure, fail
-        the shard over to a replica and retry the WHOLE operation. Safe
-        because operations are idempotent: pulls are reads, and every push
-        carries its (nonce, seq) dedup token — shards that already applied
-        it ack without re-applying, so the retry is exactly-once
-        everywhere. The total window (re-routes included, across every
-        shard the retry trips over) is bounded by ``failover_timeout``."""
+        the shard over to a replica — or, on a stale-table refusal,
+        re-fetch the shard table from the coordinator and re-route — and
+        retry the WHOLE operation. Safe because operations are
+        idempotent: pulls are reads, and every push carries its (nonce,
+        seq) dedup token — shards that already applied it (directly, via
+        a dead primary's replication stream, or via a migrated key
+        range's transferred tokens) ack without re-applying, so the retry
+        is exactly-once everywhere. The total window (re-routes included,
+        across every shard the retry trips over) is bounded by
+        ``failover_timeout``."""
         import time
 
         try:
             return fn()
-        except ServerFailureError as e:
+        except (ServerFailureError, TableMovedError) as e:
             err = e
         deadline = time.monotonic() + self.failover_timeout
         while True:
-            i = getattr(err, "server", None)
-            if i is None or len(self._replica_sets[i]) <= 1:
-                raise err
-            try:
-                self._failover(i, err, deadline)
-            except ServerFailureError as e:
-                # a candidate died mid-adoption (e.g. during lane
-                # negotiation): keep cycling within the SAME deadline; a
-                # deadline-expired failure propagates
-                if time.monotonic() >= deadline:
-                    raise
-                err = e
-                continue
+            if isinstance(err, TableMovedError):
+                # "table moved" ≠ "primary died": the shard is healthy,
+                # the ASSIGNMENT changed — re-fetch and re-split instead
+                # of cycling its replica set
+                self._on_table_moved(err, deadline)
+            else:
+                i = getattr(err, "server", None)
+                if i is None or len(self._replica_sets[i]) <= 1:
+                    # no replica to cycle to: the hook's last chance
+                    # (elastic workers re-discover the fleet; the
+                    # default raises err)
+                    self._on_server_lost(err, deadline)
+                else:
+                    try:
+                        self._failover(i, err, deadline)
+                    except ServerFailureError as e:
+                        # a candidate died mid-adoption (e.g. during lane
+                        # negotiation): keep cycling within the SAME
+                        # deadline; a deadline-expired failure propagates
+                        if time.monotonic() >= deadline:
+                            raise
+                        err = e
+                        continue
             try:
                 return fn()
-            except ServerFailureError as e:
+            except (ServerFailureError, TableMovedError) as e:
+                if time.monotonic() >= deadline:
+                    raise
                 err = e
 
     def _track_pending(self, pending) -> None:

@@ -30,6 +30,7 @@ about the math.
 
 from __future__ import annotations
 
+import logging
 import threading
 import time
 from typing import Any, Dict, List, Optional, Sequence, Tuple
@@ -44,11 +45,13 @@ from ps_tpu.backends.common import (
     BucketedTransportMixin,
     BucketPlan,
     ServerFailureError,
+    TableMovedError,
     parse_replica_uri,
     payload_nbytes,
     request_payload,
 )
 from ps_tpu.backends.van_service import (
+    StaleTableError,
     VanService,
     log_tail,
     make_history_log,
@@ -111,12 +114,20 @@ class AsyncPSService(VanService):
                  shm: Optional[bool] = None,
                  backup: bool = False,
                  record_full_history: bool = False,
-                 history: int = 4096):
+                 history: int = 4096,
+                 coordinator=None,
+                 advertise_host: str = "127.0.0.1"):
         engine = store._engine
         if getattr(engine, "mode", "sync") != "async":
             raise ValueError("AsyncPSService requires an async-mode KVStore")
         if (shard is None) != (num_shards is None):
             raise ValueError("pass shard and num_shards together")
+        if coordinator is not None and num_shards is not None:
+            raise ValueError(
+                "pass either shard/num_shards (static hash topology) or "
+                "coordinator (elastic membership), not both — under a "
+                "coordinator the shard table owns the assignment"
+            )
         self.shard, self.num_shards = shard, num_shards
         self._store = store
         self._engine = engine
@@ -152,12 +163,19 @@ class AsyncPSService(VanService):
         self._pull_cache: Dict[int, dict] = {}
         self._applied: Dict[int, int] = {}   # per-worker applied pushes
         self._drain_targets: Dict[int, int] = {}
-        # exactly-once under failover replay: worker -> (nonce, seq) of the
-        # last applied dedup-tagged push; a replayed (nonce, seq <= last)
-        # push is acked without applying. Replicated with each push entry,
-        # so a promoted backup suppresses the same replays its primary
-        # would have.
-        self._applied_pseq: Dict[int, tuple] = {}
+        # exactly-once under failover replay AND across rebalance
+        # handoffs: worker -> {key: (nonce, seq)} of the last applied
+        # dedup-tagged push PER KEY. Per key, not per worker, because one
+        # logical push fans out sub-pushes carrying the SAME seq to many
+        # shards and a live rebalance can merge ranges: after a move, one
+        # replayed (nonce, seq) can be already-applied for this shard's
+        # own keys yet never-applied for the adopted ones — a scalar
+        # token would either lose the adopted keys' gradient (false
+        # dedup) or double-apply the others. Tokens MIGRATE with their
+        # keys (MIGRATE_COMMIT) and replicate with each push entry, so
+        # promoted backups and move recipients suppress exactly the
+        # replays their donors would have.
+        self._applied_pseq: Dict[int, Dict[str, tuple]] = {}
         self._log_lock = threading.Lock()
         # worker id per committed tree, in order — a bounded ring by
         # default (a long-lived server must not hold O(applies) memory);
@@ -168,9 +186,72 @@ class AsyncPSService(VanService):
         # the DC apply depends on WHAT each worker last pulled; replaying
         # this log through a threaded engine reproduces params bit-for-bit
         self.event_log = make_history_log(record_full_history, history)
+        # elastic membership (ps_tpu/elastic): _elastic flips the key-set
+        # mismatch refusal from a hard KeyError to the typed, retry-able
+        # StaleTableError (workers re-fetch the table and re-route).
+        # _migrating is the double-write set of an in-flight outbound
+        # move; _moved_keys remembers what migrated away (and at which
+        # table epoch); _migrate_in stages an inbound move's rows until
+        # its MIGRATE_COMMIT installs them atomically.
+        self._elastic = coordinator is not None
+        self._coordinator = coordinator
+        self._coord_member = None
+        self._migrating: frozenset = frozenset()
+        self._migrate_session = None
+        self._moved_keys: Dict[str, int] = {}
+        self._migrate_in: Optional[dict] = None
+        self._migrate_committed: Optional[dict] = None  # last cutover,
+        # for idempotent re-asked MIGRATE_COMMITs (lost-reply ambiguity)
+        self._migrate_out_done: Optional[dict] = None  # last committed
+        # outbound move — same ambiguity, coordinator->donor hop
         # starts accepting: state ready
         super().__init__(port=port, bind=bind, writev=writev, shm=shm,
                          backup=backup)
+        if coordinator is not None and not backup:
+            # register AFTER the listener is up (the advertised URI needs
+            # the bound port); backups join the table only when promoted
+            # into service — their replica set is already in the URI
+            self._join_coordinator(advertise_host)
+
+    def _join_coordinator(self, advertise_host: str) -> None:
+        from ps_tpu.elastic.member import CoordinatorMember
+
+        key_bytes = {k: int(self._engine._params[k].nbytes)
+                     for k in self._key_order}
+
+        last = {"t": time.monotonic(), "req": self._req_counter.value,
+                "applies": self.apply_log.total}
+
+        def report_extra() -> dict:
+            # windowed rates from the counters the service already keeps:
+            # applies/s is the push rate, (requests - applies)/s is a fair
+            # stand-in for the read rate — no new bookkeeping on the hot
+            # path just to feed the coordinator
+            now = time.monotonic()
+            req, applies = self._req_counter.value, self.apply_log.total
+            dt = max(now - last["t"], 1e-6)
+            push_qps = (applies - last["applies"]) / dt
+            pull_qps = max(req - last["req"] - (applies - last["applies"]),
+                           0) / dt
+            last.update(t=now, req=req, applies=applies)
+            # under the engine lock: a migration cutover mutates the
+            # params dict mid-iteration otherwise (the reporter thread
+            # racing adopt/evict would silently drop this report)
+            with self._engine._lock:
+                nkeys = len(self._key_order)
+                nbytes = sum(int(v.nbytes)
+                             for v in self._engine._params.values())
+            return {
+                "keys": nkeys,
+                "nbytes": nbytes,
+                "push_qps": round(push_qps, 2),
+                "pull_qps": round(pull_qps, 2),
+            }
+
+        self._coord_member = CoordinatorMember(
+            self._coordinator, f"{advertise_host}:{self.port}",
+            key_bytes, kind="dense", report=report_extra)
+        self.table_epoch = self._coord_member.table.epoch
 
     # -- server internals -----------------------------------------------------
 
@@ -208,9 +289,10 @@ class AsyncPSService(VanService):
         token: a (nonce, seq) at or below the last applied one is a replay
         — an in-flight push whose reply died with the old primary, resent
         at this (possibly promoted) server — and is acked WITHOUT applying,
-        so failover retries are exactly-once."""
-        if sorted(grads) != sorted(self._key_order):
-            raise KeyError("push keys do not match the registered tree")
+        so failover retries are exactly-once. The dedup check runs BEFORE
+        the key-range check: a replay of a push this shard applied before
+        a rebalance moved some of its keys away must be acked (the moved
+        state already carries it), not refused."""
         extra = extra or {}
         pseq = extra.get("pseq")
         pnonce = extra.get("pnonce")
@@ -220,12 +302,18 @@ class AsyncPSService(VanService):
             # own their buffers and skip this)
             grads = {k: np.array(v) for k, v in grads.items()}
         with self._engine._lock:
+            fresh = grads
             if pseq is not None:
-                last = self._applied_pseq.get(worker)
-                if (last is not None and last[0] == pnonce
-                        and int(pseq) <= last[1]):
+                fresh = self._dedup_fresh(worker, pnonce, int(pseq), grads)
+                if not fresh:
+                    # every key already carries this (nonce, seq): the
+                    # replay of a fully-applied push — ack, never touch
+                    # the engine
                     self.transport.record_dedup_hit()
                     return None, True
+            # under the lock: a migration cutover flips _key_order under
+            # this same lock, so the check and the apply see ONE table
+            self._check_push_keys(grads)
             while (self._paused and not self._draining
                    and not self._admit_while_paused(worker)):
                 self._pause_wait_begin()
@@ -235,20 +323,92 @@ class AsyncPSService(VanService):
                     self._pause_wait_end()
             if self._draining:
                 raise RuntimeError("server is draining; push refused")
-            self._engine.push_tree(grads, worker=worker)
+            if len(fresh) == len(grads):
+                self._engine.push_tree(fresh, worker=worker)
+            else:
+                # a replay straddling a range move: this shard's own keys
+                # already applied this (nonce, seq) — only the adopted
+                # keys' sub-update is still owed. Apply exactly those.
+                self.transport.record_dedup_hit()
+                self._engine.push_subtree(fresh, worker=worker)
             self._applied[worker] = self._applied.get(worker, 0) + 1
             if pseq is not None:
-                self._applied_pseq[worker] = (pnonce, int(pseq))
+                toks = self._applied_pseq.setdefault(worker, {})
+                for k in fresh:
+                    toks[k] = (pnonce, int(pseq))
             self._pause_cond.notify_all()  # a drain_to waiter may be watching
             with self._log_lock:
                 self.apply_log.append(worker)
                 self.event_log.append(["push", worker])
+            # double-write: a commit touching keys mid-migration re-streams
+            # their post-apply rows, so the recipient converges on the live
+            # state (later rows supersede earlier ones)
+            if self._migrating:
+                self._publish_migrating(self._migrating.intersection(fresh))
             # replicate the post-decode host tree (it owns its buffers by
-            # now), carrying the dedup token so a promoted backup
-            # suppresses the same replays its primary would have
-            rseq = self._replicate("push", worker, grads,  # pslint: disable=PSL101 -- deliberate backpressure: a full ack window MUST stall commits under the apply lock (that IS the bounded-lag contract), and stall_timeout degrades a corpse instead of wedging
-                                   {"pseq": pseq, "pnonce": pnonce})
+            # now) — exactly the applied subset, carrying the dedup token,
+            # so a promoted backup suppresses the same replays its primary
+            # would have. A straddling replay's PARTIAL apply ships as the
+            # distinct "push_sub" op: the backup must mirror the subset
+            # apply, not refuse it as a torn whole-tree push.
+            rseq = self._replicate(  # pslint: disable=PSL101 -- deliberate backpressure: a full ack window MUST stall commits under the apply lock (that IS the bounded-lag contract), and stall_timeout degrades a corpse instead of wedging
+                "push" if len(fresh) == len(self._key_order)
+                else "push_sub",
+                worker, fresh, {"pseq": pseq, "pnonce": pnonce})
         return rseq, False
+
+    def _dedup_fresh(self, worker: int, pnonce, pseq: int,
+                     grads: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+        """Split a dedup-tagged push into the keys still OWED an apply
+        (engine lock held): a key whose last applied token is at or past
+        (pnonce, pseq) already carries this push — applied here directly,
+        via a dead primary's replication stream, or via a migrated row's
+        transferred token. Same-nonce comparison only: a new nonce is a
+        new worker incarnation whose seqs restart."""
+        toks = self._applied_pseq.get(worker)
+        if not toks:
+            return grads
+        fresh = {}
+        for k, v in grads.items():
+            t = toks.get(k)
+            if t is not None and t[0] == pnonce and pseq <= t[1]:
+                continue
+            fresh[k] = v
+        return fresh
+
+    def _check_push_keys(self, grads) -> None:
+        """Key-range validation (engine lock held). On an elastic service
+        a mismatch means the WORKER's table is stale — keys moved shards
+        under it — so the refusal is the typed, retry-able
+        :class:`~ps_tpu.backends.van_service.StaleTableError` (re-fetch
+        and re-route), never a job-killing KeyError."""
+        if sorted(grads) == sorted(self._key_order):
+            return
+        if self._elastic:
+            wrong = sorted(set(grads) ^ set(self._key_order))
+            moved = [k for k in wrong if k in self._moved_keys]
+            raise StaleTableError(
+                f"push keys do not match this shard's key range (table "
+                f"epoch {self.table_epoch}): "
+                + (f"{moved[:3]} moved to another shard"
+                   if moved else f"{wrong[:3]} differ")
+            )
+        raise KeyError("push keys do not match the registered tree")
+
+    def _publish_migrating(self, touched) -> None:
+        """Stream the just-committed state of still-migrating keys to the
+        recipient (engine lock held — row order IS engine order)."""
+        from ps_tpu.elastic.migrate import encode_row
+
+        s = self._migrate_session
+        if not touched or s is None or s.degraded:
+            return  # a degraded stream aborts the move; nothing to feed
+        rows = self._engine.export_keys(touched)
+        for k in sorted(rows):
+            r = rows[k]
+            tensors, meta = encode_row(k, r["param"], r["state"],
+                                       r["stale"], r["apply_count"])
+            s.publish_row(k, tensors, meta)  # pslint: disable=PSL101 -- deliberate backpressure, same contract as replication: a full migration window MUST stall commits of moving keys (bounded-lag catch-up), and stall_timeout degrades-then-aborts a stalled recipient instead of wedging the shard
 
     def _admit_while_paused(self, worker: int) -> bool:
         """Under pause, admit exactly the pushes a drain_to round asked
@@ -302,6 +462,9 @@ class AsyncPSService(VanService):
             with self._engine._lock:
                 kv = self._engine.pull_tree(worker=worker)
                 version = self._engine.version
+                # a migration cutover replaces _key_order under this lock:
+                # snapshot the transport order WITH the tree it describes
+                key_order = list(self._key_order)
                 with self._log_lock:
                     self.event_log.append(["pull", worker])
                 rseq = self._replicate("pull", worker)  # pslint: disable=PSL101 -- deliberate backpressure: a full ack window MUST stall commits under the apply lock (that IS the bounded-lag contract), and stall_timeout degrades a corpse instead of wedging
@@ -330,7 +493,7 @@ class AsyncPSService(VanService):
                 host, enc = comp.encode_tree(host)
                 host = {k: np.ascontiguousarray(v)
                         for k, v in host.items()}
-            plan = BucketPlan.from_arrays(host, bb, order=self._key_order)
+            plan = BucketPlan.from_arrays(host, bb, order=key_order)
             with self._stage_lock:
                 if plan.nbuckets > 1:
                     self._pull_cache[worker] = {
@@ -377,6 +540,7 @@ class AsyncPSService(VanService):
                 "num_shards": self.num_shards,
                 "epoch": self.epoch,
                 "role": self.role,
+                "table_epoch": self.table_epoch,
             })
         elif kind == tv.PULL:
             return self._params_payload(worker)
@@ -426,9 +590,22 @@ class AsyncPSService(VanService):
                 "metrics": self.transport.metrics_snapshot(),
             }
             out.update(self.replica_state())
+            if self._elastic:
+                out["table_epoch"] = self.table_epoch
+                out["keys_moved"] = len(self._moved_keys)
             return tv.encode(tv.OK, worker, None, extra=out)
         elif kind == tv.CHECKPOINT:
             return self._checkpoint(worker, extra)
+        elif kind == tv.MIGRATE_OUT:
+            return self._migrate_out(worker, extra)
+        elif kind == tv.MIGRATE_BEGIN:
+            return self._migrate_begin(worker, extra)
+        elif kind == tv.MIGRATE_ROW:
+            return self._migrate_row(worker, tensors, extra)
+        elif kind == tv.MIGRATE_COMMIT:
+            return self._migrate_commit(worker, extra)
+        elif kind == tv.MIGRATE_ABORT:
+            return self._migrate_abort(worker)
         return tv.encode(tv.ERR, worker, None,
                          extra={"error": f"bad kind {kind}"})
 
@@ -536,10 +713,315 @@ class AsyncPSService(VanService):
         return tv.encode(tv.OK, worker, None,
                          extra={"version": version, "path": path})  # pslint: disable=PSL203 -- save receipt: echoes the resolved server-side path (ckpt_root may have rewritten it) for operators reading the reply in drills/logs
 
+    # -- live key-range migration (ps_tpu/elastic) ----------------------------
+
+    def _migrate_out(self, worker: int, extra: dict) -> bytes:
+        """DONOR: stream ``extra["keys"]`` to the target shard and cut
+        over (the coordinator's MIGRATE_OUT command; this serve thread —
+        the coordinator's connection — drives the whole move while the
+        other serve threads keep taking worker traffic).
+
+        Three phases: (1) snapshot rows are published UNDER the apply
+        lock, atomically with arming the double-write set, so row order
+        is engine order from the first row; (2) live catch-up outside the
+        lock — traffic flows, commits touching moving keys re-publish
+        them; (3) a bounded stop-and-copy cutover: freeze applies, drain
+        the residual window, MIGRATE_COMMIT (the recipient starts
+        serving), evict, release. Failure before the commit aborts with
+        the donor intact."""
+        from ps_tpu.backends.common import parse_replica_uri
+        from ps_tpu.elastic.migrate import (
+            MigrationError,
+            MigrationSession,
+            encode_row,
+        )
+
+        keys = sorted(str(k) for k in extra["keys"])
+        target = str(extra["target"])
+        new_epoch = int(extra["table_epoch"])
+        # idempotent re-ask: the coordinator repeats MIGRATE_OUT when the
+        # reply died on the wire — if this exact move already committed
+        # here, ack with the recorded receipt instead of re-running (the
+        # keys are gone; a re-run would only confuse the recipient). The
+        # receipt is valid ONLY while the keys are still gone: once a
+        # later rebalance moves them back, an identical move request is
+        # a genuinely new move, not a replay.
+        done = self._migrate_out_done
+        if (done is not None and done["keys"] == keys
+                and done["target"] == target
+                and not any(k in self._key_order for k in keys)):
+            return tv.encode(tv.OK, worker, None, extra=done["reply"])
+        engine = self._engine
+        if not hasattr(engine, "export_keys"):
+            raise RuntimeError(
+                "this service's engine does not support live key "
+                "migration (needs export_keys/adopt_key/evict_keys)"
+            )
+        if not keys:
+            raise ValueError("MIGRATE_OUT with no keys")
+        repl = self._backup_session
+        if repl is not None and not repl.degraded:
+            raise RuntimeError(
+                "this shard is replicating to a backup — a live key "
+                "migration would drift the replica stream's key range; "
+                "detach the backup, move, then re-seed and re-attach it"
+            )
+        host, port = parse_replica_uri(target)[0][0]
+        t0 = time.monotonic()
+        begin = {"kind": "dense", "keys": keys,
+                 "num_workers": engine.num_workers,
+                 "table_epoch": new_epoch}
+        # window sized so the full snapshot enqueues without blocking the
+        # apply lock — backpressure is for the DOUBLE-WRITE phase
+        session = MigrationSession(host, port, begin, stats=self.transport,
+                                   window=max(64, 2 * len(keys)))
+        committed = False
+        try:
+            with engine._lock:
+                if self._migrating:
+                    raise RuntimeError(
+                        "a migration is already in flight at this shard")
+                missing = [k for k in keys if k not in self._key_order]
+                if missing:
+                    raise KeyError(
+                        f"donor does not own {missing[:3]} — the "
+                        f"coordinator's table is ahead of this shard")
+                rows = engine.export_keys(keys)
+                for k in keys:
+                    r = rows[k]
+                    tensors, meta = encode_row(k, r["param"], r["state"],
+                                               r["stale"],
+                                               r["apply_count"])
+                    session.publish_row(k, tensors, meta)  # pslint: disable=PSL101 -- the snapshot MUST enqueue under the apply lock (atomic with arming the double-write set, so row order is engine order); the window is sized to the snapshot so this never blocks
+                self._migrating = frozenset(keys)
+                self._migrate_session = session
+            # phase 2: live catch-up — the lock is free, traffic flows
+            if not session.wait_drained():
+                raise MigrationError(
+                    f"recipient never caught up: {session.log.death_reason}")
+            # phase 3: bounded stop-and-copy. Holding the apply lock
+            # across the residual drain + one commit round trip IS the
+            # design: it is the worker-visible p99 disturbance the
+            # rebalance bench bounds, and it is what makes the cutover
+            # atomic (no push can land between the last row and the
+            # ownership flip).
+            with engine._lock:
+                if not session.wait_drained():  # pslint: disable=PSL101 -- the cutover freeze: residual-window drain under the apply lock is the bounded stop-and-copy (stall_timeout aborts a stalled recipient instead of wedging the shard)
+                    raise MigrationError(
+                        "recipient stalled during the cutover freeze")
+                session.quiesce()
+                gone = set(keys)
+                # per-KEY dedup tokens travel with their keys: the moved
+                # rows' apply history is what the recipient needs to ack
+                # a replayed pre-move push without re-applying — and
+                # nothing else (this shard's remaining keys keep their
+                # tokens here)
+                tokens = {}
+                for w, toks in self._applied_pseq.items():
+                    moved = {k: [t[0], t[1]] for k, t in toks.items()
+                             if k in gone}
+                    if moved:
+                        tokens[str(w)] = moved
+                applied = {str(w): n for w, n in self._applied.items()}
+                session.commit({  # pslint: disable=PSL101 -- the cutover commit round trip must be atomic with the ownership flip the lock protects (connect/stall timeouts bound it); releasing the lock first would let a push land at the donor AFTER the recipient started serving
+                    "table_epoch": new_epoch, "tokens": tokens,
+                    "applied": applied, "keys": keys,
+                })
+                engine.evict_keys(keys)
+                # only NOW does this shard refuse the moved range
+                # retryably: an aborted move must leave a static
+                # deployment's hard key-mismatch diagnosis untouched
+                self._elastic = True
+                # the moved keys' authoritative tokens now live at the
+                # recipient; a leftover here would go stale and merge
+                # wrongly if the keys ever move back
+                for toks in self._applied_pseq.values():
+                    for k in gone.intersection(toks):
+                        del toks[k]
+                self._key_order = [k for k in self._key_order
+                                   if k not in gone]
+                now_moved = dict(self._moved_keys)
+                now_moved.update({k: new_epoch for k in keys})
+                self._moved_keys = now_moved
+                self.table_epoch = max(self.table_epoch, new_epoch)
+                committed = True
+        finally:
+            with engine._lock:
+                self._migrating = frozenset()
+                self._migrate_session = None
+            if committed:
+                session.close()
+            else:
+                session.abort()
+        dt = time.monotonic() - t0
+        logging.getLogger(__name__).info(
+            "migrated %d key(s) to %s in %.2fs (%d row(s), %.1f MB, "
+            "table epoch %d)", len(keys), target, dt, session.rows_sent,
+            session.bytes_sent / 1e6, new_epoch,
+        )
+        extra = {
+            "keys": keys, "rows": session.rows_sent,
+            "bytes": session.bytes_sent, "seconds": round(dt, 4),
+            "table_epoch": new_epoch,
+        }
+        self._migrate_out_done = {"keys": keys, "target": target,
+                                  "reply": extra}
+        return tv.encode(tv.OK, worker, None, extra=extra)
+
+    def _migrate_begin(self, worker: int, extra: dict) -> bytes:
+        """RECIPIENT: open the intake — validate the declared range and
+        stage it; rows only touch the engine at MIGRATE_COMMIT."""
+        if not hasattr(self._engine, "adopt_key"):
+            return tv.encode(tv.ERR, worker, None, extra={
+                "error": "this service's engine cannot adopt migrated "
+                         "keys"})
+        if extra.get("kind") != "dense":
+            return tv.encode(tv.ERR, worker, None, extra={
+                "error": f"migration stream kind {extra.get('kind')!r} "
+                         f"does not match this dense service"})
+        repl = self._backup_session
+        if repl is not None and not repl.degraded:
+            return tv.encode(tv.ERR, worker, None, extra={
+                "error": "this shard is replicating to a backup — "
+                         "adopting keys would drift the replica "
+                         "stream's key range"})
+        keys = set(str(k) for k in extra.get("keys") or [])
+        if not keys:
+            return tv.encode(tv.ERR, worker, None, extra={
+                "error": "MIGRATE_BEGIN with no keys"})
+        nw = extra.get("num_workers")
+        if nw is not None and int(nw) != self._engine.num_workers:
+            return tv.encode(tv.ERR, worker, None, extra={
+                "error": f"donor says num_workers={nw}, this service "
+                         f"runs {self._engine.num_workers}"})
+        overlap = keys & set(self._key_order)
+        if overlap:
+            return tv.encode(tv.ERR, worker, None, extra={
+                "error": f"this shard already owns {sorted(overlap)[:3]}"})
+        with self._stage_lock:
+            if self._migrate_in is not None:
+                return tv.encode(tv.ERR, worker, None, extra={
+                    "error": "a migration intake is already staged here"})
+            self._migrate_in = {"keys": keys, "rows": {}, "seq": 0}
+        return tv.encode(tv.OK, worker, None, extra={"applied_seq": 0})
+
+    def _migrate_row(self, worker: int, tensors, extra) -> bytes:
+        """RECIPIENT: stage one sequenced row (later rows for a key
+        supersede earlier — the donor's double-write catch-up)."""
+        from ps_tpu.elastic.migrate import decode_row
+
+        seq = int(extra["seq"])
+        # decode (multi-MB array copies) OUTSIDE _stage_lock: the
+        # recipient is a LIVE serving shard and every worker's bucket
+        # staging serializes on that lock — only the seq check and the
+        # dict store need it (rows arrive from one sender thread anyway)
+        row = decode_row(tensors, extra)
+        with self._stage_lock:
+            stage = self._migrate_in
+            if stage is None:
+                return tv.encode(tv.ERR, worker, None, extra={
+                    "error": "MIGRATE_ROW before MIGRATE_BEGIN"})
+            if seq != stage["seq"] + 1:
+                return tv.encode(tv.ERR, worker, None, extra={
+                    "error": f"migration gap: expected seq "
+                             f"{stage['seq'] + 1}, got {seq}"})
+            if row["key"] not in stage["keys"]:
+                return tv.encode(tv.ERR, worker, None, extra={
+                    "error": f"row for {row['key']!r} outside the "
+                             f"declared range"})
+            stage["rows"][row["key"]] = row
+            stage["seq"] = seq
+        return tv.encode(tv.OK, worker, None, extra={"applied_seq": seq})
+
+    def _migrate_commit(self, worker: int, extra: dict) -> bytes:
+        """RECIPIENT: the cutover — install every staged row into the
+        engine, extend the served key range, and merge the donor's dedup
+        tokens (exactly-once across the handoff: a push the donor applied
+        and the worker replays here is acked without re-applying), all
+        under ONE apply-lock hold."""
+        with self._stage_lock:
+            stage = self._migrate_in
+        if stage is None:
+            # idempotent replay: the donor re-asks when the commit REPLY
+            # died on the wire — if this exact range already committed
+            # here, ack again instead of letting the donor "abort" a
+            # move the recipient is already serving (dual ownership)
+            asked = sorted(str(k) for k in (extra.get("keys") or []))
+            done = self._migrate_committed
+            if asked and done is not None and asked == done["keys"]:
+                return tv.encode(tv.OK, worker, None, extra={
+                    "keys": done["keys"],
+                    "table_epoch": done["table_epoch"],
+                })
+            return tv.encode(tv.ERR, worker, None, extra={
+                "error": "MIGRATE_COMMIT without a staged intake"})
+        missing = sorted(stage["keys"] - set(stage["rows"]))
+        if missing:
+            return tv.encode(tv.ERR, worker, None, extra={
+                "error": f"commit refused: keys never streamed "
+                         f"{missing[:3]}"})
+        new_epoch = int(extra.get("table_epoch", 0))
+        with self._engine._lock:
+            for k in sorted(stage["rows"]):
+                r = stage["rows"][k]
+                self._engine.adopt_key(k, r["param"], r["state"],
+                                       r["stale"], r["apply_count"])
+            self._key_order = sorted(self._key_order
+                                     + sorted(stage["rows"]))
+            for w_str, toks in (extra.get("tokens") or {}).items():
+                w = int(w_str)
+                mine = self._applied_pseq.setdefault(w, {})
+                for k, t in toks.items():
+                    # unconditional per-key replace: the donor owned the
+                    # key, so its token IS the key's whole apply history
+                    mine[k] = (t[0], int(t[1]))
+            for w_str, n in (extra.get("applied") or {}).items():
+                w = int(w_str)
+                self._applied[w] = max(self._applied.get(w, 0), int(n))
+            self.table_epoch = max(self.table_epoch, new_epoch)
+            # serving adopted keys means refusing their OLD routing
+            # retryably from now on (and remembering the commit so a
+            # re-asked MIGRATE_COMMIT acks instead of "aborting" it)
+            self._elastic = True
+        with self._stage_lock:
+            self._migrate_in = None
+            self._migrate_committed = {
+                "keys": sorted(stage["rows"]),
+                "table_epoch": self.table_epoch,
+            }
+        logging.getLogger(__name__).info(
+            "adopted %d migrated key(s) (table epoch %d); now serving "
+            "%d key(s)", len(stage["rows"]), self.table_epoch,
+            len(self._key_order),
+        )
+        return tv.encode(tv.OK, worker, None, extra={
+            "keys": sorted(stage["rows"]), "table_epoch": self.table_epoch,
+        })
+
+    def _migrate_abort(self, worker: int) -> bytes:
+        """RECIPIENT: discard the staged range (the donor keeps serving;
+        nothing here ever reached the engine)."""
+        with self._stage_lock:
+            self._migrate_in = None
+        return tv.encode(tv.OK, worker, None)
+
     def _set_draining(self) -> None:
         with self._engine._lock:
             self._draining = True
             self._pause_cond.notify_all()  # paused pushes wake into refusal
+
+    def stop(self, grace: float = 10.0) -> None:
+        m = self._coord_member
+        if m is not None:
+            m.close(goodbye=True)  # clean leave: the membership view
+            # shows 'left', never an eventual 'dead'
+        super().stop(grace=grace)
+
+    def kill(self) -> None:
+        m = self._coord_member
+        if m is not None:
+            m.close(goodbye=False)  # SIGKILL-equivalent: beats just stop
+        super().kill()
 
     # -- shard replication hooks (ps_tpu/replica) -----------------------------
 
@@ -583,23 +1065,35 @@ class AsyncPSService(VanService):
             with self._log_lock:
                 self.event_log.append(["pull", worker])
             return
-        if op != "push":
+        if op not in ("push", "push_sub"):
             raise ValueError(f"unknown replica op {op!r}")
         tree = decode_tree(dict(tensors), extra.get("enc"),
                            stats=self.transport)
         # own-memory copies: the entry's arrays view the request frame,
         # and the engine keeps references past its lifetime
         tree = {k: np.array(v) for k, v in tree.items()}
-        if sorted(tree) != sorted(self._key_order):
-            raise KeyError("replica push keys do not match the tree")
-        self._engine.push_tree(tree, worker=worker)
+        if op == "push_sub":
+            # the primary's PARTIAL apply (a replay straddling a range
+            # move owed only its adopted keys): mirror exactly that
+            # subset — the whole-tree check would refuse it as torn
+            missing = [k for k in tree if k not in self._key_order]
+            if missing:
+                raise KeyError(
+                    f"replica push_sub keys outside the tree: "
+                    f"{missing[:3]}")
+            self._engine.push_subtree(tree, worker=worker)
+        else:
+            if sorted(tree) != sorted(self._key_order):
+                raise KeyError("replica push keys do not match the tree")
+            self._engine.push_tree(tree, worker=worker)
         self._applied[worker] = self._applied.get(worker, 0) + 1
         if extra.get("pseq") is not None:
-            self._applied_pseq[worker] = (extra.get("pnonce"),
-                                          int(extra["pseq"]))
+            toks = self._applied_pseq.setdefault(worker, {})
+            for k in tree:
+                toks[k] = (extra.get("pnonce"), int(extra["pseq"]))
         with self._log_lock:
             self.apply_log.append(worker)
-            self.event_log.append(["push", worker])
+            self.event_log.append([op, worker])
 
 
 def serve_async(store, port: int = 0, bind: str = "127.0.0.1",
@@ -632,14 +1126,14 @@ def serve_async(store, port: int = 0, bind: str = "127.0.0.1",
                           ckpt_root=ckpt_root, backup=backup)
 
 
-def connect_async(uri: str, worker: int, params_like,
+def connect_async(uri: Optional[str], worker: int, params_like,
                   bucket_bytes: Optional[int] = None,
                   pool_size: Optional[int] = None,
                   compress=None, writev: Optional[bool] = None,
                   shm: Optional[bool] = None,
                   shm_bytes: Optional[int] = None,
-                  failover_timeout: Optional[float] = None
-                  ) -> "RemoteAsyncWorker":
+                  failover_timeout: Optional[float] = None,
+                  coordinator=None) -> "RemoteAsyncWorker":
     """Join a cross-process async job as worker ``worker``.
 
     ``uri`` is ``host:port`` of the :func:`serve_async` process, or a
@@ -675,15 +1169,36 @@ def connect_async(uri: str, worker: int, params_like,
     off, env PS_SHM) negotiates a same-host shared-memory ring lane per
     connection at connect time — ``shm_bytes`` (env PS_SHM_BYTES) sizes
     each ring — falling back to TCP whenever the peer is another host,
-    the segments cannot be created, or the server refuses."""
-    addrs, replica_sets = parse_replica_uri(uri)
+    the segments cannot be created, or the server refuses.
+
+    Elastic membership (README "Elastic membership"): pass
+    ``coordinator="host:port"`` (env PS_COORD_URI) INSTEAD of ``uri`` —
+    the worker fetches the authoritative shard table from the
+    coordinator (waiting until every server registered and the table
+    covers this model's keys), dials the shards it names, and
+    re-fetches + re-routes whenever a live rebalance moves keys under
+    it — no worker restart, no global pause."""
+    table = None
+    if coordinator is not None:
+        from ps_tpu.elastic.member import fetch_table
+
+        want, _ = keymod.flatten_with_keys(params_like)
+        table = fetch_table(coordinator, cover=want)
+        addrs, replica_sets = table.addrs(), table.replica_sets()
+    elif uri is None:
+        raise ValueError("connect_async needs a server uri or a "
+                         "coordinator address")
+    else:
+        addrs, replica_sets = parse_replica_uri(uri)
     return RemoteAsyncWorker.connect_many(addrs, worker, params_like,
                                           bucket_bytes=bucket_bytes,
                                           pool_size=pool_size,
                                           compress=compress, writev=writev,
                                           shm=shm, shm_bytes=shm_bytes,
                                           replica_sets=replica_sets,
-                                          failover_timeout=failover_timeout)
+                                          failover_timeout=failover_timeout,
+                                          coordinator=coordinator,
+                                          table=table)
 
 
 class CheckpointRoundError(RuntimeError):
@@ -835,14 +1350,16 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                      shm: Optional[bool] = None,
                      shm_bytes: Optional[int] = None,
                      replica_sets=None,
-                     failover_timeout: Optional[float] = None
+                     failover_timeout: Optional[float] = None,
+                     coordinator=None, table=None
                      ) -> "RemoteAsyncWorker":
         self = cls.__new__(cls)
         self._init_multi(list(addrs), worker, params_like,
                          bucket_bytes=bucket_bytes, pool_size=pool_size,
                          compress=compress, writev=writev, shm=shm,
                          shm_bytes=shm_bytes, replica_sets=replica_sets,
-                         failover_timeout=failover_timeout)
+                         failover_timeout=failover_timeout,
+                         coordinator=coordinator, table=table)
         return self
 
     def _init_multi(self, addrs: List[Tuple[str, int]], worker: int,
@@ -852,8 +1369,14 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                     shm: Optional[bool] = None,
                     shm_bytes: Optional[int] = None,
                     replica_sets=None,
-                    failover_timeout: Optional[float] = None) -> None:
+                    failover_timeout: Optional[float] = None,
+                    coordinator=None, table=None) -> None:
         self.worker = worker
+        # elastic membership (ps_tpu/elastic): with a coordinator, the
+        # shard table drives addrs/replica-sets and a stale-table refusal
+        # re-fetches it (_on_table_moved) instead of failing the job
+        self._coord = coordinator
+        self._table = table
         kv, self._treedef = keymod.flatten_with_keys(params_like)
         # placeholders, not the arrays: reconnect() only needs keys +
         # structure, and pinning a BERT-size initial tree for the worker's
@@ -994,6 +1517,92 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                     f"job runs {self.num_workers}")
         return None
 
+    # -- elastic membership: table re-route (ps_tpu/elastic) ------------------
+
+    def _on_table_moved(self, err, deadline: float) -> None:
+        """A shard refused with "key range moved" (or a pull came back
+        short): fetch a shard table NEWER than the one this worker routes
+        by and rebuild the transport against it. Bounded by the same
+        failover deadline as replica re-routes; converges because every
+        committed move eventually publishes a strictly higher epoch."""
+        from ps_tpu.elastic.member import fetch_table
+
+        if self._coord is None:
+            super()._on_table_moved(err, deadline)  # raises: no recovery
+        min_epoch = self._table.epoch if self._table is not None else None
+        while True:
+            budget = deadline - time.monotonic()
+            if budget <= 0:
+                raise TableMovedError(
+                    f"shard table never converged before the failover "
+                    f"deadline: {err}",
+                    table_epoch=getattr(err, "table_epoch", 0)) from err
+            try:
+                table = fetch_table(self._coord, cover=self._key_order,
+                                    min_epoch=min_epoch,
+                                    timeout=min(budget, 10.0))
+            except TimeoutError:
+                # the coordinator's publish can lag the shard's refusal;
+                # keep polling — the budget check above (not this one
+                # fetch's slice of it) is the real deadline, and the
+                # typed TableMovedError is the only way out
+                continue
+            try:
+                self._adopt_table(table)
+                return
+            except (ValueError, tv.VanError, ServerFailureError):
+                # the fetched table can race a shard's own cutover (its
+                # HELLO briefly disagrees): wait for a newer epoch — or
+                # just let the shards settle — and try again
+                min_epoch = table.epoch - 1
+                time.sleep(0.05)
+
+    def _adopt_table(self, table) -> None:
+        """Rebuild the whole transport (channels, owner map, replica
+        sets, pumps) against a new shard table, preserving transport
+        identity — cumulative counters, epoch streams, compressor
+        residuals, and the dedup nonce — exactly like ``reconnect()``.
+        No worker restart: the op that hit the refusal retries against
+        the new routing as soon as this returns."""
+        old_epoch = self._table.epoch if self._table is not None else None
+        obs.record_event("table_reroute", worker=self.worker,
+                         old_epoch=old_epoch, epoch=table.epoch,
+                         shards=len(table.shards))
+        self.transport.record_table_reroute()
+        saved = self._saved_transport_state()
+        # a table re-route is NOT a new worker incarnation: the op that
+        # hit the refusal replays with its original (nonce, seq) token
+        # right after this, and the shards that already applied it must
+        # still recognize the replay. _init_multi mints a fresh nonce and
+        # resets the seq counter (correct for a real reconnect — that IS
+        # a new incarnation); here both must survive, or the replay
+        # double-applies (unknown nonce) and every later push false-dedups
+        # (seq restarts below the server's token).
+        nonce, push_seq = self._transport_nonce, self._push_seq
+        self._close_transport()
+        for ch in self._chs:
+            ch.close()
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+        try:
+            self._init_multi(
+                table.addrs(), self.worker,
+                keymod.unflatten(self._treedef, self._kv_like,
+                                 self._key_order),
+                bucket_bytes=self.bucket_bytes, pool_size=self.pool_size,
+                compress=self.compress, writev=self.writev, shm=self.shm,
+                shm_bytes=self.shm_bytes,
+                replica_sets=table.replica_sets(),
+                failover_timeout=self.failover_timeout,
+                coordinator=self._coord, table=table)
+        finally:
+            self._restore_transport_state(saved)
+            self._transport_nonce, self._push_seq = nonce, push_seq
+        logging.getLogger(__name__).warning(
+            "worker %d re-routed to shard table epoch %d (%d shard(s))",
+            self.worker, table.epoch, len(table.shards),
+        )
+
     @property
     def version(self) -> int:
         """Total whole-subtree applies across all servers (single-server:
@@ -1044,15 +1653,39 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
             self.versions[i] = int(extra["version"])
             for k, v in tensors.items():
                 kv[k] = jnp.asarray(np.array(v))
+        missing = [k for k in self._key_order if k not in kv]
+        if missing:
+            raise self._incomplete_pull(missing)
         self._params = keymod.unflatten(self._treedef, kv, self._key_order)
         return self._params
 
-    def _split_by_owner(self, grads) -> Dict[int, Dict[str, np.ndarray]]:
+    def _incomplete_pull(self, missing) -> BaseException:
+        """A pull round that covered every dialed shard still came back
+        short: on an elastic worker that means keys moved to a shard this
+        worker is not dialing yet — re-fetch the table and re-pull
+        (reads are idempotent). Static workers surface it hard."""
+        if self._coord is not None:
+            return TableMovedError(
+                f"pull returned no value for {missing[:3]} — the shard "
+                f"table moved during the pull")
+        return RuntimeError(f"pull returned no value for {missing[:3]}")
+
+    def _host_grads(self, grads) -> Dict[str, np.ndarray]:
+        """Flatten one gradient pytree to host arrays ONCE per logical
+        push; the owner split happens per attempt (``_split_kv``) because
+        a table re-route between retries changes the split."""
         kv, _ = keymod.flatten_with_keys(grads)
+        return {k: np.asarray(v) for k, v in kv.items()}
+
+    def _split_kv(self, kv: Dict[str, np.ndarray]
+                  ) -> Dict[int, Dict[str, np.ndarray]]:
         out: Dict[int, Dict[str, np.ndarray]] = {i: {} for i in self._active}
         for k, v in kv.items():
-            out[self._owner[k]][k] = np.asarray(v)
+            out[self._owner[k]][k] = v
         return out
+
+    def _split_by_owner(self, grads) -> Dict[int, Dict[str, np.ndarray]]:
+        return self._split_kv(self._host_grads(grads))
 
     def pull_all(self) -> Any:
         """Fetch current params (each server records this worker's snapshot
@@ -1076,30 +1709,32 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
 
         The push carries this worker's (nonce, seq) dedup token — assigned
         ONCE per logical push, reused verbatim by any failover retry, so a
-        shard that already applied it (directly, or via its dead primary's
-        replication stream) acks without re-applying."""
-        by_owner = self._split_by_owner(grads)
+        shard that already applied it (directly, via its dead primary's
+        replication stream, or via a migrated key range's transferred
+        tokens) acks without re-applying. The owner SPLIT happens inside
+        the retried closure: a table re-route between attempts re-splits
+        against the new assignment."""
+        kv = self._host_grads(grads)
         pseq = self._next_push_seq()
         with self._op("push") as sp:
             tc = sp.wire()
             if self.bucket_bytes is not None:
                 self.flush()
                 self._with_failover(
-                    lambda: self._push_buckets_sync(by_owner, pseq=pseq,
-                                                    tc=tc))
+                    lambda: self._push_buckets_sync(self._split_kv(kv),
+                                                    pseq=pseq, tc=tc))
                 return
 
             def once():
                 msgs = self._fanout({
                     i: self._encode_serial_push(tv.PUSH, sub, pseq=pseq,
                                                 tc=tc)
-                    for i, sub in by_owner.items()
+                    for i, sub in self._split_kv(kv).items()
                 })
                 for i, msg in msgs.items():
                     kind, _, _, extra = tv.decode(msg)
                     if kind != tv.OK:
-                        raise RuntimeError(
-                            f"server {i} error: {extra.get('error')}")
+                        raise self._reply_error(i, extra)
                     self.versions[i] = int(extra["version"])
 
             self._with_failover(once)
@@ -1110,7 +1745,7 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         bucketed pipeline when the worker was connected with
         ``bucket_bytes`` (identical math — the server applies the same
         whole tree and snapshots the same atomic pull)."""
-        by_owner = self._split_by_owner(grads)
+        kv = self._host_grads(grads)
         pseq = self._next_push_seq()
         with self._op("push_pull") as sp:
             tc = sp.wire()
@@ -1119,7 +1754,8 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                 # reorder epochs
 
                 def once_bucketed():
-                    self._push_buckets_sync(by_owner, pseq=pseq, tc=tc)
+                    self._push_buckets_sync(self._split_kv(kv), pseq=pseq,
+                                            tc=tc)
                     return self._merge_host_params(self._pull_buckets(tc=tc))
 
                 return self._with_failover(once_bucketed)
@@ -1127,7 +1763,7 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                 lambda: self._merge_params(self._fanout({
                     i: self._encode_serial_push(tv.PUSH_PULL, sub,
                                                 pseq=pseq, tc=tc)
-                    for i, sub in by_owner.items()
+                    for i, sub in self._split_kv(kv).items()
                 })))
 
     # -- bucketed, pipelined transport (worker half) --------------------------
@@ -1272,6 +1908,9 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
     def _merge_host_params(self, kv: Dict[str, np.ndarray]) -> Any:
         import jax.numpy as jnp
 
+        missing = [k for k in self._key_order if k not in kv]
+        if missing:
+            raise self._incomplete_pull(missing)
         self._params = keymod.unflatten(
             self._treedef, {k: jnp.asarray(v) for k, v in kv.items()},
             self._key_order,
@@ -1292,14 +1931,14 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         the call and the wait: next-batch prep, metrics, the previous
         step's host work."""
         self._require_bucketed()
-        by_owner = self._split_by_owner(grads)  # host copy: caller may mutate
+        kv = self._host_grads(grads)  # host copy: caller may mutate
         pseq = self._next_push_seq()  # assigned NOW: retries reuse it
         pending = PendingCycle(self.transport)
         self._track_pending(pending)
-        self._bg_executor().submit(self._run_cycle, by_owner, pseq, pending)
+        self._bg_executor().submit(self._run_cycle, kv, pseq, pending)
         return pending
 
-    def _run_cycle(self, by_owner, pseq: int, pending: PendingCycle) -> None:
+    def _run_cycle(self, kv, pseq: int, pending: PendingCycle) -> None:
         t0 = time.perf_counter()
         try:
             # the background cycle is its own trace root (the caller's
@@ -1308,7 +1947,8 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                 tc = sp.wire()
 
                 def once():
-                    self._push_buckets_sync(by_owner, pseq=pseq, tc=tc)
+                    self._push_buckets_sync(self._split_kv(kv), pseq=pseq,
+                                            tc=tc)
                     return self._merge_host_params(self._pull_buckets(tc=tc))
 
                 params = self._with_failover(once)
@@ -1437,7 +2077,9 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                 # keeps them
                 replica_sets=None if addrs is not None
                 else self._replica_sets,
-                failover_timeout=self.failover_timeout)
+                failover_timeout=self.failover_timeout,
+                coordinator=self._coord,
+                table=None if addrs is not None else self._table)
         finally:
             # restores the compressor too: topk error-feedback residuals
             # are unsent gradient mass and must survive the re-dial

@@ -118,7 +118,9 @@ class SparsePSService(VanService):
                  shm: Optional[bool] = None,
                  backup: bool = False,
                  record_full_history: bool = False,
-                 history: int = 4096):
+                 history: int = 4096,
+                 coordinator=None,
+                 advertise_host: str = "127.0.0.1"):
         if not tables:
             raise ValueError("no tables to serve")
         if (shard is None) != (num_shards is None):
@@ -181,9 +183,63 @@ class SparsePSService(VanService):
         # worker id per applied push message — bounded ring unless the
         # replay-parity tests opt into full history
         self.apply_log = make_history_log(record_full_history, history)
+        # elastic membership (ps_tpu/elastic): a sparse shard JOINS the
+        # coordinator (membership, liveness, load reports, topology
+        # discovery for workers) but its row ranges do not live-migrate —
+        # a range move would resize live SparseEmbedding tables, which
+        # stays checkpoint-restart territory (SURVEY §6). The coordinator
+        # refuses to plan moves against kind="sparse" members.
+        self._coordinator = coordinator
+        self._coord_member = None
         # starts accepting: state ready
         super().__init__(port=port, bind=bind, writev=writev, shm=shm,
                          backup=backup)
+        if coordinator is not None and not backup:
+            self._join_coordinator(advertise_host)
+
+    def _join_coordinator(self, advertise_host: str) -> None:
+        import time as _time
+
+        from ps_tpu.elastic.member import CoordinatorMember
+
+        # one registry entry per (table, row range) — unique across the
+        # range partition, so the coordinator's ownership check holds
+        key_bytes = {
+            f"{name}@{m['lo']}:{m['hi']}":
+                (m["hi"] - m["lo"]) * m["dim"] * np.dtype(m["dtype"]).itemsize
+            for name, m in self._meta.items()
+        }
+        last = {"t": _time.monotonic(), "applies": self.apply_log.total}
+
+        def report_extra() -> dict:
+            now = _time.monotonic()
+            applies = self.apply_log.total
+            dt = max(now - last["t"], 1e-6)
+            push_qps = (applies - last["applies"]) / dt
+            last.update(t=now, applies=applies)
+            return {
+                "keys": len(self._meta),
+                "nbytes": sum(key_bytes.values()),
+                "push_qps": round(push_qps, 2),
+                "pull_qps": None,  # reads don't advance a sparse counter
+            }
+
+        self._coord_member = CoordinatorMember(
+            self._coordinator, f"{advertise_host}:{self.port}",
+            key_bytes, kind="sparse", report=report_extra)
+        self.table_epoch = self._coord_member.table.epoch
+
+    def stop(self, grace: float = 10.0) -> None:
+        m = self._coord_member
+        if m is not None:
+            m.close(goodbye=True)  # clean leave: membership shows 'left'
+        super().stop(grace=grace)
+
+    def kill(self) -> None:
+        m = self._coord_member
+        if m is not None:
+            m.close(goodbye=False)  # SIGKILL-equivalent: beats just stop
+        super().kill()
 
     # -- server internals -----------------------------------------------------
 
@@ -368,6 +424,8 @@ class SparsePSService(VanService):
                 "metrics": self.transport.metrics_snapshot(),
             }
             out.update(self.replica_state())
+            if self._coord_member is not None:
+                out["table_epoch"] = self.table_epoch
             return tv.encode(tv.OK, worker, None, extra=out)
         elif kind == tv.CHECKPOINT:
             return self._checkpoint(worker, extra)
@@ -564,15 +622,15 @@ def serve_sparse(tables: Dict[str, Any], port: int = 0,
                            ckpt_root=ckpt_root, backup=backup)
 
 
-def connect_sparse(uri: str, worker: int,
+def connect_sparse(uri: Optional[str], worker: int,
                    tables: Dict[str, Tuple[int, int]],
                    bucket_bytes: Optional[int] = None,
                    pool_size: Optional[int] = None,
                    compress=None, writev: Optional[bool] = None,
                    shm: Optional[bool] = None,
                    shm_bytes: Optional[int] = None,
-                   failover_timeout: Optional[float] = None
-                   ) -> "RemoteSparseWorker":
+                   failover_timeout: Optional[float] = None,
+                   coordinator=None) -> "RemoteSparseWorker":
     """Join a cross-process sparse PS as worker ``worker``.
 
     ``uri`` is ``host:port`` or a comma-separated list naming every server
@@ -593,13 +651,94 @@ def connect_sparse(uri: str, worker: int,
 
     Replica sets: each shard's entry may list replicas separated by ``|``
     (primary first) — a dead primary is retried against the set within
-    ``failover_timeout`` seconds (README "Replication & failover")."""
-    addrs, replica_sets = parse_replica_uri(uri)
+    ``failover_timeout`` seconds (README "Replication & failover").
+
+    Elastic membership (README "Elastic membership"): pass
+    ``coordinator="host:port"`` (env PS_COORD_URI) INSTEAD of ``uri`` —
+    the worker discovers the server topology from the coordinator's shard
+    table (polling until the registered members cover the whole row
+    partition) rather than a static URI list. Sparse row ranges do not
+    LIVE-migrate (that would resize serving tables — checkpoint-restart
+    territory), so the table is discovery + liveness here, not a moving
+    assignment."""
+    if coordinator is not None:
+        addrs, replica_sets = _sparse_topology_from_coordinator(
+            coordinator, worker, tables)
+    elif uri is None:
+        raise ValueError("connect_sparse needs a server uri or a "
+                         "coordinator address")
+    else:
+        addrs, replica_sets = parse_replica_uri(uri)
     return RemoteSparseWorker(addrs, worker, tables,
                               bucket_bytes=bucket_bytes, pool_size=pool_size,
                               compress=compress, writev=writev, shm=shm,
                               shm_bytes=shm_bytes, replica_sets=replica_sets,
-                              failover_timeout=failover_timeout)
+                              failover_timeout=failover_timeout,
+                              coordinator=coordinator)
+
+
+def _sparse_topology_from_coordinator(coordinator, worker: int,
+                                      tables: Dict[str, Tuple[int, int]],
+                                      timeout: float = 30.0):
+    """Poll the coordinator until the registered sparse members cover
+    every row of every expected table (members register one
+    ``<table>@<lo>:<hi>`` key per owned range), then return their URIs
+    as the dial list. Connect-time HELLO validation still runs — the
+    coordinator bootstraps the topology, the servers prove it."""
+    import time as _time
+
+    from ps_tpu.elastic.member import fetch_view
+
+    want = {name: int(total) for name, (total, _d) in tables.items()}
+    deadline = _time.monotonic() + timeout
+    while True:
+        view = fetch_view(coordinator)
+        table = view["table"]
+        owners = _sparse_owner_shards(table, want)
+        if owners:
+            return parse_replica_uri(
+                ",".join(table["shards"][s] for s in owners))
+        if _time.monotonic() >= deadline:
+            raise TimeoutError(
+                f"coordinator's members never covered the row partition "
+                f"of {sorted(want)} within {timeout}s "
+                f"({len(table['shards'])} member(s) registered)")
+        _time.sleep(0.05)
+
+
+def _sparse_owner_shards(table: dict,
+                         want: Dict[str, int]) -> Optional[List[int]]:
+    """The shard indices serving ``want``'s whole row partition, ordered
+    by row range (the dial order the worker's ``row_range`` math and the
+    servers' HELLO validation both expect) — or ``None`` while coverage
+    is incomplete. Assignment keys that are not this fleet's
+    ``<table>@<lo>:<hi>`` entries (a dense member's parameter keys on a
+    shared coordinator) are SKIPPED, not failed: the coordinator may own
+    more than one fleet."""
+    spans: Dict[str, List[Tuple[int, int, int]]] = {}
+    for k, s in table["assign"].items():
+        name, _, rng = k.partition("@")
+        if name not in want or ":" not in rng:
+            continue  # a dense key (or junk) — not this worker's fleet
+        lo, hi = rng.split(":")
+        spans.setdefault(name, []).append((int(lo), int(hi), int(s)))
+    for name, total in want.items():
+        pos = 0
+        for lo, hi, _s in sorted(spans.get(name, [])):
+            if lo > pos:
+                return None  # hole (overlap is HELLO's job to refuse)
+            pos = max(pos, hi)
+        if pos < total:
+            return None
+    # dial order = row order of the (alphabetically) first table; every
+    # table is sharded over the same members in the same split, which
+    # connect-time HELLO validation re-proves against each server
+    first = sorted(want)[0]
+    owners: List[int] = []
+    for _lo, _hi, s in sorted(spans.get(first, [])):
+        if s not in owners:
+            owners.append(s)
+    return owners
 
 
 class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
@@ -626,7 +765,12 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                  shm: Optional[bool] = None,
                  shm_bytes: Optional[int] = None,
                  replica_sets=None,
-                 failover_timeout: Optional[float] = None):
+                 failover_timeout: Optional[float] = None,
+                 coordinator=None):
+        # elastic membership: remembered so a topology change (a member
+        # drained/replaced between this worker's dials) re-discovers the
+        # fleet from the coordinator instead of failing the job
+        self._coord = coordinator
         self._init_multi(list(addrs), worker, tables,
                          bucket_bytes=bucket_bytes, pool_size=pool_size,
                          compress=compress, writev=writev, shm=shm,
@@ -1189,6 +1333,49 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                 failover_timeout=self.failover_timeout)
         finally:
             self._restore_transport_state(saved)
+
+    def _on_table_moved(self, err, deadline: float) -> None:
+        """Elastic membership: re-discover the fleet from the coordinator
+        and re-dial. Sparse ranges never live-migrate, so this only fires
+        when membership itself changed — a dead member whose slot a
+        replacement took over (the coordinator's exact-key-set takeover)
+        — via :meth:`_on_server_lost`. Polls within the failover deadline:
+        the replacement may still be booting/registering when the worker
+        first notices the death; the re-dial revalidates the whole row
+        partition (HELLO)."""
+        import time as _time
+
+        if self._coord is None:
+            super()._on_table_moved(err, deadline)  # raises: no recovery
+        while True:
+            budget = deadline - _time.monotonic()
+            if budget <= 0:
+                raise err
+            try:
+                addrs, replica_sets = _sparse_topology_from_coordinator(
+                    self._coord, self.worker, dict(self._spec),
+                    timeout=min(budget, 30.0))
+                self.reconnect(addrs)
+            except (tv.VanError, OSError, TimeoutError,
+                    ServerFailureError, RuntimeError):
+                # the table may still name the corpse (replacement not
+                # registered yet) — wait it out within the deadline
+                _time.sleep(0.2)
+                continue
+            self._replica_sets = replica_sets
+            self.transport.record_table_reroute()
+            obs.record_event("table_reroute", worker=self.worker,
+                             shards=len(addrs), fleet="sparse")
+            return
+
+    def _on_server_lost(self, err, deadline: float) -> None:
+        """A member died with no replica to cycle to: with a coordinator,
+        the fleet may already have a replacement registered for the same
+        row range (slot takeover) — re-discover and re-dial instead of
+        surfacing the death; without one, surface it unchanged."""
+        if self._coord is None:
+            raise err
+        self._on_table_moved(err, deadline)
 
     def stats(self) -> dict:
         msgs = self._fanout({

@@ -158,6 +158,20 @@ class AsyncTpuServer(PeekMixin, AsyncStagingMixin, CheckpointMixin):
         with self._lock:
             self._commit_tree(grads_kv, worker)
 
+    def push_subtree(self, grads_kv: Dict[str, Any], worker: int = 0) -> None:
+        """One fused DC apply of a SUBSET of keys — the live-migration
+        replay path (ps_tpu/elastic): a logical push retried across a
+        range move owes an apply only to the keys whose per-key dedup
+        token missed it, and keys are independent under per-tensor
+        optimizers, so applying exactly that subset is numerically the
+        replay of exactly those keys."""
+        missing = [k for k in grads_kv if k not in self._params]
+        if missing:
+            raise KeyError(f"unregistered keys {missing[:3]}")
+        self._check_worker(worker)
+        with self._lock:
+            self._commit_tree(grads_kv, worker)
+
     def _commit_tree_accounting(self, grads_kv) -> None:
         self._applies += len(grads_kv)
         k = self.mesh.shape[DATA_AXIS]
@@ -192,6 +206,97 @@ class AsyncTpuServer(PeekMixin, AsyncStagingMixin, CheckpointMixin):
 
     def optimizer_state(self, key: str):
         return self._state[key]
+
+    # -- elastic membership hooks (ps_tpu/elastic) ---------------------------
+    # Live key-range migration moves whole OWNERSHIP UNITS between engines:
+    # the parameter, its per-key optimizer state, every worker's stale
+    # snapshot of it, and its apply count. Keys are independent under
+    # per-tensor optimizers (the property the whole fused-apply design
+    # already rests on), which is exactly what makes a key's history
+    # portable between engines bit-for-bit.
+
+    def export_keys(self, keys):
+        """Full migration rows for ``keys`` (CALLER holds the lock).
+
+        Optimizer state travels flattened (``{leaf-path: array}`` in
+        flatten order) — the recipient rebuilds the pytree against a
+        fresh ``opt.init`` of the adopted param, so treedefs never
+        cross the wire."""
+        from ps_tpu.kv import keys as keymod
+
+        out = {}
+        for k in keys:
+            if k not in self._params:
+                raise KeyError(f"unregistered key {k!r}")
+            state_kv, _ = keymod.flatten_with_keys(self._state[k])
+            out[k] = {
+                "param": self._params[k],
+                "state": state_kv,
+                "stale": {w: v for (w, kk), v in self._stale.items()
+                          if kk == k},
+                "apply_count": self.apply_count.get(k, 0),
+            }
+        return out
+
+    def adopt_key(self, k: str, param, state_kv, stale,
+                  apply_count: int = 0) -> None:
+        """Install one migrated row (CALLER holds the lock): place the
+        param per this engine's policy, rebuild the optimizer state from
+        the donor's flattened leaves over a fresh-init structure, and
+        seed the stale snapshots so the DC correction resumes where the
+        donor left it."""
+        from ps_tpu.kv import keys as keymod
+
+        if k in self._params:
+            raise KeyError(f"key {k!r} already registered")
+        sh = param_sharding(self.mesh, np.asarray(param), self.placement,
+                            key=k, rules=self.partition_rules)
+        p = jax.device_put(np.asarray(param), sh)
+        fresh = sharded_opt_init(self._opt.init, p, self.mesh,
+                                 self.placement, key=k,
+                                 rules=self.partition_rules)
+        fkv, fdef = keymod.flatten_with_keys(fresh)
+        order = list(fkv)
+        if sorted(fkv) != sorted(state_kv):
+            raise ValueError(
+                f"optimizer-state structure mismatch for {k!r}: donor "
+                f"sent {sorted(state_kv)[:3]}, this engine expects "
+                f"{sorted(fkv)[:3]} — donor and recipient must run the "
+                f"same optimizer"
+            )
+        merged = {}
+        for sk, like in fkv.items():
+            v = np.asarray(state_kv[sk])
+            if tuple(v.shape) != tuple(np.shape(like)):
+                raise ValueError(
+                    f"optimizer-state leaf {sk!r} of {k!r} has shape "
+                    f"{v.shape}, expected {np.shape(like)}"
+                )
+            merged[sk] = jax.device_put(v, like.sharding)
+        self._params[k] = p
+        self._state[k] = keymod.unflatten(fdef, merged, order)
+        for w, v in stale.items():
+            self._stale[(int(w), k)] = jax.device_put(np.asarray(v), sh)
+        self.apply_count[k] = int(apply_count)
+
+    def evict_keys(self, keys) -> None:
+        """Drop migrated-away keys (CALLER holds the lock): params, state,
+        stale snapshots, apply counts — and any per-key async staging of
+        them (a staged partial tree must not commit a key this engine no
+        longer owns)."""
+        gone = set(keys)
+        for k in gone:
+            if k not in self._params:
+                raise KeyError(f"unregistered key {k!r}")
+        for k in gone:
+            del self._params[k]
+            del self._state[k]
+            self.apply_count.pop(k, None)
+        for wk in [wk for wk in self._stale if wk[1] in gone]:
+            del self._stale[wk]
+        for staged in self._staged_async.values():
+            for k in gone & set(staged):
+                del staged[k]
 
     # -- checkpoint hooks (CheckpointMixin) ---------------------------------
     # SURVEY.md §6: async mode checkpoints server-side state + every worker's

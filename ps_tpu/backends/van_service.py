@@ -54,6 +54,17 @@ class NotServingError(RuntimeError):
     failover loop re-routes instead of failing the job."""
 
 
+class StaleTableError(RuntimeError):
+    """Raised inside a handler when the request's key range is not (or no
+    longer) served here because the SHARD TABLE moved — a live rebalance
+    migrated keys between shards (ps_tpu/elastic). Typed apart from
+    :class:`NotServingError` because the remedy differs: the server is
+    healthy, only the assignment changed, so the worker must re-fetch the
+    table from the coordinator and re-split — NOT cycle this shard's
+    replica set. The serve loop encodes it as an ERR reply carrying
+    ``moved: True`` plus this service's ``table_epoch``."""
+
+
 class RingLog:
     """Fixed-size tail of an append-only log, plus the total count.
 
@@ -205,6 +216,11 @@ class VanService:
         # workers refuse to re-route to a lower-epoch (zombie) server.
         self.role = "backup" if backup else "primary"
         self.epoch = 0
+        # elastic membership (ps_tpu/elastic): the shard-table epoch this
+        # service last observed (0 = static topology). Migration commits
+        # advance it; stale-table refusals carry it so workers know which
+        # epoch to wait past when they re-fetch from the coordinator.
+        self.table_epoch = 0
         self._primary_epoch = 0       # backup: learned at REPLICA_HELLO
         self._replica_applied_seq = 0  # backup: last applied stream seq
         self._replica_attached = False
@@ -698,6 +714,12 @@ class VanService:
                             reply = tv.encode(tv.ERR, worker, None, extra={
                                 "error": str(e), "backup": True,
                                 "epoch": self.epoch,
+                            })
+                        except StaleTableError as e:  # re-route, not
+                            # failover: the key range moved shards
+                            reply = tv.encode(tv.ERR, worker, None, extra={
+                                "error": str(e), "moved": True,
+                                "table_epoch": self.table_epoch,
                             })
                         except Exception as e:  # surface to the worker
                             reply = tv.encode(tv.ERR, worker, None,

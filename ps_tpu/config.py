@@ -51,6 +51,17 @@ Environment variables honored by :meth:`Config.from_env`:
   primaries block (the bounded ack window; default 256)
 - ``PS_FAILOVER_TIMEOUT_MS`` — worker side: how long a shard's replica set
   is retried (promotion wait included) before the typed failure surfaces
+- ``PS_COORD_URI``           — elastic membership (ps_tpu/elastic):
+  ``host:port`` of the cluster coordinator; servers register with it and
+  workers fetch the shard table from it instead of a static
+  ``PS_SERVER_URIS`` list (unset = today's static topology)
+- ``PS_REBALANCE_AUTO``      — '1' lets the coordinator rebalance on its
+  own when byte skew across shards exceeds the threshold (default off —
+  operators/benches trigger rebalances explicitly)
+- ``PS_REBALANCE_MAX_SKEW``  — max/min byte-load ratio tolerated before an
+  auto rebalance fires (default 2.0)
+- ``PS_REBALANCE_REPORT_MS`` — load-report cadence the coordinator hands
+  registering members (default 1000)
 - ``PS_TRACE_SAMPLE``        — distributed-tracing sample rate in [0, 1]
   (ps_tpu/obs: 0 = off, the default — the unsampled path costs nothing)
 - ``PS_TRACE_DIR``           — directory for trace exports and flight-
@@ -165,6 +176,23 @@ class Config:
       failover_timeout_ms: worker side — how long each shard's replica
         set is retried (covering detection + promotion) before a
         ServerFailureError surfaces.
+      coord_uri: elastic membership (ps_tpu/elastic, README "Elastic
+        membership") — ``host:port`` of the cluster coordinator. Servers
+        register their key ranges with it; workers fetch the
+        authoritative shard table from it (INSTEAD of ``server_uris``)
+        and re-route live when a rebalance moves keys. ``None`` (default)
+        keeps today's static URI topology — the subsystem is strictly
+        additive. Distinct from ``coordinator_uri``, which is
+        jax.distributed's rendezvous for multi-host SPMD.
+      rebalance_auto: let the coordinator fire a rebalance on its own
+        when the byte skew across serving shards exceeds
+        ``rebalance_max_skew``. Off by default: drills, benches, and
+        operators call the rebalance entry points explicitly.
+      rebalance_max_skew: the max/min byte-load ratio across shards the
+        auto-rebalancer tolerates before planning moves (default 2.0).
+      rebalance_report_ms: cadence of the load reports (keys, bytes,
+        push/pull QPS) each member streams to the coordinator — the
+        skew signal's freshness (default 1000).
       trace_sample: distributed-tracing sample rate in [0, 1] (README
         "Observability"; ps_tpu/obs). A sampled worker op propagates its
         trace context in the van frame headers, so the whole
@@ -254,6 +282,15 @@ class Config:
     replica_ack: str = "sync"
     replica_window: int = 256
     failover_timeout_ms: int = 10_000
+    # elastic membership (ps_tpu/elastic, README "Elastic membership"):
+    # the coordinator owning the versioned shard table (None = static
+    # topology), plus the rebalance policy knobs the coordinator runs
+    # with (auto-fire on byte skew, the tolerated max/min ratio, and the
+    # member load-report cadence feeding the skew signal)
+    coord_uri: Optional[str] = None
+    rebalance_auto: bool = False
+    rebalance_max_skew: float = 2.0
+    rebalance_report_ms: int = 1000
     # observability (ps_tpu/obs, README "Observability"): trace sampling
     # (0 = off), trace/flight output dir, the opt-in /metrics endpoint,
     # and the flight-recorder ring size. apply_obs() pushes these into
@@ -367,6 +404,13 @@ class Config:
             raise ValueError("replica_window must be >= 1")
         if self.failover_timeout_ms < 1:
             raise ValueError("failover_timeout_ms must be >= 1")
+        if self.rebalance_max_skew < 1.0:
+            raise ValueError(
+                f"rebalance_max_skew {self.rebalance_max_skew} < 1: the "
+                f"max/min byte ratio across shards is never below 1"
+            )
+        if self.rebalance_report_ms < 1:
+            raise ValueError("rebalance_report_ms must be >= 1")
         if not (0.0 <= self.trace_sample <= 1.0):
             raise ValueError(
                 f"trace_sample {self.trace_sample} outside [0, 1]")
@@ -479,6 +523,15 @@ class Config:
             kwargs["replica_window"] = int(env["PS_REPLICA_WINDOW"])
         if "PS_FAILOVER_TIMEOUT_MS" in env:
             kwargs["failover_timeout_ms"] = int(env["PS_FAILOVER_TIMEOUT_MS"])
+        if "PS_COORD_URI" in env:
+            # "" explicitly selects the static topology
+            kwargs["coord_uri"] = env["PS_COORD_URI"] or None
+        if "PS_REBALANCE_AUTO" in env:
+            kwargs["rebalance_auto"] = env_flag("PS_REBALANCE_AUTO", False)
+        if "PS_REBALANCE_MAX_SKEW" in env:
+            kwargs["rebalance_max_skew"] = float(env["PS_REBALANCE_MAX_SKEW"])
+        if "PS_REBALANCE_REPORT_MS" in env:
+            kwargs["rebalance_report_ms"] = int(env["PS_REBALANCE_REPORT_MS"])
         if "PS_TRACE_SAMPLE" in env:
             kwargs["trace_sample"] = float(env["PS_TRACE_SAMPLE"] or 0)
         if "PS_TRACE_DIR" in env:

@@ -44,6 +44,8 @@ def _lib():
     ]
     lib.hb_server_seq.restype = ctypes.c_uint64
     lib.hb_server_seq.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
+    lib.hb_server_age_ms.restype = ctypes.c_int64
+    lib.hb_server_age_ms.argtypes = [ctypes.c_void_p, ctypes.c_uint32]
     lib.hb_server_stop.argtypes = [ctypes.c_void_p]
     lib.hb_client_start.restype = ctypes.c_void_p
     lib.hb_client_start.argtypes = [
@@ -107,19 +109,43 @@ class HeartbeatServer:
         """Beats received from node_id (0 = never seen)."""
         return int(self._lib.hb_server_seq(self._require(), node_id))
 
-    def state(self, node_id: int) -> str:
-        """One node's liveness: 'left' (clean goodbye — permanent), 'dead'
-        (seen-then-silent past the horizon), 'alive', or 'unseen' (never
-        beat — indistinguishable from not-started-yet). The promotion
-        watch (ps_tpu/replica/watch.py) keys its goodbye-vs-timeout
-        distinction off this."""
-        if node_id in self.left():
-            return "left"
-        if node_id in self.dead():
-            return "dead"
-        if node_id in self.alive():
-            return "alive"
-        return "unseen"
+    def age_ms(self, node_id: int) -> Optional[int]:
+        """Milliseconds since this node's last beat (None = never seen).
+        The per-peer freshness the coordinator's membership view and
+        ps_top render — 'alive' says a peer beat within the horizon,
+        the age says HOW fresh, which is what an operator watching a
+        wobbly member actually needs."""
+        age = int(self._lib.hb_server_age_ms(self._require(), node_id))
+        return None if age < 0 else age
+
+    def state(self, node_id: Optional[int] = None):
+        """One node's liveness, or the whole monitor's view.
+
+        With ``node_id``: the liveness string — 'left' (clean goodbye —
+        permanent), 'dead' (seen-then-silent past the horizon), 'alive',
+        or 'unseen' (never beat — indistinguishable from
+        not-started-yet). The promotion watch (ps_tpu/replica/watch.py)
+        keys its goodbye-vs-timeout distinction off this.
+
+        Without: ``{node: {"state", "age_ms", "seq"}}`` for every node
+        that ever beat — the per-peer last-beat ages included, so the
+        coordinator's liveness view (ps_tpu/elastic) rides this ONE
+        detector instead of growing a second one."""
+        if node_id is not None:
+            if node_id in self.left():
+                return "left"
+            if node_id in self.dead():
+                return "dead"
+            if node_id in self.alive():
+                return "alive"
+            return "unseen"
+        out: Dict[int, dict] = {}
+        for st, nodes in (("alive", self.alive()), ("dead", self.dead()),
+                          ("left", self.left())):
+            for n in nodes:
+                out[n] = {"state": st, "age_ms": self.age_ms(n),
+                          "seq": self.seq(n)}
+        return out
 
     def close(self) -> None:
         if self._h:

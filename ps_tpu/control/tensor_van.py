@@ -70,6 +70,35 @@ REPLICA_STATE = 19    # -> any service: role/epoch/replication-lag probe
 #                       (reply also carries the server's wall clock "now" —
 #                       the NTP-style probe ps_tpu/obs/clock.py rides for
 #                       cross-process trace-timeline alignment)
+# elastic membership (ps_tpu/elastic): a coordinator role owns the
+# authoritative versioned shard table; servers register and report load,
+# workers fetch the table, and the coordinator drives live key-range
+# migrations between shards (no worker restart, no global pause)
+COORD_HELLO = 20      # member -> coordinator: join (servers advertise
+#                       their uri + key range; the reply carries the
+#                       current table, a heartbeat port, and a node id)
+COORD_TABLE = 21      # -> coordinator: the current shard table, plus the
+#                       membership/liveness view ps_top renders
+COORD_REPORT = 22     # server -> coordinator: periodic load report
+#                       (keys, bytes, push/pull QPS from TransportStats)
+COORD_REBALANCE = 23  # operator -> coordinator: plan + execute a
+#                       rebalance (explicit moves, a target member set,
+#                       or a drain); replies when the table committed
+# live key-range migration (donor shard -> recipient shard), driven by
+# the coordinator's MIGRATE_OUT command; rows ride the PR-4 replica-
+# stream machinery (sequenced entries over one channel, per-entry acks)
+MIGRATE_OUT = 24      # coordinator -> donor: stream these keys to the
+#                       target shard; replies once the move committed
+MIGRATE_BEGIN = 25    # donor -> recipient: open the migration intake
+#                       (key list + topology validation; ERR = refused)
+MIGRATE_ROW = 26      # donor -> recipient: ONE sequenced row — param +
+#                       optimizer state + stale snapshots travel together;
+#                       later rows for a key supersede earlier (the
+#                       double-write catch-up during live traffic)
+MIGRATE_COMMIT = 27   # donor -> recipient: cut over — the recipient
+#                       installs the staged rows and starts serving them
+MIGRATE_ABORT = 28    # donor -> recipient: discard the staged range
+#                       (the move failed; the donor keeps serving)
 
 #: human names per kind — span labels (ps_tpu/obs/trace.py), ps_top, and
 #: flight-recorder events all resolve through here so a new kind gets a
@@ -83,6 +112,11 @@ KIND_NAMES = {
     ROW_BUCKET_PUSH: "row_bucket_push", SHM_SETUP: "shm_setup",
     REPLICA_HELLO: "replica_hello", REPLICA_APPEND: "replica_append",
     REPLICA_PROMOTE: "replica_promote", REPLICA_STATE: "replica_state",
+    COORD_HELLO: "coord_hello", COORD_TABLE: "coord_table",
+    COORD_REPORT: "coord_report", COORD_REBALANCE: "coord_rebalance",
+    MIGRATE_OUT: "migrate_out", MIGRATE_BEGIN: "migrate_begin",
+    MIGRATE_ROW: "migrate_row", MIGRATE_COMMIT: "migrate_commit",
+    MIGRATE_ABORT: "migrate_abort",
 }
 
 

@@ -1,0 +1,40 @@
+"""Elastic membership (README "Elastic membership").
+
+A coordinator role owns the authoritative, epoch-versioned shard table
+and drives LIVE key-range rebalancing between serving shards — scale a
+2-shard fleet to 4 and back under traffic with no worker restart and no
+global pause. Strictly additive: with no coordinator configured
+(``Config.coord_uri`` / PS_COORD_URI unset), workers and servers keep
+today's static URI topology untouched.
+
+Pieces:
+
+- :class:`~ps_tpu.elastic.table.ShardTable` — the versioned key→shard
+  assignment (the fencing token workers re-route on);
+- :class:`~ps_tpu.elastic.coordinator.Coordinator` — membership,
+  liveness (PR-4 heartbeat detector), load reports, rebalance driver;
+- :class:`~ps_tpu.elastic.migrate.MigrationSession` — the donor's
+  sequenced row stream (param + optimizer state + stale snapshots per
+  key) with double-write catch-up and a bounded stop-and-copy cutover;
+- :class:`~ps_tpu.elastic.member.CoordinatorMember` /
+  :func:`~ps_tpu.elastic.member.fetch_table` /
+  :func:`~ps_tpu.elastic.member.request_rebalance` — the member/worker/
+  operator clients.
+"""
+
+from ps_tpu.elastic.coordinator import Coordinator
+from ps_tpu.elastic.member import (
+    CoordinatorMember,
+    fetch_table,
+    fetch_view,
+    parse_coord,
+    request_rebalance,
+)
+from ps_tpu.elastic.migrate import MigrationError, MigrationSession
+from ps_tpu.elastic.table import ShardTable, plan_moves, skew
+
+__all__ = [
+    "Coordinator", "CoordinatorMember", "MigrationError",
+    "MigrationSession", "ShardTable", "fetch_table", "fetch_view",
+    "parse_coord", "plan_moves", "request_rebalance", "skew",
+]

@@ -1,0 +1,562 @@
+"""The coordinator role: authoritative shard table + rebalance driver.
+
+The PS family ships a scheduler/rendezvous node that owns cluster
+membership (SURVEY §2's van roles); this is ps_tpu's version, scoped to
+what the data plane actually needs from it:
+
+- **membership**: servers register at startup (``COORD_HELLO`` with their
+  URI and the key range they booted with); the coordinator accumulates
+  the authoritative :class:`~ps_tpu.elastic.table.ShardTable` and serves
+  it to joining workers (``COORD_TABLE``). Liveness reuses the PR-4
+  heartbeat detector — every member beats this process's
+  :class:`~ps_tpu.control.heartbeat.HeartbeatServer`, and the membership
+  view (``ps_top --coord``) shows each member's per-peer last-beat age.
+- **load**: servers report keys/bytes/QPS (``COORD_REPORT``, fed from
+  their existing ``TransportStats``); reports drive the skew check.
+- **rebalance**: on an operator request (``COORD_REBALANCE`` /
+  :meth:`Coordinator.rebalance`) — or automatically when byte skew
+  exceeds ``max_skew`` with ``auto=True`` — the coordinator plans moves
+  (:func:`~ps_tpu.elastic.table.plan_moves`) and drives each donor's live
+  key-range migration (``MIGRATE_OUT``), committing one table epoch per
+  move. Workers re-route on the typed stale-table refusal and re-fetch
+  here; nothing restarts and nothing pauses globally.
+
+The coordinator is deliberately OFF the data path: a dead coordinator
+stops rebalances and new joins, never traffic — workers keep their last
+table and servers keep serving. (Replication/failover within a shard
+stays PR-4's job; the coordinator moves key ranges between LIVE shards.)
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional
+
+from ps_tpu import obs
+from ps_tpu.backends.van_service import VanService
+from ps_tpu.control import tensor_van as tv
+from ps_tpu.control.heartbeat import HeartbeatServer
+from ps_tpu.elastic.table import ShardTable, plan_moves, skew
+
+__all__ = ["Coordinator"]
+
+
+class _Member:
+    """One registered server: its dialable URI, per-key byte sizes, the
+    heartbeat node id it beats with, and its latest load report."""
+
+    def __init__(self, uri: str, node: int, kind: str):
+        self.uri = uri
+        self.node = node
+        self.kind = kind              # "dense" | "sparse"
+        self.key_bytes: Dict[str, int] = {}
+        self.report: dict = {}
+        self.report_t: Optional[float] = None
+
+    @property
+    def nbytes(self) -> int:
+        return sum(self.key_bytes.values())
+
+
+class Coordinator(VanService):
+    """Serve the shard table and drive rebalances over the tensor van.
+
+    Args:
+      port/bind: the van endpoint (0 = ephemeral; loopback by default,
+        like every other unauthenticated endpoint here).
+      hb_timeout_ms: the member death horizon for the liveness view.
+      auto: rebalance automatically when the byte skew across serving
+        shards exceeds ``max_skew`` (``Config.rebalance_auto`` /
+        PS_REBALANCE_AUTO; off by default — drills and operators call
+        :meth:`rebalance` explicitly).
+      max_skew: max/min byte-load ratio tolerated before an auto
+        rebalance fires (``Config.rebalance_max_skew``).
+      report_ms: the load-report cadence handed to registering members
+        (``Config.rebalance_report_ms``).
+    """
+
+    def __init__(self, port: int = 0, bind: str = "127.0.0.1",
+                 hb_timeout_ms: int = 2000, auto: bool = False,
+                 max_skew: float = 2.0, report_ms: int = 1000):
+        self._tlock = threading.Lock()
+        self._table = ShardTable(0, [], {})
+        self._members: List[_Member] = []   # index == shard index
+        self._next_node = 1
+        self._rebalancing: Optional[dict] = None  # live move progress
+        self._draining = False
+        self._dead_seen: set = set()
+        self.auto = bool(auto)
+        self.max_skew = float(max_skew)
+        self.report_ms = int(report_ms)
+        self.moves_done = 0
+        self.hb = HeartbeatServer(port=0, timeout_ms=hb_timeout_ms,
+                                  bind=bind)
+        reg = obs.default_registry()
+        self._m_moves = reg.counter("ps_rebalance_moves_total",
+                                    "committed key-range moves")
+        self._m_keys = reg.counter("ps_rebalance_keys_total",
+                                   "keys moved by committed rebalances")
+        self._m_bytes = reg.counter("ps_rebalance_bytes_total",
+                                    "row bytes streamed by rebalances")
+        self._m_aborts = reg.counter("ps_rebalance_aborts_total",
+                                     "aborted key-range moves")
+        # one coordinator per cluster here, so "election" is the moment
+        # this process takes ownership of the table — recorded so the
+        # flight log of any later incident names who owned membership
+        obs.record_event("coord_elect", hb_port=self.hb.port)
+        super().__init__(port=port, bind=bind)
+        self.role = "coordinator"  # after super(): ps_top shows the truth
+
+    # -- dispatch --------------------------------------------------------------
+
+    def _dispatch_traced(self, kind: int, worker: int, tensors,
+                         extra) -> bytes:
+        # no primary/backup gate: the coordinator serves its own protocol
+        # (plus REPLICA_STATE so clock probes and ps_top work unchanged)
+        if kind == tv.REPLICA_STATE:
+            return tv.encode(tv.OK, worker, None, extra=self.replica_state())
+        return self._handle(kind, worker, tensors, extra)
+
+    def _handle(self, kind: int, worker: int, tensors, extra) -> bytes:
+        if kind == tv.COORD_HELLO:
+            return self._hello(worker, extra)
+        elif kind == tv.COORD_TABLE:
+            if (extra or {}).get("lean"):
+                # table only — the hot worker-poll shape (join, re-route)
+                with self._tlock:
+                    wire = self._table.to_wire()
+                return tv.encode(tv.OK, worker, None,
+                                 extra={"table": wire})
+            return tv.encode(tv.OK, worker, None, extra=self._table_reply())
+        elif kind == tv.COORD_REPORT:
+            return self._report(worker, extra)
+        elif kind == tv.COORD_REBALANCE:
+            if self._draining:
+                return tv.encode(tv.ERR, worker, None, extra={
+                    "error": "coordinator is draining; rebalance refused"})
+            try:
+                out = self.rebalance(
+                    moves=extra.get("moves"),
+                    targets=extra.get("targets"),
+                    drain=extra.get("drain"))
+            except Exception as e:  # refusal, not a crash: the table is
+                # unchanged for any move that did not commit
+                return tv.encode(tv.ERR, worker, None,
+                                 extra={"error": repr(e)})
+            return tv.encode(tv.OK, worker, None, extra=out)
+        elif kind == tv.STATS:
+            out = {"role": self.role, "members": self._members_view(),
+                   "table": self._table.to_wire(),
+                   "moves_done": self.moves_done}
+            return tv.encode(tv.OK, worker, None, extra=out)
+        return tv.encode(tv.ERR, worker, None,
+                         extra={"error": f"bad kind {kind}"})
+
+    def _set_draining(self) -> None:
+        self._draining = True
+
+    def stop(self, grace: float = 10.0) -> None:
+        super().stop(grace=grace)
+        self.hb.close()
+
+    def kill(self) -> None:
+        super().kill()
+        self.hb.close()
+
+    # -- membership ------------------------------------------------------------
+
+    def _hello(self, worker: int, extra: dict) -> bytes:
+        role = str(extra.get("role", "worker"))
+        if role != "server":
+            # workers just fetch the table; no registration needed
+            return tv.encode(tv.OK, worker, None, extra=self._table_reply())
+        uri = str(extra["uri"])
+        key_bytes = {str(k): int(v)
+                     for k, v in (extra.get("key_bytes") or {}).items()}
+        # liveness snapshot BEFORE the table lock (the monitor has its
+        # own mutex; no reason to nest them)
+        try:
+            gone = set(self.hb.dead()) | set(self.hb.left())
+        except Exception:
+            gone = set()
+        with self._tlock:
+            member = next((m for m in self._members if m.uri == uri), None)
+            if member is None:
+                # a member that boots WITH keys extends the table (the
+                # descriptive initial registration); overlap with already-
+                # assigned keys is refused — ownership is unique — UNLESS
+                # this is a replacement adopting a dead/left member's
+                # EXACT key set (same range re-seeded on a new
+                # process/port): that member's slot is taken over in
+                # place, so the fleet heals without a coordinator restart
+                claimed = [k for k in key_bytes if k in self._table.assign]
+                slot = None
+                if claimed:
+                    for i, m in enumerate(self._members):
+                        if (m.node in gone and key_bytes
+                                and set(self._table.keys_of(i))
+                                == set(key_bytes)):
+                            slot = i
+                            break
+                    if slot is None:
+                        return tv.encode(tv.ERR, worker, None, extra={
+                            "error": (f"keys already assigned elsewhere: "
+                                      f"{sorted(claimed)[:3]} — a joining "
+                                      f"server must boot empty (standby), "
+                                      f"with unclaimed keys, or as a "
+                                      f"replacement matching a dead/left "
+                                      f"member's exact key set"),
+                        })
+                member = _Member(uri, self._next_node,
+                                 str(extra.get("kind", "dense")))
+                self._next_node += 1
+                member.key_bytes = key_bytes
+                if slot is not None:
+                    old = self._members[slot]
+                    self._members[slot] = member
+                    shards = list(self._table.shards)
+                    shards[slot] = uri
+                    self._table = ShardTable(self._table.epoch + 1,
+                                             shards, self._table.assign)
+                    self._dead_seen.discard(old.node)
+                    obs.record_event("coord_takeover", shard=slot,
+                                     uri=uri, old_uri=old.uri,
+                                     epoch=self._table.epoch)
+                else:
+                    self._members.append(member)
+                    shard = len(self._members) - 1
+                    assign = dict(self._table.assign)
+                    assign.update({k: shard for k in key_bytes})
+                    self._table = ShardTable(
+                        self._table.epoch + 1,
+                        self._table.shards + [uri], assign)
+            else:
+                shard = self._members.index(member)
+                if key_bytes and (set(key_bytes)
+                                  != set(self._table.keys_of(shard))):
+                    return tv.encode(tv.ERR, worker, None, extra={
+                        "error": (f"re-registration of {uri} does not "
+                                  f"match shard {shard}'s assignment — "
+                                  f"a member's key set only changes "
+                                  f"through rebalance moves"),
+                    })
+                if member.node in gone:
+                    # a restarted process on the SAME uri: its old node
+                    # id is 'left'/'dead' at the monitor FOREVER (a
+                    # goodbye permanently suppresses death detection),
+                    # so reusing it would show a live shard as left and
+                    # leave its slot takeover-eligible while it serves.
+                    # Mint a fresh identity for the new process.
+                    self._dead_seen.discard(member.node)
+                    member.node = self._next_node
+                    self._next_node += 1
+                member.key_bytes = key_bytes or member.key_bytes
+            node = member.node
+            table = self._table
+        logging.getLogger(__name__).info(
+            "member %s joined as shard %d (node %d, %d key(s), epoch %d)",
+            uri, table.shards.index(uri), node, len(key_bytes), table.epoch,
+        )
+        return tv.encode(tv.OK, worker, None, extra={
+            "table": table.to_wire(), "hb_port": self.hb.port,
+            "node": node, "report_ms": self.report_ms,
+        })
+
+    def _report(self, worker: int, extra: dict) -> bytes:
+        uri = str(extra.get("uri"))
+        with self._tlock:
+            member = next((m for m in self._members if m.uri == uri), None)
+            if member is not None:
+                member.report = {
+                    "keys": extra.get("keys"),
+                    "nbytes": extra.get("nbytes"),
+                    "push_qps": extra.get("push_qps"),
+                    "pull_qps": extra.get("pull_qps"),
+                }
+                member.report_t = time.monotonic()
+                if extra.get("nbytes") is not None:
+                    total = int(extra["nbytes"])
+                    if member.key_bytes and total:
+                        # rescale the per-key sizes to the reported total
+                        # (rows grow/shrink server-side, e.g. sparse)
+                        old = sum(member.key_bytes.values()) or 1
+                        member.key_bytes = {
+                            k: max(1, v * total // old)
+                            for k, v in member.key_bytes.items()}
+        self._note_dead_members()
+        if self.auto and member is not None:
+            self._maybe_auto_rebalance()
+        return tv.encode(tv.OK, worker, None,
+                         extra={"epoch": self._table.epoch})
+
+    def _members_view(self) -> List[dict]:
+        """The membership/liveness rows ps_top renders: per member, the
+        heartbeat state AND last-beat age from the PR-4 detector."""
+        hb = self.hb.state()  # {node: {"state", "age_ms", "seq"}}
+        with self._tlock:
+            out = []
+            for i, m in enumerate(self._members):
+                live = hb.get(m.node) or {}
+                out.append({
+                    "shard": i, "uri": m.uri, "kind": m.kind,
+                    "node": m.node,
+                    "hb_state": live.get("state", "unseen"),
+                    "hb_age_ms": live.get("age_ms"),
+                    "keys": len(m.key_bytes), "nbytes": m.nbytes,
+                    "report": m.report,
+                })
+            return out
+
+    def _table_reply(self) -> dict:
+        with self._tlock:
+            mig = dict(self._rebalancing) if self._rebalancing else None
+            table = self._table
+        # members render OUTSIDE _tlock: _members_view re-acquires it
+        # (and polls the heartbeat monitor — no reason to do that under
+        # the table lock anyway)
+        return {"table": table.to_wire(),
+                "members": self._members_view(),
+                "migration": mig}
+
+    def _note_dead_members(self) -> None:
+        """Flight-record each member death ONCE (lazy, on report/table
+        traffic — the coordinator has no poll thread to leak). A dead
+        member is a failover matter for its replica set (PR-4), not a
+        migration source: its keys cannot be streamed off a corpse."""
+        try:
+            dead = set(self.hb.dead())
+        except Exception:
+            return
+        with self._tlock:
+            members = list(self._members)
+        for i, m in enumerate(members):
+            if m.node in dead and m.node not in self._dead_seen:
+                self._dead_seen.add(m.node)
+                obs.record_event("coord_member_dead", shard=i, uri=m.uri)
+                logging.getLogger(__name__).warning(
+                    "member %s (shard %d) stopped heartbeating", m.uri, i)
+
+    # -- rebalance -------------------------------------------------------------
+
+    def table(self) -> ShardTable:
+        with self._tlock:
+            return self._table
+
+    def loads(self) -> Dict[int, int]:
+        with self._tlock:
+            return {i: m.nbytes for i, m in enumerate(self._members)}
+
+    def _maybe_auto_rebalance(self) -> None:
+        with self._tlock:
+            if self._rebalancing is not None:
+                return
+            # skew over the DENSE fleet only: sparse members' byte loads
+            # are not movable mass (their ranges never live-migrate), so
+            # counting them would fire a rebalance that can never help
+            dense = {i: m.nbytes for i, m in enumerate(self._members)
+                     if m.kind != "sparse"}
+            if len(dense) < 2:
+                return
+            if skew(dense) <= self.max_skew:
+                return
+        t = threading.Thread(target=self._auto_rebalance_safe,
+                             daemon=True, name="ps-coord-rebalance")
+        t.start()
+
+    def _auto_rebalance_safe(self) -> None:
+        try:
+            self.rebalance()
+        except Exception:
+            logging.getLogger(__name__).warning(
+                "auto rebalance failed", exc_info=True)
+
+    def rebalance(self, moves=None, targets=None, drain=None) -> dict:
+        """Plan and execute one rebalance; returns a summary dict.
+
+        ``moves``: explicit ``[[donor, recipient, [keys]], ...]``;
+        ``targets``: the shard indices that should serve afterwards
+        (defaults to every registered member not in ``drain``);
+        ``drain``: shard indices to empty AND remove from the table.
+        Each move commits one table epoch; a failed move aborts cleanly
+        (donor keeps its keys, table unchanged) and stops the plan.
+        """
+        with self._tlock:
+            if self._rebalancing is not None:
+                raise RuntimeError("a rebalance is already in flight")
+            table = self._table
+            key_bytes: Dict[str, int] = {}
+            for m in self._members:
+                key_bytes.update(m.key_bytes)
+            sparse = {i for i, m in enumerate(self._members)
+                      if m.kind == "sparse"}
+            if moves is None:
+                drain_set = set(int(d) for d in (drain or []))
+                if drain_set & sparse:
+                    raise RuntimeError(
+                        f"shard(s) {sorted(drain_set & sparse)} are "
+                        f"sparse members — their row ranges do not "
+                        f"live-migrate, so they leave by stopping "
+                        f"(goodbye), not by a key drain")
+                if targets is None:
+                    targets = [i for i in range(len(self._members))
+                               if i not in drain_set and i not in sparse]
+                # plan only over the DENSE fleet: on a shared
+                # coordinator the sparse members' range keys are not
+                # movable mass, and treating them as homeless/donor
+                # would refuse every rebalance
+                plan_assign = {k: s for k, s in table.assign.items()
+                               if s not in sparse}
+                moves = plan_moves(
+                    {k: v for k, v in key_bytes.items()
+                     if k in plan_assign},
+                    plan_assign, [int(t) for t in targets])
+            moves = [(int(d), int(r), [str(k) for k in ks])
+                     for d, r, ks in moves if ks]
+            for d, r, _ks in moves:
+                for side, name in ((d, "donor"), (r, "recipient")):
+                    if (0 <= side < len(self._members)
+                            and self._members[side].kind == "sparse"):
+                        raise RuntimeError(
+                            f"{name} shard {side} is a sparse member — "
+                            f"row ranges do not live-migrate (a range "
+                            f"move would resize serving tables); scale "
+                            f"sparse fleets by checkpoint-restart")
+            self._rebalancing = {"moves": len(moves), "done": 0,
+                                 "keys": sum(len(ks) for _, _, ks in moves)}
+        executed, bytes_moved = [], 0
+        try:
+            for d, r, keys in moves:
+                bytes_moved += self._one_move(d, r, keys, key_bytes)
+                executed.append([d, r, len(keys)])
+                with self._tlock:
+                    self._rebalancing["done"] += 1
+            if drain:
+                self._drop_members(sorted(set(int(x) for x in drain)))
+        finally:
+            with self._tlock:
+                self._rebalancing = None
+        with self._tlock:
+            epoch = self._table.epoch
+        return {"epoch": epoch, "moves": executed,
+                "moved_bytes": bytes_moved}
+
+    def _one_move(self, donor: int, recipient: int, keys: List[str],
+                  key_bytes: Dict[str, int]) -> int:
+        """Drive one donor→recipient move end to end: MIGRATE_OUT to the
+        donor, table install on success. Returns row bytes streamed."""
+        from ps_tpu.backends.common import parse_replica_uri
+
+        with self._tlock:
+            table = self._table
+            if donor == recipient:
+                raise ValueError("donor and recipient are the same shard")
+            for k in keys:
+                if table.assign.get(k) != donor:
+                    raise ValueError(
+                        f"key {k!r} is not owned by donor shard {donor}")
+            donor_uri = table.shards[donor]
+            target_uri = table.shards[recipient]
+            # PROVISIONAL epoch for the donor/recipient stamp: the
+            # COMMITTED epoch is allocated at install time below, so a
+            # concurrent join (which installs its own epoch while this
+            # move streams) can never collide with this move's — table
+            # epochs stay strictly monotonic for every reader
+            stamp_epoch = table.epoch + 1
+        obs.record_event("rebalance_start", donor=donor,
+                         recipient=recipient, keys=len(keys),
+                         epoch=stamp_epoch)
+        host, port = parse_replica_uri(donor_uri)[0][0]
+        t0 = time.monotonic()
+        frame = tv.encode(tv.MIGRATE_OUT, 0, None, extra={
+            "keys": keys, "target": target_uri,
+            "table_epoch": stamp_epoch,
+        })
+
+        def ask():
+            ch = tv.Channel.connect(host, port)
+            try:
+                return tv.decode(ch.request(frame))
+            finally:
+                ch.close()
+
+        with obs.tracer().span("rebalance", cat="coord").set(
+                donor=donor, recipient=recipient, keys=len(keys)):
+            try:
+                try:
+                    kind, _, _, extra = ask()
+                except (tv.VanError, OSError):
+                    # ambiguous: the donor may have cut over and the
+                    # REPLY died on the wire — declaring abort would
+                    # leave the table routing moved keys to a shard that
+                    # evicted them. Re-ask once on a fresh channel:
+                    # MIGRATE_OUT is idempotent at the donor for the
+                    # just-committed move (and simply re-runs a move
+                    # that never committed). A donor that is truly gone
+                    # fails the re-ask too, and the abort stands — a
+                    # commit that died WITH the donor is its replica
+                    # set's failover problem, not a table problem.
+                    kind, _, _, extra = ask()
+                if kind != tv.OK:
+                    raise RuntimeError(
+                        f"donor shard {donor} refused the move: "
+                        f"{extra.get('error')}")
+            except Exception as e:
+                self._m_aborts.inc()
+                obs.record_event("rebalance_abort", donor=donor,
+                                 recipient=recipient, keys=len(keys),
+                                 epoch=stamp_epoch, why=repr(e))
+                raise
+        # committed at the donor+recipient: install the new table at the
+        # NEXT epoch — allocated here, under the lock, so it is strictly
+        # above whatever membership installed while the move streamed
+        with self._tlock:
+            new_epoch = self._table.epoch + 1
+            assign = dict(self._table.assign)
+            for k in keys:
+                assign[k] = recipient
+            self._table = ShardTable(new_epoch, self._table.shards, assign)
+            for k in keys:
+                b = self._members[donor].key_bytes.pop(k, key_bytes.get(k, 0))
+                self._members[recipient].key_bytes[k] = b
+            self.moves_done += 1
+        dt = time.monotonic() - t0
+        rbytes = int(extra.get("bytes", 0))
+        self._m_moves.inc()
+        self._m_keys.inc(len(keys))
+        self._m_bytes.inc(rbytes)
+        obs.record_event("rebalance_commit", donor=donor,
+                         recipient=recipient, keys=len(keys),
+                         epoch=new_epoch, bytes=rbytes,
+                         rows=int(extra.get("rows", 0)),
+                         donor_seconds=extra.get("seconds"),
+                         seconds=round(dt, 4))
+        logging.getLogger(__name__).info(
+            "rebalance committed: %d key(s) shard %d -> %d "
+            "(epoch %d, %.1f MB in %.2fs)", len(keys), donor, recipient,
+            new_epoch, rbytes / 1e6, dt,
+        )
+        return rbytes
+
+    def _drop_members(self, drained: List[int]) -> None:
+        """Remove now-empty drained members and renumber the table (one
+        more epoch). Refuses to drop a member that still owns keys."""
+        with self._tlock:
+            table = self._table
+            for d in drained:
+                owned = table.keys_of(d)
+                if owned:
+                    raise RuntimeError(
+                        f"shard {d} still owns {len(owned)} key(s) — "
+                        f"drain moves them first")
+            keep = [i for i in range(len(self._members)) if i not in drained]
+            remap = {old: new for new, old in enumerate(keep)}
+            self._members = [self._members[i] for i in keep]
+            self._table = ShardTable(
+                table.epoch + 1,
+                [table.shards[i] for i in keep],
+                {k: remap[s] for k, s in table.assign.items()},
+            )
+            epoch = self._table.epoch
+        obs.record_event("coord_drain", shards=drained, epoch=epoch)

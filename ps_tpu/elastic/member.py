@@ -1,0 +1,162 @@
+"""Member-side coordinator plumbing: join, beat, report, fetch.
+
+Everything a server or worker needs to participate in elastic membership
+without the coordinator ever being on its data path:
+
+- :class:`CoordinatorMember` — a serving shard's registration: one
+  ``COORD_HELLO`` (advertising the shard's URI and per-key byte sizes),
+  a :class:`~ps_tpu.control.heartbeat.HeartbeatClient` beating the
+  coordinator's monitor from a C++ thread, and a daemon reporter sending
+  ``COORD_REPORT`` load frames on the coordinator's cadence. ``close
+  (goodbye=True)`` announces a clean leave so the membership view shows
+  *left*, never an eventual *dead*.
+- :func:`fetch_table` — one ``COORD_TABLE`` round trip (workers poll it
+  until the table covers their parameter keys, and again whenever a
+  stale-table refusal tells them the assignment moved).
+- :func:`request_rebalance` — the operator/bench entry point for
+  ``COORD_REBALANCE``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Callable, Dict, Optional, Tuple, Union
+
+from ps_tpu.control import tensor_van as tv
+from ps_tpu.elastic.table import ShardTable
+
+__all__ = ["CoordinatorMember", "fetch_table", "fetch_view",
+           "request_rebalance", "parse_coord"]
+
+
+def parse_coord(addr: Union[str, Tuple[str, int]]) -> Tuple[str, int]:
+    """``"host:port"`` (or an ``(host, port)`` pair) → ``(host, port)``."""
+    if isinstance(addr, str):
+        host, port = addr.rsplit(":", 1)
+        return host, int(port)
+    host, port = addr
+    return str(host), int(port)
+
+
+def _coord_request(addr, kind: int, extra: Optional[dict] = None,
+                   timeout_ms: int = 5000) -> dict:
+    host, port = parse_coord(addr)
+    ch = tv.Channel.connect(host, port, timeout_ms=timeout_ms)
+    try:
+        k, _, _, out = tv.decode(ch.request(tv.encode(kind, 0, None,
+                                                      extra=extra)))
+    finally:
+        ch.close()
+    if k != tv.OK:
+        raise RuntimeError(f"coordinator {host}:{port} refused "
+                           f"{tv.kind_name(kind)}: {out.get('error')}")
+    return out
+
+
+def fetch_view(addr, timeout_ms: int = 5000) -> dict:
+    """The coordinator's full COORD_TABLE reply: wire table + the
+    membership/liveness rows + migration progress (ps_top's view)."""
+    return _coord_request(addr, tv.COORD_TABLE, timeout_ms=timeout_ms)
+
+
+def fetch_table(addr, cover=None, min_epoch: Optional[int] = None,
+                timeout: float = 30.0) -> ShardTable:
+    """Fetch the current shard table, polling until it covers ``cover``
+    (a key iterable — joining workers wait for every server to register)
+    and/or its epoch exceeds ``min_epoch`` (re-routing workers wait for
+    the move they were refused over to actually commit)."""
+    deadline = time.monotonic() + timeout
+    want = set(cover) if cover is not None else None
+    last = None
+    while True:
+        # lean reply: table only — this poll runs at join/re-route time
+        # from every worker at once, and the full view (per-member
+        # liveness = native heartbeat calls per poll) is ps_top's need,
+        # not this one's
+        extra = {"lean": True}
+        view = _coord_request(addr, tv.COORD_TABLE, extra=extra)
+        table = ShardTable.from_wire(view["table"])
+        ok = want is None or table.covers(want)
+        if ok and (min_epoch is None or table.epoch > min_epoch):
+            return table
+        last = table
+        if time.monotonic() >= deadline:
+            missing = sorted(want - set(table.assign))[:3] if want else []
+            raise TimeoutError(
+                f"coordinator table never became usable within {timeout}s "
+                f"(epoch {last.epoch}, need > {min_epoch}; "
+                f"missing keys {missing})"
+            )
+        time.sleep(0.05)
+
+
+def request_rebalance(addr, moves=None, targets=None, drain=None,
+                      timeout_ms: int = 600_000) -> dict:
+    """Ask the coordinator to rebalance (explicit ``moves``, a ``targets``
+    member set, or a ``drain`` list); blocks until the table committed.
+    The bench and the CI smoke drive their mid-traffic splits through
+    this — the same frames an operator's tooling would send."""
+    extra: Dict[str, object] = {}
+    if moves is not None:
+        extra["moves"] = [[int(d), int(r), [str(k) for k in ks]]
+                          for d, r, ks in moves]
+    if targets is not None:
+        extra["targets"] = [int(t) for t in targets]
+    if drain is not None:
+        extra["drain"] = [int(d) for d in drain]
+    return _coord_request(addr, tv.COORD_REBALANCE, extra=extra,
+                          timeout_ms=timeout_ms)
+
+
+class CoordinatorMember:
+    """One serving shard's standing with the coordinator."""
+
+    def __init__(self, coord: Union[str, Tuple[str, int]], uri: str,
+                 key_bytes: Dict[str, int], kind: str = "dense",
+                 report: Optional[Callable[[], dict]] = None,
+                 report_ms: Optional[int] = None):
+        from ps_tpu.control.heartbeat import HeartbeatClient
+
+        self.coord = parse_coord(coord)
+        self.uri = uri
+        extra = {
+            "role": "server", "uri": uri, "kind": kind,
+            "key_bytes": {k: int(v) for k, v in key_bytes.items()},
+        }
+        extra = _coord_request(self.coord, tv.COORD_HELLO, extra=extra)
+        self.node = int(extra["node"])
+        self.table = ShardTable.from_wire(extra["table"])
+        self._report_fn = report
+        self._report_ms = int(report_ms if report_ms is not None
+                              else extra.get("report_ms", 1000))
+        self._hb = HeartbeatClient(self.coord[0], int(extra["hb_port"]),
+                                   node_id=self.node)
+        self._stop = threading.Event()
+        self._t: Optional[threading.Thread] = None
+        if report is not None:
+            self._t = threading.Thread(target=self._report_loop,
+                                       daemon=True,
+                                       name="ps-coord-report")
+            self._t.start()
+
+    def _report_loop(self) -> None:
+        while not self._stop.wait(self._report_ms / 1e3):
+            try:
+                extra = dict(self._report_fn() or {})
+                extra["uri"] = self.uri
+                _coord_request(self.coord, tv.COORD_REPORT, extra=extra)
+            except Exception:
+                # a dead coordinator must never take a serving shard's
+                # reporter thread down with a crash loop — log once per
+                # failure at debug and keep trying (joins/rebalances are
+                # what a dead coordinator actually costs)
+                logging.getLogger(__name__).debug(
+                    "load report to coordinator failed", exc_info=True)
+
+    def close(self, goodbye: bool = True) -> None:
+        self._stop.set()
+        if self._t is not None:
+            self._t.join(timeout=5)
+        self._hb.close(goodbye=goodbye)

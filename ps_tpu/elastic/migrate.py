@@ -1,0 +1,240 @@
+"""Live key-range migration: the donor→recipient row stream.
+
+A rebalance moves a set of keys from one serving shard to another WITHOUT
+pausing the job: the donor snapshots the moving rows under its apply lock,
+streams them to the recipient over one van channel (sequenced entries with
+per-entry acks — the exact machinery the PR-4 replica stream proved), and
+keeps DOUBLE-WRITING while traffic continues: every commit that touches a
+moving key re-publishes that key's post-apply state, so later rows
+supersede earlier ones and the recipient converges on the donor's live
+state. A row is the WHOLE ownership unit: parameter bytes, per-key
+optimizer state, and every worker's stale snapshot travel together —
+promotion-grade state, not just weights.
+
+The cutover is a bounded stop-and-copy: the donor freezes applies (its
+apply lock), drains the residual ack window, sends ``MIGRATE_COMMIT``
+(the recipient installs the staged rows and starts serving), evicts the
+keys, and releases the lock. The freeze costs residual-lag + one round
+trip — the worker-visible p99 disturbance ``bench.py --model rebalance``
+measures. Failure anywhere before the commit aborts cleanly: the donor
+keeps serving every key, the recipient discards the staged range, and the
+table epoch never moves.
+
+Exactly-once across the handoff: the commit carries the donor's
+per-worker (nonce, seq) dedup tokens, so a push applied at the donor and
+replayed at the recipient after the cutover (its re-split retry) is acked
+WITHOUT re-applying — the moved state already contains it.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Dict, Optional
+
+from ps_tpu.control import tensor_van as tv
+from ps_tpu.replica.log import ReplicationLog
+
+__all__ = ["MigrationError", "MigrationSession",
+           "encode_row", "decode_row"]
+
+
+class MigrationError(RuntimeError):
+    """The migration stream could not attach, broke mid-move, or was
+    refused at commit — the move aborts and the donor keeps its keys."""
+
+
+def encode_row(key: str, param, state_kv: Dict[str, object],
+               stale: Dict[int, object], apply_count: int):
+    """One row's wire form: ``(tensors, extra)``. Tensor names are
+    prefixed (``param`` / ``s:<leaf>`` / ``w:<worker>``) so the flat
+    frame codec carries the three groups without a nested structure;
+    ``extra["state_keys"]`` preserves the optimizer-state flatten order
+    the recipient rebuilds against its fresh-init structure."""
+    tensors = {"param": param}
+    for sk, v in state_kv.items():
+        tensors[f"s:{sk}"] = v
+    for w, v in stale.items():
+        tensors[f"w:{w}"] = v
+    extra = {"key": key, "state_keys": list(state_kv),
+             "apply_count": int(apply_count)}
+    return tensors, extra
+
+
+def decode_row(tensors, extra) -> dict:
+    """Inverse of :func:`encode_row`; arrays are COPIED out of the frame
+    (the staged row outlives the request buffer)."""
+    import numpy as np
+
+    param = None
+    state: Dict[str, object] = {}
+    stale: Dict[int, object] = {}
+    for name, v in tensors.items():
+        if name == "param":
+            param = np.array(v)
+        elif name.startswith("s:"):
+            state[name[2:]] = np.array(v)
+        elif name.startswith("w:"):
+            stale[int(name[2:])] = np.array(v)
+    return {"key": str(extra["key"]), "param": param, "state": state,
+            "state_keys": list(extra.get("state_keys") or []),
+            "stale": stale, "apply_count": int(extra.get("apply_count", 0))}
+
+
+class MigrationSession:
+    """Donor side of one key-range move: channel + sender thread + the
+    sequenced row log. Mirrors :class:`~ps_tpu.replica.session.
+    BackupSession`'s failure policy — a dead/refusing/stalled recipient
+    marks the session degraded and wakes every waiter, so a migration can
+    only ever ABORT, never wedge the donor's apply path."""
+
+    def __init__(self, host: str, port: int, begin_extra: dict,
+                 stats=None, window: int = 64,
+                 connect_timeout_ms: int = 10_000,
+                 stall_timeout: float = 30.0):
+        self.addr = (host, int(port))
+        self.stats = stats
+        self.stall_timeout = float(stall_timeout)
+        self.log = ReplicationLog(window=window, stall_timeout=stall_timeout)
+        self.rows_sent = 0
+        self.bytes_sent = 0
+        self._ch = tv.Channel.connect(host, port,
+                                      timeout_ms=connect_timeout_ms)
+        kind, _, _, extra = tv.decode(self._ch.request(
+            tv.encode(tv.MIGRATE_BEGIN, 0, None, extra=begin_extra)
+        ))
+        if kind != tv.OK:
+            self._ch.close()
+            raise MigrationError(
+                f"recipient {host}:{port} refused the migration stream: "
+                f"{extra.get('error')}"
+            )
+        self._closed = False
+        self._t = threading.Thread(target=self._loop, daemon=True,
+                                   name="ps-migrate-send")
+        self._t.start()
+
+    # -- donor-side API --------------------------------------------------------
+
+    @property
+    def degraded(self) -> bool:
+        return self.log.dead
+
+    @property
+    def lag(self) -> int:
+        return self.log.lag
+
+    def publish_row(self, key: str, tensors: Dict, meta: dict) -> int:
+        """Append one row (call under the donor's apply lock — row order
+        must follow engine order so later rows supersede earlier ones).
+        Blocks when the ack window is full; returns the entry's seq."""
+        return self.log.append("row", 0, tensors, dict(meta, key=key))
+
+    def wait_drained(self, timeout: Optional[float] = None) -> bool:
+        """Block until every published row is acked (False on degrade or
+        timeout — the caller aborts the move)."""
+        with self.log._cond:
+            target = self.log.next_seq - 1
+        if target <= 0:
+            return not self.log.dead
+        return self.log.wait_acked(target, self.stall_timeout
+                                   if timeout is None else timeout)
+
+    def quiesce(self) -> None:
+        """Stop the sender thread (call only after :meth:`wait_drained`):
+        the channel then has exactly one driving thread again — the
+        caller's — for the final commit/abort request."""
+        self._closed = True
+        self.log.mark_dead("quiesced for commit")
+        self._t.join(timeout=10)
+
+    def commit(self, extra: dict) -> dict:
+        """The cutover request (call after :meth:`quiesce`, with the
+        donor's apply lock held so no commit can race the ownership flip).
+        Returns the recipient's reply extra; raises on refusal.
+
+        A connection death here is AMBIGUOUS: the recipient may have
+        installed the rows and the REPLY died — treating that as an abort
+        would leave both shards owning the range (the donor keeps its
+        keys while the recipient serves them too, and every later push to
+        the recipient refuses). So the request is re-asked once on a
+        fresh channel; ``_migrate_commit`` is idempotent for a
+        just-committed range (the commit ``extra`` carries the key list),
+        so the retry resolves the ambiguity either way."""
+        frame = tv.encode(tv.MIGRATE_COMMIT, 0, None, extra=extra)
+        try:
+            kind, _, _, rx = tv.decode(self._ch.request(frame))
+        except (tv.VanError, OSError) as e:
+            try:
+                ch2 = tv.Channel.connect(*self.addr, timeout_ms=10_000)
+                try:
+                    kind, _, _, rx = tv.decode(ch2.request(frame))
+                finally:
+                    ch2.close()
+            except (tv.VanError, OSError) as e2:
+                raise MigrationError(
+                    f"migration commit to {self.addr[0]}:{self.addr[1]} "
+                    f"died and the re-ask failed too ({e2!r}); original: "
+                    f"{e!r}"
+                ) from e2
+        if kind != tv.OK:
+            raise MigrationError(
+                f"recipient {self.addr[0]}:{self.addr[1]} refused the "
+                f"migration commit: {rx.get('error')}"
+            )
+        return rx
+
+    def abort(self) -> None:
+        """Best-effort: tell the recipient to discard the staged range
+        (it may already be dead — that is usually why we are aborting)."""
+        self._closed = True
+        self.log.mark_dead("migration aborted")
+        self._t.join(timeout=10)
+        try:
+            self._ch.request(tv.encode(tv.MIGRATE_ABORT, 0, None))
+        except (tv.VanError, OSError):
+            pass
+        self._ch.close()
+
+    def close(self) -> None:
+        self._closed = True
+        self.log.mark_dead("session closed")
+        self._t.join(timeout=10)
+        self._ch.close()
+
+    # -- sender thread ---------------------------------------------------------
+
+    def _loop(self) -> None:
+        while not self._closed and not self.log.dead:
+            entry = self.log.take(timeout=0.2)
+            if entry is None:
+                continue
+            seq, _op, _w, tensors, meta = entry
+            try:
+                header, chunks = tv.encode_parts(
+                    tv.MIGRATE_ROW, 0, tensors, dict(meta, seq=seq))
+                reply = self._ch.request_parts(header, chunks)
+                kind, _, _, extra = tv.decode(reply)
+            except tv.VanError as e:
+                self._degrade(f"recipient connection failed: {e}")
+                return
+            except Exception as e:  # noqa: BLE001 — a silent sender death
+                # would leave wait_drained blocked until the stall timeout
+                self._degrade(f"migration sender failed: {e!r}")
+                return
+            if kind != tv.OK:
+                self._degrade(f"recipient refused row seq {seq}: "
+                              f"{extra.get('error')}")
+                return
+            self.log.ack(int(extra.get("applied_seq", seq)))
+            self.rows_sent += 1
+            self.bytes_sent += len(header) + sum(len(c) for c in chunks)
+
+    def _degrade(self, why: str) -> None:
+        if not self.log.dead:
+            logging.getLogger(__name__).warning(
+                "migration to %s:%d degraded — the move will abort: %s",
+                *self.addr, why
+            )
+        self.log.mark_dead(why)
+        self._ch.close()

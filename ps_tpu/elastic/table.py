@@ -1,0 +1,176 @@
+"""The versioned shard table — elastic membership's one source of truth.
+
+The static topology this repo started from fixes key→server assignment at
+boot (``shard_for_key(key, N)`` hashed over a URI list every process was
+launched with). Elastic membership replaces that with an EXPLICIT,
+epoch-versioned assignment owned by the coordinator
+(:mod:`ps_tpu.elastic.coordinator`): ``shards`` is the live member list
+(each entry the replica-set URI workers dial, ``"h:p"`` or ``"h:p|b:q"``),
+``assign`` maps every parameter key to its owning shard index, and
+``epoch`` advances once per committed change (a join that adds keys, a
+migration commit, a drain). Workers treat a refusal carrying a higher
+table epoch as "re-fetch and re-route", exactly like the PR-4 stale-epoch
+path — the table IS the fencing token of the key→shard mapping.
+
+The initial table is DESCRIPTIVE: servers register with the key ranges
+they were launched with (typically the classic ``shard_for_key`` split,
+so existing launchers keep working) and the coordinator records them.
+Every later change is PRESCRIPTIVE: the coordinator plans moves
+(:func:`plan_moves`) and drives the donor shards' live migrations.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+
+class ShardTable:
+    """One immutable-by-convention snapshot of the key→shard assignment.
+
+    Wire form (:meth:`to_wire`/:meth:`from_wire`) is a plain json dict so
+    the table rides the van's ``extra`` header unchanged. Instances are
+    replaced wholesale on change (never mutated in place) so concurrent
+    readers always observe a consistent epoch/assignment pair.
+    """
+
+    def __init__(self, epoch: int, shards: Sequence[str],
+                 assign: Dict[str, int]):
+        self.epoch = int(epoch)
+        self.shards = list(shards)
+        self.assign = dict(assign)
+        for k, s in self.assign.items():
+            if not (0 <= int(s) < len(self.shards)):
+                raise ValueError(
+                    f"table assigns key {k!r} to shard {s} but only "
+                    f"{len(self.shards)} shard(s) are registered"
+                )
+
+    # -- wire ------------------------------------------------------------------
+
+    def to_wire(self) -> dict:
+        return {"epoch": self.epoch, "shards": list(self.shards),
+                "assign": dict(self.assign)}
+
+    @classmethod
+    def from_wire(cls, d: dict) -> "ShardTable":
+        return cls(int(d["epoch"]), list(d["shards"]),
+                   {k: int(v) for k, v in d["assign"].items()})
+
+    # -- views -----------------------------------------------------------------
+
+    def keys_of(self, shard: int) -> List[str]:
+        return sorted(k for k, s in self.assign.items() if s == int(shard))
+
+    def owner_map(self) -> Dict[str, int]:
+        return dict(self.assign)
+
+    def addrs(self) -> List[Tuple[str, int]]:
+        """Primary (preferred) address per shard, for worker dials."""
+        from ps_tpu.backends.common import parse_replica_uri
+
+        primaries, _ = parse_replica_uri(",".join(self.shards))
+        return primaries
+
+    def replica_sets(self) -> List[List[Tuple[str, int]]]:
+        from ps_tpu.backends.common import parse_replica_uri
+
+        _, sets = parse_replica_uri(",".join(self.shards))
+        return sets
+
+    def covers(self, keys) -> bool:
+        """True when every key in ``keys`` has an assignment — what a
+        worker waits for before its first connect (servers may still be
+        registering)."""
+        return all(k in self.assign for k in keys)
+
+    def __repr__(self) -> str:
+        per = [sum(1 for s in self.assign.values() if s == i)
+               for i in range(len(self.shards))]
+        return (f"ShardTable(epoch={self.epoch}, shards={len(self.shards)}, "
+                f"keys/shard={per})")
+
+
+#: one planned move: (donor shard index, recipient shard index, keys)
+Move = Tuple[int, int, List[str]]
+
+
+def plan_moves(key_bytes: Dict[str, int], assign: Dict[str, int],
+               targets: Sequence[int],
+               max_moves: Optional[int] = None) -> List[Move]:
+    """Plan key moves that balance bytes across ``targets`` while moving
+    as little as possible.
+
+    ``key_bytes`` sizes every key; ``assign`` is the current key→shard
+    map; ``targets`` names the shards that should serve AFTER the
+    rebalance (a shard in ``assign`` but not in ``targets`` is being
+    DRAINED — every one of its keys moves). Greedy: drained keys first,
+    then keys peel off the most-loaded shard onto the least-loaded one,
+    largest key first, while the transfer strictly reduces the load gap.
+    Deterministic (ties broken by key name) so the coordinator's decision
+    is reproducible in tests and post-incident reads of the flight log.
+    """
+    targets = sorted(set(int(t) for t in targets))
+    if not targets:
+        raise ValueError("plan_moves needs at least one target shard")
+    load: Dict[int, int] = {t: 0 for t in targets}
+    homeless: List[str] = []  # keys on drained shards
+    for k, s in assign.items():
+        if s in load:
+            load[s] += key_bytes.get(k, 0)
+        else:
+            homeless.append(k)
+    moves: Dict[Tuple[int, int], List[str]] = {}
+
+    def lightest() -> int:
+        return min(targets, key=lambda t: (load[t], t))
+
+    # drained shards: every key must land somewhere — biggest first onto
+    # the currently lightest target
+    for k in sorted(homeless, key=lambda k: (-key_bytes.get(k, 0), k)):
+        t = lightest()
+        moves.setdefault((assign[k], t), []).append(k)
+        load[t] += key_bytes.get(k, 0)
+    # balance the rest: move a key from the heaviest to the lightest
+    # while that strictly shrinks the gap
+    if len(targets) > 1:
+        by_shard: Dict[int, List[str]] = {t: [] for t in targets}
+        for k, s in assign.items():
+            if s in by_shard:
+                by_shard[s].append(k)
+        for s in by_shard:
+            by_shard[s].sort(key=lambda k: (-key_bytes.get(k, 0), k))
+        budget = max_moves if max_moves is not None else len(assign)
+        n = 0
+        while n < budget:
+            hi = max(targets, key=lambda t: (load[t], -t))
+            lo = lightest()
+            gap = load[hi] - load[lo]
+            moved = False
+            for i, k in enumerate(by_shard[hi]):
+                b = key_bytes.get(k, 0)
+                # after the move the gap becomes |gap - 2b|
+                if abs(gap - 2 * b) < gap:
+                    moves.setdefault((hi, lo), []).append(k)
+                    load[hi] -= b
+                    load[lo] += b
+                    del by_shard[hi][i]
+                    by_shard[lo].append(k)
+                    moved = True
+                    n += 1
+                    break
+            if not moved:
+                break
+    return [(d, r, sorted(ks)) for (d, r), ks in sorted(moves.items())]
+
+
+def skew(loads: Dict[int, int]) -> float:
+    """max/min byte load across serving shards (inf when any shard is
+    empty but others are not) — what the auto-rebalance knob compares
+    against ``rebalance_max_skew``."""
+    vals = [v for v in loads.values()]
+    if not vals or max(vals) == 0:
+        return 1.0
+    lo = min(vals)
+    if lo == 0:
+        return float("inf")
+    return max(vals) / lo

@@ -181,6 +181,21 @@ uint64_t hb_server_seq(void* h, uint32_t node_id) {
   return it == s->last_seq.end() ? 0 : it->second;
 }
 
+// Milliseconds since node_id's last beat (a goodbye refreshes last_seen
+// too, so a just-left node ages from its goodbye); -1 = never seen. The
+// coordinator's membership view (ps_tpu/elastic) and ps_top render this
+// as the per-peer "beat age" column.
+int64_t hb_server_age_ms(void* h, uint32_t node_id) {
+  auto* s = static_cast<Server*>(h);
+  auto now = Clock::now();
+  std::lock_guard<std::mutex> lock(s->mu);
+  auto it = s->last_seen.find(node_id);
+  if (it == s->last_seen.end()) return -1;
+  return std::chrono::duration_cast<std::chrono::milliseconds>(
+             now - it->second)
+      .count();
+}
+
 void hb_server_stop(void* h) {
   auto* s = static_cast<Server*>(h);
   s->stop.store(true);

@@ -138,6 +138,12 @@ class TransportStats:
         self.dedup_hits = 0
         self.failovers = 0
         self.failover_s = 0.0
+        # elastic membership (ps_tpu/elastic): worker-side table re-routes
+        # — a shard refused with "key range moved", the worker re-fetched
+        # the shard table and re-split. Counted apart from failovers
+        # because the remedy (and the health signal) differ: a re-route
+        # is a planned rebalance doing its job, a failover is a death.
+        self.table_reroutes = 0
 
     def record_vec_send(self, nbytes: int) -> None:
         """One vectored (scatter-gather) send: ``nbytes`` of tensor payload
@@ -206,6 +212,12 @@ class TransportStats:
         a replayed in-flight push applied exactly once under failover."""
         with self._lock:
             self.dedup_hits += 1
+
+    def record_table_reroute(self) -> None:
+        """One worker-side shard-table refresh + re-route (a live
+        rebalance moved keys under this worker — ps_tpu/elastic)."""
+        with self._lock:
+            self.table_reroutes += 1
 
     def record_failover(self, seconds: float) -> None:
         """One worker-side shard re-route to a promoted replica."""
@@ -296,7 +308,8 @@ class TransportStats:
                     self.pool_hits, self.pool_misses,
                     self.repl_entries, self.repl_bytes,
                     self.repl_ack_wait_s, self.dedup_hits,
-                    self.failovers, self.failover_s)
+                    self.failovers, self.failover_s,
+                    self.table_reroutes)
 
     def summary(self, since: Optional[tuple] = None) -> Dict[str, float]:
         now = self.snapshot()
@@ -355,6 +368,8 @@ class TransportStats:
         if d[24] > 0:
             out["failovers"] = int(d[24])
             out["failover_s"] = round(d[25], 4)
+        if d[26] > 0:
+            out["table_reroutes"] = int(d[26])
         # latency DISTRIBUTIONS (ps_tpu/obs): quantiles of everything the
         # histograms saw — lifetime, not interval (a p99 over an interval
         # delta of log buckets is computable but the lifetime tail is

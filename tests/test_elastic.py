@@ -1,0 +1,1114 @@
+"""Elastic membership (ps_tpu/elastic) — coordinator + live rebalancing.
+
+The fixed-at-boot shard set becomes a resizable fleet: a coordinator role
+owns the authoritative, epoch-versioned shard table; servers register and
+report load; workers fetch the table and re-route live when a rebalance
+moves keys. This file covers the subsystem in-process:
+
+- ShardTable wire roundtrip/validation, plan_moves (drain-first greedy,
+  deterministic), and the skew signal;
+- HeartbeatServer.state() as a whole-monitor view with per-peer last-beat
+  ages (the coordinator's liveness view rides the PR-4 detector);
+- membership: join/report/liveness rows, unique-ownership refusal, clean
+  goodbye vs silent death;
+- the live migration: scale 2→4 (split) and 4→2 (drain) under a
+  concurrent pusher with per-key exactly-once accounting, plus MNIST-MLP
+  loss parity (momentum optimizer — state travels with the row) against
+  an unrebalanced reference;
+- exactly-once across the handoff: transferred dedup tokens ack a
+  replayed pre-move push at the recipient WITHOUT re-applying, and the
+  donor's post-move refusal is the typed re-route (never a KeyError);
+- an aborted move: table unchanged, donor intact, rebalance_start/abort
+  flight events recorded, mirrored as ps_event_* counters, and dumped;
+- sparse members: membership + topology discovery via the coordinator,
+  range moves refused with the typed message;
+- the static fallback: no coordinator configured = today's behavior,
+  and a moved refusal surfaces hard with the pointer to PS_COORD_URI;
+- Config knobs (coord_uri / rebalance_*) and their PS_* env mirrors;
+- ps_top --coord: the membership/table/migration view renders.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import ps_tpu as ps
+from ps_tpu import obs
+from ps_tpu.backends.common import TableMovedError
+from ps_tpu.backends.remote_async import AsyncPSService, connect_async
+from ps_tpu.backends.remote_sparse import (
+    SparsePSService,
+    connect_sparse,
+    row_range,
+)
+from ps_tpu.config import Config
+from ps_tpu.control import tensor_van as tv
+from ps_tpu.control.heartbeat import HeartbeatClient, HeartbeatServer
+from ps_tpu.elastic import (
+    Coordinator,
+    ShardTable,
+    fetch_table,
+    fetch_view,
+    plan_moves,
+    request_rebalance,
+    skew,
+)
+from ps_tpu.kv import keys as keymod
+from ps_tpu.kv.sparse import SparseEmbedding
+
+
+def _params(n=8, seed=0, shape=(16, 8)):
+    rng = np.random.default_rng(seed)
+    return {f"p{i}/w": jnp.asarray(rng.normal(0, 1, shape).astype(np.float32))
+            for i in range(n)}
+
+
+def _mkstore(params, lr=0.1, optimizer="sgd"):
+    st = ps.KVStore(optimizer=optimizer, learning_rate=lr, mode="async")
+    st.init(params)
+    return st
+
+
+def _subset(params, keys):
+    return {k: params[k] for k in keys}
+
+
+@pytest.fixture
+def tpu_async(request):
+    ps.init(backend="tpu", mode="async", num_workers=1, dc_lambda=0.0)
+    request.addfinalizer(ps.shutdown)
+
+
+# -- ShardTable / plan_moves / skew -------------------------------------------
+
+
+def test_shard_table_wire_roundtrip_and_validation():
+    t = ShardTable(3, ["h0:1", "h1:2"], {"a": 0, "b": 1, "c": 1})
+    t2 = ShardTable.from_wire(t.to_wire())
+    assert (t2.epoch, t2.shards, t2.assign) == (3, t.shards, t.assign)
+    assert t.keys_of(1) == ["b", "c"]
+    assert t.covers(["a", "b"]) and not t.covers(["a", "z"])
+    assert t.addrs() == [("h0", 1), ("h1", 2)]
+    with pytest.raises(ValueError, match="only 1 shard"):
+        ShardTable(0, ["h0:1"], {"a": 1})
+
+
+def test_plan_moves_drains_first_then_balances_deterministically():
+    key_bytes = {"a": 100, "b": 100, "c": 100, "d": 100, "e": 50}
+    assign = {"a": 0, "b": 0, "c": 1, "d": 1, "e": 2}
+    # shard 2 is being drained: 'e' MUST move; 0 and 1 are balanced
+    moves = plan_moves(key_bytes, assign, targets=[0, 1])
+    flat = {k: r for _d, r, ks in moves for k in ks}
+    assert "e" in flat and flat["e"] in (0, 1)
+    # deterministic: the same inputs plan the same moves
+    assert moves == plan_moves(key_bytes, assign, targets=[0, 1])
+    # pure balance: everything on shard 0, split over 0 and 1
+    moves = plan_moves({"a": 4, "b": 4, "c": 4, "d": 4},
+                       {"a": 0, "b": 0, "c": 0, "d": 0}, targets=[0, 1])
+    moved = [k for _d, _r, ks in moves for k in ks]
+    assert len(moved) == 2  # half the bytes peel off
+
+
+def test_skew_signal():
+    assert skew({0: 100, 1: 100}) == 1.0
+    assert skew({0: 300, 1: 100}) == 3.0
+    assert skew({0: 100, 1: 0}) == float("inf")
+    assert skew({}) == 1.0
+
+
+# -- heartbeat: the whole-monitor view with per-peer ages ---------------------
+
+
+def test_heartbeat_state_view_exposes_last_beat_ages():
+    srv = HeartbeatServer(port=0, timeout_ms=30_000)
+    c1 = HeartbeatClient("127.0.0.1", srv.port, node_id=1, interval_ms=20)
+    c2 = HeartbeatClient("127.0.0.1", srv.port, node_id=2, interval_ms=20)
+    try:
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            view = srv.state()
+            if {1, 2} <= set(view):
+                break
+            time.sleep(0.02)
+        view = srv.state()
+        assert view[1]["state"] == "alive" and view[2]["state"] == "alive"
+        for n in (1, 2):
+            assert view[n]["seq"] >= 1
+            assert isinstance(view[n]["age_ms"], int)
+            assert 0 <= view[n]["age_ms"] < 30_000
+        # per-node form still answers, and an unseen node reads as such
+        assert srv.state(1) == "alive"
+        assert srv.state(99) == "unseen"
+        assert srv.age_ms(99) is None
+        # a clean goodbye flips the state but keeps the node in the view
+        c1.close(goodbye=True)
+        deadline = time.monotonic() + 5
+        while srv.state(1) != "left" and time.monotonic() < deadline:
+            time.sleep(0.02)
+        view = srv.state()
+        assert view[1]["state"] == "left"
+        assert view[2]["state"] == "alive"
+    finally:
+        c2.close(goodbye=False)
+        srv.close()
+
+
+# -- membership ---------------------------------------------------------------
+
+
+def test_coordinator_join_report_and_liveness_view(tpu_async):
+    params = _params()
+    keys = sorted(params)
+    coord = Coordinator(bind="127.0.0.1")
+    ca = f"127.0.0.1:{coord.port}"
+    s0 = AsyncPSService(_mkstore(_subset(params, keys[:4])),
+                        bind="127.0.0.1", coordinator=ca)
+    s1 = AsyncPSService(_mkstore(_subset(params, keys[4:])),
+                        bind="127.0.0.1", coordinator=ca)
+    try:
+        table = coord.table()
+        assert table.epoch == 2 and len(table.shards) == 2
+        assert table.keys_of(0) == keys[:4] and table.keys_of(1) == keys[4:]
+        # the registered load reporters feed the view on their cadence
+        deadline = time.monotonic() + 10
+        view = None
+        while time.monotonic() < deadline:
+            view = fetch_view(ca)
+            ms = view["members"]
+            if all(m["report"].get("keys") is not None for m in ms) \
+                    and all(m["hb_state"] == "alive" for m in ms):
+                break
+            time.sleep(0.05)
+        ms = view["members"]
+        assert [m["shard"] for m in ms] == [0, 1]
+        assert all(m["kind"] == "dense" for m in ms)
+        assert all(m["hb_state"] == "alive" for m in ms)
+        assert all(isinstance(m["hb_age_ms"], int) for m in ms)
+        assert all(m["report"]["keys"] == 4 for m in ms)
+        assert all(m["nbytes"] > 0 for m in ms)
+        # fetch_table covers/min_epoch semantics
+        t = fetch_table(ca, cover=keys)
+        assert t.covers(keys)
+        with pytest.raises(TimeoutError):
+            fetch_table(ca, min_epoch=t.epoch, timeout=0.3)
+        # a clean stop is a goodbye: the membership view shows 'left'
+        s1.stop()
+        deadline = time.monotonic() + 5
+        while time.monotonic() < deadline:
+            ms = fetch_view(ca)["members"]
+            if ms[1]["hb_state"] == "left":
+                break
+            time.sleep(0.05)
+        assert ms[1]["hb_state"] == "left"
+    finally:
+        s0.stop()
+        s1.stop()
+        coord.stop()
+
+
+def test_join_refuses_already_claimed_keys(tpu_async):
+    params = _params(n=4)
+    coord = Coordinator(bind="127.0.0.1")
+    ca = f"127.0.0.1:{coord.port}"
+    s0 = AsyncPSService(_mkstore(params), bind="127.0.0.1", coordinator=ca)
+    try:
+        with pytest.raises(RuntimeError, match="already assigned"):
+            AsyncPSService(_mkstore(params), bind="127.0.0.1",
+                           coordinator=ca)
+        # the refused join left no member behind
+        assert len(coord.table().shards) == 1
+    finally:
+        s0.stop()
+        coord.stop()
+
+
+def test_worker_joins_via_coordinator_and_trains(tpu_async):
+    params = _params()
+    keys = sorted(params)
+    coord = Coordinator(bind="127.0.0.1")
+    ca = f"127.0.0.1:{coord.port}"
+    s0 = AsyncPSService(_mkstore(_subset(params, keys[:4])),
+                        bind="127.0.0.1", coordinator=ca)
+    s1 = AsyncPSService(_mkstore(_subset(params, keys[4:])),
+                        bind="127.0.0.1", coordinator=ca)
+    w = connect_async(None, 0, params, coordinator=ca)
+    try:
+        w.pull_all()
+        grads = {k: jnp.full_like(v, 0.01) for k, v in params.items()}
+        for _ in range(3):
+            w.push_pull(grads)
+        assert s0._engine.version == 3 and s1._engine.version == 3
+        # connect_async still demands SOME topology
+        with pytest.raises(ValueError, match="server uri or a"):
+            connect_async(None, 0, params)
+    finally:
+        w.close()
+        s0.stop()
+        s1.stop()
+        coord.stop()
+
+
+# -- live migration -----------------------------------------------------------
+
+
+def test_live_split_and_drain_under_traffic_exactly_once(tpu_async):
+    """The tentpole drill: 2 shards grow to 4 and shrink back to 2, all
+    mid-traffic, with zero lost and zero double-applied pushes — every
+    key's apply count across the whole fleet equals the number of
+    logical pushes — and the flight log narrating every move."""
+    params = _params(n=8)
+    keys = sorted(params)
+    fr = obs.flight()
+    n0 = fr.total
+    reg = obs.default_registry()
+    coord = Coordinator(bind="127.0.0.1")
+    ca = f"127.0.0.1:{coord.port}"
+    svcs = [
+        AsyncPSService(_mkstore(_subset(params, keys[:4])),
+                       bind="127.0.0.1", coordinator=ca),
+        AsyncPSService(_mkstore(_subset(params, keys[4:])),
+                       bind="127.0.0.1", coordinator=ca),
+    ]
+    w = connect_async(None, 0, params, coordinator=ca,
+                      failover_timeout=30.0)
+    try:
+        w.pull_all()
+        grads = {k: jnp.full_like(v, 0.01) for k, v in params.items()}
+        stop = threading.Event()
+        pushed = [0]
+        errs = []
+
+        def hammer():
+            try:
+                while not stop.is_set():
+                    w.push_pull(grads)
+                    pushed[0] += 1
+            except BaseException as e:  # surfaced below
+                errs.append(e)
+
+        t = threading.Thread(target=hammer)
+        t.start()
+        try:
+            time.sleep(0.2)
+            # two empty standbys join mid-traffic
+            svcs.append(AsyncPSService(_mkstore({}), bind="127.0.0.1",
+                                       coordinator=ca))
+            svcs.append(AsyncPSService(_mkstore({}), bind="127.0.0.1",
+                                       coordinator=ca))
+            out = request_rebalance(ca, targets=[0, 1, 2, 3])
+            assert out["moves"], "the split planned no moves"
+            split_epoch = out["epoch"]
+            time.sleep(0.3)
+            out = request_rebalance(ca, drain=[2, 3])
+            assert out["epoch"] > split_epoch
+            time.sleep(0.2)
+        finally:
+            stop.set()
+            t.join(timeout=60)
+        assert not errs, f"pusher died during the drill: {errs[0]!r}"
+        assert pushed[0] > 0
+        # every push routed somewhere and applied exactly once per key:
+        # the engines' per-key apply counts (which MIGRATE with the row)
+        # sum to the logical push count across the whole fleet
+        for k in keys:
+            total = sum(s._engine.apply_count.get(k, 0) for s in svcs
+                        if k in s._engine._params)
+            assert total == pushed[0], (
+                f"key {k}: {total} applies for {pushed[0]} pushes")
+        # drained members left the table; the worker re-routed to follow
+        table = coord.table()
+        assert len(table.shards) == 2
+        assert sorted(table.assign) == keys
+        assert w.transport.table_reroutes >= 1
+        # the flight log narrates the moves, and the counters mirror it
+        kinds = [e["kind"] for e in fr.events()[-(fr.total - n0):]]
+        assert "rebalance_start" in kinds and "rebalance_commit" in kinds
+        assert "table_reroute" in kinds
+        rendered = reg.render_prometheus()
+        assert "ps_event_rebalance_commit_total" in rendered
+        assert "ps_rebalance_moves_total" in rendered
+        assert coord.moves_done >= 2
+    finally:
+        w.close()
+        for s in svcs:
+            s.stop()
+        coord.stop()
+
+
+def test_bucketed_pusher_races_table_flip_replays_exactly_once(tpu_async):
+    """tests/test_replica.py's bucketed dedup drill, extended to a MOVING
+    key range: a multi-bucket pusher races the epoch bump of a live
+    migration. A push staged against epoch E can be cut by the cutover
+    mid-flight — some buckets applied at the donor, the rest refused with
+    the typed 'moved' reply — so the worker re-fetches the table and
+    replays the WHOLE logical push with its original (nonce, seq) token:
+    per-key dedup acks the half that landed and applies only the owed
+    keys, exactly once each, across repeated flips in both directions."""
+    params = _params(n=8)
+    keys = sorted(params)
+    coord = Coordinator(bind="127.0.0.1")
+    ca = f"127.0.0.1:{coord.port}"
+    svcs = [
+        AsyncPSService(_mkstore(_subset(params, keys[:4])),
+                       bind="127.0.0.1", coordinator=ca),
+        AsyncPSService(_mkstore(_subset(params, keys[4:])),
+                       bind="127.0.0.1", coordinator=ca),
+    ]
+    # tiny buckets: every logical push is MANY staged frames per shard,
+    # maximizing the window for a flip to cut a push mid-stream
+    w = connect_async(None, 0, params, coordinator=ca,
+                      bucket_bytes=1 << 10, pool_size=2,
+                      failover_timeout=30.0)
+    try:
+        w.pull_all()
+        grads = {k: jnp.full_like(v, 0.01) for k, v in params.items()}
+        stop = threading.Event()
+        pushed = [0]
+        errs = []
+
+        def hammer():
+            try:
+                while not stop.is_set():
+                    w.push_pull(grads)
+                    pushed[0] += 1
+            except BaseException as e:  # surfaced below
+                errs.append(e)
+
+        t = threading.Thread(target=hammer)
+        t.start()
+        try:
+            time.sleep(0.2)
+            svcs.append(AsyncPSService(_mkstore({}), bind="127.0.0.1",
+                                       coordinator=ca))
+            # several flips in both directions, racing the pusher every
+            # time: 2 shards -> 3 -> back, twice
+            for _ in range(2):
+                request_rebalance(ca, targets=[0, 1, 2])
+                time.sleep(0.2)
+                # back off shard 2 (it stays registered, just empty)
+                request_rebalance(ca, targets=[0, 1])
+                time.sleep(0.2)
+        finally:
+            stop.set()
+            t.join(timeout=60)
+        assert not errs, f"pusher died during the flips: {errs[0]!r}"
+        assert pushed[0] > 0
+        assert w.transport.table_reroutes >= 1
+        for k in keys:
+            total = sum(s._engine.apply_count.get(k, 0) for s in svcs
+                        if k in s._engine._params)
+            assert total == pushed[0], (
+                f"key {k}: {total} applies for {pushed[0]} pushes")
+    finally:
+        w.close()
+        for s in svcs:
+            s.stop()
+        coord.stop()
+
+
+def test_rebalance_drill_mnist_loss_parity_with_momentum(tpu_async):
+    """Scale 2→4→2 mid-MNIST-MLP-run: the loss curve is BITWISE the
+    unrebalanced reference's (sync-ack path: push_pull blocks until the
+    apply landed; λ=0). The momentum optimizer proves per-key optimizer
+    state travels with the row — a reset trace would break parity."""
+    from ps_tpu.data.synthetic import mnist_batches
+    from ps_tpu.models.mlp import MLP, cross_entropy_loss
+
+    model = MLP(hidden=32)
+    params0 = model.init(jax.random.key(0),
+                         jnp.zeros((1, 28, 28, 1)))["params"]
+    kv, _ = keymod.flatten_with_keys(params0)
+    keys = sorted(kv)
+
+    @jax.jit
+    def grad_fn(p, images, labels):
+        def loss_fn(q):
+            return cross_entropy_loss(
+                model.apply({"params": q}, images), labels)
+        return jax.value_and_grad(loss_fn)(p)
+
+    steps, bs = 8, 32
+
+    def run(rebalance):
+        coord = Coordinator(bind="127.0.0.1")
+        ca = f"127.0.0.1:{coord.port}"
+        half = len(keys) // 2
+        svcs = [
+            AsyncPSService(
+                _mkstore(_subset(dict(kv), keys[:half]),
+                         optimizer="momentum"),
+                bind="127.0.0.1", coordinator=ca),
+            AsyncPSService(
+                _mkstore(_subset(dict(kv), keys[half:]),
+                         optimizer="momentum"),
+                bind="127.0.0.1", coordinator=ca),
+        ]
+        w = connect_async(None, 0, params0, coordinator=ca,
+                          failover_timeout=30.0)
+        losses = []
+        try:
+            p = w.pull_all()
+            for step, (images, labels) in enumerate(
+                    mnist_batches(bs, steps=steps, seed=1)):
+                if rebalance and step == 3:  # mid-run: grow the fleet
+                    svcs.append(AsyncPSService(
+                        _mkstore({}, optimizer="momentum"),
+                        bind="127.0.0.1", coordinator=ca))
+                    svcs.append(AsyncPSService(
+                        _mkstore({}, optimizer="momentum"),
+                        bind="127.0.0.1", coordinator=ca))
+                    request_rebalance(ca, targets=[0, 1, 2, 3])
+                if rebalance and step == 6:  # and shrink it back
+                    request_rebalance(ca, drain=[2, 3])
+                loss, g = grad_fn(p, images, labels)
+                losses.append(float(loss))
+                p = w.push_pull(g)
+            if rebalance:
+                assert w.transport.table_reroutes >= 1
+        finally:
+            w.close()
+            for s in svcs:
+                s.stop()
+            coord.stop()
+        return losses
+
+    ref = run(rebalance=False)
+    drill = run(rebalance=True)
+    assert drill == ref, (
+        f"rebalanced loss curve diverged: {drill} vs {ref}")
+
+
+def test_migration_moves_optimizer_state_and_dedup_tokens(tpu_async):
+    """Exactly-once across the handoff, deterministically: a push the
+    donor applied pre-move, replayed at the recipient post-move (the
+    worker's retry of an in-flight push whose reply died during the
+    cutover), is acked WITHOUT re-applying — the moved row already
+    contains it. And the donor's post-move refusal is the typed
+    re-route, never a job-killing KeyError."""
+    params = _params(n=4)
+    keys = sorted(params)
+    coord = Coordinator(bind="127.0.0.1")
+    ca = f"127.0.0.1:{coord.port}"
+    donor = AsyncPSService(_mkstore(params, optimizer="momentum"),
+                           bind="127.0.0.1", coordinator=ca)
+    recip = AsyncPSService(_mkstore({}, optimizer="momentum"),
+                           bind="127.0.0.1", coordinator=ca)
+    w = connect_async(None, 0, params, coordinator=ca,
+                      failover_timeout=30.0)
+    try:
+        w.pull_all()
+        grads = {k: jnp.full_like(v, 0.1) for k, v in params.items()}
+        w.push_all(grads)          # pseq=1 applied at the donor
+        nonce = w._transport_nonce
+        donor_params = {k: np.asarray(v) for k, v in
+                        donor._engine._params.items()}
+        moved = keys[:2]
+        out = request_rebalance(ca, moves=[[0, 1, moved]])
+        assert out["moved_bytes"] > 0
+        # the moved rows landed bitwise, momentum state and all
+        for k in moved:
+            np.testing.assert_array_equal(
+                np.asarray(recip._engine._params[k]), donor_params[k])
+            assert recip._engine.apply_count[k] == 1
+        assert recip._engine.optimizer_state(moved[0]) is not None
+        # replay pseq=1 (moved subtree) AT THE RECIPIENT: the transferred
+        # (nonce, seq) token dedups it — acked, not re-applied
+        sub = {k: np.full(np.asarray(params[k]).shape, 0.1, np.float32)
+               for k in moved}
+        ch = tv.Channel.connect("127.0.0.1", recip.port)
+        try:
+            kind, _, _, extra = tv.decode(ch.request(tv.encode(
+                tv.PUSH, 0, sub, extra={"pseq": 1, "pnonce": nonce})))
+            assert kind == tv.OK and extra["dedup"] is True
+            assert all(recip._engine.apply_count[k] == 1 for k in moved)
+            # a NEW push of the moved range at the DONOR: the typed,
+            # retry-able "moved" refusal carrying the table epoch
+            ch2 = tv.Channel.connect("127.0.0.1", donor.port)
+            try:
+                kind, _, _, extra = tv.decode(ch2.request(tv.encode(
+                    tv.PUSH, 0, sub, extra={"pseq": 2, "pnonce": nonce})))
+                assert kind == tv.ERR and extra["moved"] is True
+                assert extra["table_epoch"] >= out["epoch"]
+            finally:
+                ch2.close()
+        finally:
+            ch.close()
+        # the WORKER rides the same refusal transparently end to end
+        w.push_all(grads)
+        for k in keys:
+            total = sum(s._engine.apply_count.get(k, 0)
+                        for s in (donor, recip)
+                        if k in s._engine._params)
+            assert total == 2  # pseq 1 + the post-move push, never 3
+    finally:
+        w.close()
+        donor.stop()
+        recip.stop()
+        coord.stop()
+
+
+def test_aborted_move_leaves_donor_intact_and_dumps_events(
+        tpu_async, tmp_path):
+    """A move whose recipient is unreachable ABORTS cleanly: the table
+    epoch never advances, the donor keeps serving every key, and the
+    flight recorder holds typed rebalance_start/rebalance_abort events
+    (mirrored as ps_event_* counters) that dump as JSONL."""
+    params = _params(n=4)
+    fr = obs.flight()
+    reg = obs.default_registry()
+    coord = Coordinator(bind="127.0.0.1")
+    ca = f"127.0.0.1:{coord.port}"
+    s0 = AsyncPSService(_mkstore(params), bind="127.0.0.1", coordinator=ca)
+    w = connect_async(None, 0, params, coordinator=ca)
+    try:
+        w.pull_all()
+        epoch0 = coord.table().epoch
+        # hand-plan a move to an address nobody serves: MIGRATE_BEGIN
+        # can never succeed, so the donor aborts the session. (Snapshot
+        # the table BEFORE taking _tlock — table() acquires it too.)
+        t0 = coord.table()
+        with coord._tlock:
+            coord._table = ShardTable(
+                epoch0, t0.shards + ["127.0.0.1:9"], t0.assign)
+            coord._members.append(type(coord._members[0])(
+                "127.0.0.1:9", 999, "dense"))
+        with pytest.raises(RuntimeError, match="refused the move"):
+            coord.rebalance(moves=[[0, 1, sorted(params)[:2]]])
+        assert coord.table().epoch == epoch0  # nothing committed
+        # donor intact: traffic flows over the full key range
+        grads = {k: jnp.full_like(v, 0.1) for k, v in params.items()}
+        w.push_pull(grads)
+        assert s0._engine.version == 1
+        kinds = [e["kind"] for e in fr.events()]
+        assert "rebalance_start" in kinds and "rebalance_abort" in kinds
+        assert "coord_elect" in kinds
+        rendered = reg.render_prometheus()
+        assert "ps_event_rebalance_abort_total" in rendered
+        assert "ps_event_coord_elect_total" in rendered
+        assert "ps_rebalance_aborts_total" in rendered
+        # and the black box dumps them for the post-incident read
+        path = fr.dump("abort drill", path=str(tmp_path / "flight.jsonl"))
+        lines = [json.loads(ln) for ln in
+                 open(path).read().splitlines() if ln]
+        dumped = {e.get("kind") for e in lines}
+        assert {"rebalance_start", "rebalance_abort"} <= dumped
+    finally:
+        w.close()
+        s0.stop()
+        coord.stop()
+
+
+def test_concurrent_join_never_collides_with_move_epoch(tpu_async):
+    """The committed epoch of a move is allocated at INSTALL time, not
+    when the move was planned — so a member that joins while the move
+    streams gets its own epoch, and every table reader observes a
+    strictly monotonic epoch sequence (a collision would strand workers
+    waiting for an epoch 'past' one that was published twice)."""
+    params = _params(n=8, shape=(128, 128))  # big rows: a wide window
+    keys = sorted(params)
+    coord = Coordinator(bind="127.0.0.1")
+    ca = f"127.0.0.1:{coord.port}"
+    donor = AsyncPSService(_mkstore(params), bind="127.0.0.1",
+                           coordinator=ca)
+    recip = AsyncPSService(_mkstore({}), bind="127.0.0.1", coordinator=ca)
+    epochs = []
+    stop = threading.Event()
+
+    def watch():
+        while not stop.is_set():
+            epochs.append(coord.table().epoch)
+            time.sleep(0.002)
+
+    late = []
+
+    def join_late():
+        time.sleep(0.03)  # land inside the move's streaming window
+        late.append(AsyncPSService(_mkstore({}), bind="127.0.0.1",
+                                   coordinator=ca))
+
+    tw = threading.Thread(target=watch)
+    tj = threading.Thread(target=join_late)
+    tw.start()
+    tj.start()
+    try:
+        out = coord.rebalance(moves=[[0, 1, keys[:4]]])
+    finally:
+        tj.join(timeout=30)
+        stop.set()
+        tw.join(timeout=10)
+    try:
+        assert late, "the concurrent join never completed"
+        # strict monotonicity for every reader, no epoch reuse
+        assert all(b >= a for a, b in zip(epochs, epochs[1:])), epochs
+        # the join and the move both committed, at DISTINCT epochs
+        table = coord.table()
+        assert out["epoch"] == table.epoch
+        assert len(table.shards) == 3
+        assert table.keys_of(1) == keys[:4]
+    finally:
+        donor.stop()
+        recip.stop()
+        for s in late:
+            s.stop()
+        coord.stop()
+
+
+def test_migrate_commit_reask_is_idempotent(tpu_async):
+    """A lost MIGRATE_COMMIT reply is ambiguous at the donor — the
+    recipient may have installed the rows already. The donor re-asks on
+    a fresh channel; a commit for the just-committed key list must ACK
+    (same reply), and anything else must still refuse — otherwise the
+    donor 'aborts' a move the recipient is serving and both shards own
+    the range."""
+    params = _params(n=4)
+    keys = sorted(params)
+    coord = Coordinator(bind="127.0.0.1")
+    ca = f"127.0.0.1:{coord.port}"
+    donor = AsyncPSService(_mkstore(params), bind="127.0.0.1",
+                           coordinator=ca)
+    recip = AsyncPSService(_mkstore({}), bind="127.0.0.1", coordinator=ca)
+    try:
+        moved = keys[:2]
+        out = request_rebalance(ca, moves=[[0, 1, moved]])
+        ch = tv.Channel.connect("127.0.0.1", recip.port)
+        try:
+            # the re-ask of the committed cutover: acked, not refused
+            kind, _, _, extra = tv.decode(ch.request(tv.encode(
+                tv.MIGRATE_COMMIT, 0, None,
+                extra={"keys": moved, "table_epoch": out["epoch"]})))
+            assert kind == tv.OK and extra["keys"] == moved
+            # no double-install: apply counts unchanged by the re-ask
+            assert all(recip._engine.apply_count.get(k, 0) == 0
+                       for k in moved)
+            # a DIFFERENT range (or a commit with no staged intake at
+            # all) still refuses
+            kind, _, _, extra = tv.decode(ch.request(tv.encode(
+                tv.MIGRATE_COMMIT, 0, None,
+                extra={"keys": keys[2:], "table_epoch": 99})))
+            assert kind == tv.ERR and "staged intake" in extra["error"]
+        finally:
+            ch.close()
+        # the SAME ambiguity one hop up: a re-asked MIGRATE_OUT for the
+        # committed move acks with the recorded receipt at the donor —
+        # never re-runs (the keys are gone) and never reads as an abort
+        ch = tv.Channel.connect("127.0.0.1", donor.port)
+        try:
+            kind, _, _, extra = tv.decode(ch.request(tv.encode(
+                tv.MIGRATE_OUT, 0, None, extra={
+                    "keys": moved, "target": f"127.0.0.1:{recip.port}",
+                    "table_epoch": out["epoch"]})))
+            assert kind == tv.OK and extra["keys"] == moved
+            assert extra["rows"] >= len(moved)
+            assert all(recip._engine.apply_count.get(k, 0) == 0
+                       for k in moved)  # receipt replay, no re-stream
+        finally:
+            ch.close()
+    finally:
+        donor.stop()
+        recip.stop()
+        coord.stop()
+
+
+def test_straddling_replay_replicates_as_subtree(tpu_async):
+    """A replay that is owed only SOME keys applies (and must replicate)
+    a partial tree: the backup mirrors it through push_subtree instead
+    of refusing the stream as a torn whole-tree push — a re-attached
+    backup right after a range move must survive the in-flight replays."""
+    params = _params(n=4)
+    keys = sorted(params)
+    prim = AsyncPSService(_mkstore(params), bind="127.0.0.1")
+    back = AsyncPSService(_mkstore(params), bind="127.0.0.1", backup=True)
+    prim.attach_backup("127.0.0.1", back.port, ack="sync")
+    w = connect_async(f"127.0.0.1:{prim.port}|127.0.0.1:{back.port}", 0,
+                      params)
+    try:
+        w.pull_all()
+        grads = {k: jnp.full_like(v, 0.1) for k, v in params.items()}
+        w.push_all(grads)  # pseq=1, fully applied + replicated
+        nonce = w._transport_nonce
+        # simulate the post-move merge: two keys' tokens are BEHIND
+        # (as if adopted from a donor that never saw pseq=1)
+        with prim._engine._lock:
+            for k in keys[:2]:
+                del prim._applied_pseq[0][k]
+        sub = {k: np.full(np.asarray(params[k]).shape, 0.1, np.float32)
+               for k in params}
+        ch = tv.Channel.connect("127.0.0.1", prim.port)
+        try:
+            kind, _, _, extra = tv.decode(ch.request(tv.encode(
+                tv.PUSH, 0, sub, extra={"pseq": 1, "pnonce": nonce})))
+            assert kind == tv.OK
+        finally:
+            ch.close()
+        # the primary applied exactly the owed subset...
+        assert all(prim._engine.apply_count[k] == 2 for k in keys[:2])
+        assert all(prim._engine.apply_count[k] == 1 for k in keys[2:])
+        # ...and the backup mirrored it instead of degrading
+        sess = prim._backup_session
+        assert sess is not None and not sess.degraded
+        assert all(back._engine.apply_count[k] == 2 for k in keys[:2])
+        assert all(back._engine.apply_count[k] == 1 for k in keys[2:])
+        for k in keys:
+            np.testing.assert_array_equal(
+                np.asarray(prim._engine._params[k]),
+                np.asarray(back._engine._params[k]))
+    finally:
+        w.close()
+        prim.stop()
+        back.stop()
+
+
+def test_refused_migrate_out_keeps_static_semantics(tpu_async):
+    """An aborted/refused move must NOT convert a static deployment into
+    an 'elastic' one: afterwards a mismatched push still surfaces the
+    hard KeyError diagnosis, never the retryable 'moved' refusal."""
+    params = _params(n=4)
+    keys = sorted(params)
+    svc = AsyncPSService(_mkstore(params), bind="127.0.0.1")
+    other = AsyncPSService(_mkstore({}), bind="127.0.0.1")
+    try:
+        ch = tv.Channel.connect("127.0.0.1", svc.port)
+        try:
+            # donor does not own this key: refused after BEGIN, aborted
+            kind, _, _, extra = tv.decode(ch.request(tv.encode(
+                tv.MIGRATE_OUT, 0, None, extra={
+                    "keys": ["nope/w"],
+                    "target": f"127.0.0.1:{other.port}",
+                    "table_epoch": 1})))
+            assert kind == tv.ERR and "does not own" in extra["error"]
+            # a bad push is still the HARD static refusal
+            sub = {keys[0]: np.zeros(
+                np.asarray(params[keys[0]]).shape, np.float32)}
+            kind, _, _, extra = tv.decode(ch.request(tv.encode(
+                tv.PUSH, 0, sub)))
+            assert kind == tv.ERR
+            assert not extra.get("moved")
+            assert "KeyError" in extra["error"]
+        finally:
+            ch.close()
+    finally:
+        svc.stop()
+        other.stop()
+
+
+# -- sparse members -----------------------------------------------------------
+
+
+def _sparse_tables(shard, num_shards, total=64, dim=4):
+    # the fixture's 1-device mesh (ps.init(mesh_shape={"data": 1})) is
+    # picked up by SparseEmbedding automatically — see test_replica.py's
+    # in-process-services gotcha
+    lo, hi = row_range(shard, num_shards, total)
+    emb = SparseEmbedding(hi - lo, dim, optimizer="sgd", learning_rate=0.1)
+    rng = np.random.default_rng([11, dim])
+    emb.init(rng.normal(0, 0.01, (total, dim)).astype(np.float32)[lo:hi])
+    return {"deep": emb}, {"deep": total}
+
+
+@pytest.fixture
+def sparse_mesh(request):
+    # in-process sparse services need a 1-device mesh under the 8-virtual-
+    # device test env (see test_replica.py's gotcha)
+    ps.init(backend="tpu", mode="async", num_workers=1,
+            mesh_shape={"data": 1})
+    request.addfinalizer(ps.shutdown)
+
+
+def test_sparse_member_joins_and_worker_discovers_topology(sparse_mesh):
+    total, dim = 64, 4
+    coord = Coordinator(bind="127.0.0.1")
+    ca = f"127.0.0.1:{coord.port}"
+    t0, tr = _sparse_tables(0, 2, total, dim)
+    t1, _ = _sparse_tables(1, 2, total, dim)
+    s0 = SparsePSService(t0, bind="127.0.0.1", shard=0, num_shards=2,
+                         total_rows=tr, coordinator=ca)
+    s1 = SparsePSService(t1, bind="127.0.0.1", shard=1, num_shards=2,
+                         total_rows=tr, coordinator=ca)
+    w = connect_sparse(None, 0, {"deep": (total, dim)}, coordinator=ca)
+    try:
+        ids = np.arange(0, total, 3, dtype=np.int32)
+        rows = w.pull({"deep": ids})
+        assert rows["deep"].shape == (ids.size, dim)
+        w.push({"deep": (ids, np.ones((ids.size, dim), np.float32))})
+        assert w.versions()["deep"] >= 1
+        # membership shows both ranges as sparse members, liveness live
+        view = fetch_view(ca)
+        assert [m["kind"] for m in view["members"]] == ["sparse", "sparse"]
+        assert all(f"deep@" in k for k in view["table"]["assign"])
+        # a range move is refused with the typed message — sparse fleets
+        # scale by checkpoint-restart, not live row migration
+        with pytest.raises(RuntimeError, match="sparse member"):
+            request_rebalance(
+                ca, moves=[[0, 1, list(view["table"]["assign"])[:1]]])
+        with pytest.raises(ValueError, match="server uri or a"):
+            connect_sparse(None, 0, {"deep": (total, dim)})
+    finally:
+        w.close()
+        s0.stop()
+        s1.stop()
+        coord.stop()
+
+
+def test_sparse_worker_discovers_topology_on_shared_coordinator(
+        sparse_mesh):
+    """One coordinator may own more than one fleet: a dense member's
+    parameter keys in the shard table must be SKIPPED by sparse topology
+    discovery, not treated as a coverage failure."""
+    total, dim = 64, 4
+    coord = Coordinator(bind="127.0.0.1")
+    ca = f"127.0.0.1:{coord.port}"
+    dense = AsyncPSService(_mkstore(_params(n=2)), bind="127.0.0.1",
+                           coordinator=ca)
+    t0, tr = _sparse_tables(0, 2, total, dim)
+    t1, _ = _sparse_tables(1, 2, total, dim)
+    s0 = SparsePSService(t0, bind="127.0.0.1", shard=0, num_shards=2,
+                         total_rows=tr, coordinator=ca)
+    s1 = SparsePSService(t1, bind="127.0.0.1", shard=1, num_shards=2,
+                         total_rows=tr, coordinator=ca)
+    w = connect_sparse(None, 0, {"deep": (total, dim)}, coordinator=ca)
+    try:
+        ids = np.arange(0, total, 5, dtype=np.int32)
+        rows = w.pull({"deep": ids})
+        assert rows["deep"].shape == (ids.size, dim)
+        # the worker dialed ONLY the sparse members (2 of 3)
+        assert len(w._addrs) == 2
+        # a DEFAULT rebalance on the shared coordinator plans over the
+        # dense fleet only — the sparse ranges are not movable mass
+        standby = AsyncPSService(_mkstore({}), bind="127.0.0.1",
+                                 coordinator=ca)
+        try:
+            out = request_rebalance(ca)
+            assert out["moves"], "the dense split planned no moves"
+            assert all({d, r} <= {0, 3} for d, r, _n in out["moves"]), out
+            t = coord.table()
+            assert all(t.assign[k] in (1, 2) for k in t.assign
+                       if "@" in k)  # sparse ranges never moved
+            # and a sparse member cannot be key-drained
+            with pytest.raises(RuntimeError, match="leave by stopping"):
+                request_rebalance(ca, drain=[1])
+        finally:
+            standby.stop()
+    finally:
+        w.close()
+        dense.stop()
+        s0.stop()
+        s1.stop()
+        coord.stop()
+
+
+def test_sparse_member_replacement_takeover_and_rediscovery(sparse_mesh):
+    """Membership replacement without a worker restart: a member leaves,
+    a replacement registers the SAME row range (the coordinator's
+    exact-key-set slot takeover), and the worker's next op — which finds
+    the old address dead with no replica to cycle to — re-discovers the
+    fleet from the coordinator and re-dials."""
+    total, dim = 64, 4
+    coord = Coordinator(bind="127.0.0.1")
+    ca = f"127.0.0.1:{coord.port}"
+    t0, tr = _sparse_tables(0, 2, total, dim)
+    t1, _ = _sparse_tables(1, 2, total, dim)
+    s0 = SparsePSService(t0, bind="127.0.0.1", shard=0, num_shards=2,
+                         total_rows=tr, coordinator=ca)
+    s1 = SparsePSService(t1, bind="127.0.0.1", shard=1, num_shards=2,
+                         total_rows=tr, coordinator=ca)
+    w = connect_sparse(None, 0, {"deep": (total, dim)},
+                       coordinator=ca, failover_timeout=30.0)
+    repl = None
+    try:
+        ids = np.arange(0, total, 3, dtype=np.int32)
+        w.push({"deep": (ids, np.ones((ids.size, dim), np.float32))})
+        old_epoch = coord.table().epoch
+        s1.stop()  # clean leave: the membership view shows 'left'
+        deadline = time.monotonic() + 10
+        while time.monotonic() < deadline:
+            ms = fetch_view(ca)["members"]
+            if ms[1]["hb_state"] == "left":
+                break
+            time.sleep(0.05)
+        # the replacement re-registers the exact range on a new port:
+        # slot takeover, one more table epoch
+        t1b, _ = _sparse_tables(1, 2, total, dim)
+        repl = SparsePSService(t1b, bind="127.0.0.1", shard=1,
+                               num_shards=2, total_rows=tr,
+                               coordinator=ca)
+        table = coord.table()
+        assert table.epoch > old_epoch
+        assert len(table.shards) == 2
+        assert table.shards[1].endswith(f":{repl.port}")
+        # the worker's next op rides the death -> re-discovery path
+        rows = w.pull({"deep": ids})
+        assert rows["deep"].shape == (ids.size, dim)
+        assert w.transport.table_reroutes >= 1
+    finally:
+        w.close()
+        s0.stop()
+        if repl is not None:
+            repl.stop()
+        coord.stop()
+
+
+def test_same_uri_restart_gets_fresh_heartbeat_identity(tpu_async):
+    """A rolling restart on a fixed port: the goodbye's 'left' state is
+    permanent at the monitor, so re-registration must mint a FRESH node
+    id — otherwise the live restarted shard reads as left forever and
+    its slot stays takeover-eligible while it serves."""
+    import socket
+
+    params = _params(n=4)
+    coord = Coordinator(bind="127.0.0.1")
+    ca = f"127.0.0.1:{coord.port}"
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    s0 = AsyncPSService(_mkstore(params), port=port, bind="127.0.0.1",
+                        coordinator=ca)
+    node0 = s0._coord_member.node
+    epoch0 = coord.table().epoch
+    s0.stop()  # clean leave: 'left' at the monitor, permanently
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if fetch_view(ca)["members"][0]["hb_state"] == "left":
+            break
+        time.sleep(0.05)
+    s0b = AsyncPSService(_mkstore(params), port=port, bind="127.0.0.1",
+                         coordinator=ca)
+    try:
+        assert s0b._coord_member.node != node0
+        assert coord.table().epoch == epoch0  # same table, same slot
+        deadline = time.monotonic() + 10
+        view = None
+        while time.monotonic() < deadline:
+            view = fetch_view(ca)["members"][0]
+            if view["hb_state"] == "alive":
+                break
+            time.sleep(0.05)
+        assert view["hb_state"] == "alive", view
+        # ...and while it is alive, its slot cannot be taken over
+        with pytest.raises(RuntimeError, match="already assigned"):
+            AsyncPSService(_mkstore(params), bind="127.0.0.1",
+                           coordinator=ca)
+    finally:
+        s0b.stop()
+        coord.stop()
+
+
+def test_table_reroute_timeout_stays_typed_within_deadline(
+        tpu_async, monkeypatch):
+    """A coordinator whose table publish lags must not let a raw
+    TimeoutError escape the re-route loop early: the worker polls until
+    ITS failover deadline, then surfaces the typed TableMovedError."""
+    import ps_tpu.elastic.member as member_mod
+
+    params = _params(n=2)
+    coord = Coordinator(bind="127.0.0.1")
+    ca = f"127.0.0.1:{coord.port}"
+    svc = AsyncPSService(_mkstore(params), bind="127.0.0.1",
+                         coordinator=ca)
+    w = connect_async(None, 0, params, coordinator=ca)
+    try:
+        calls = [0]
+
+        def stalled(*a, **kw):
+            calls[0] += 1
+            time.sleep(0.05)
+            raise TimeoutError("publish lagging")
+
+        monkeypatch.setattr(member_mod, "fetch_table", stalled)
+        err = TableMovedError("shard says moved", server=0, table_epoch=9)
+        t0 = time.monotonic()
+        with pytest.raises(TableMovedError, match="never converged"):
+            w._on_table_moved(err, deadline=time.monotonic() + 1.0)
+        dt = time.monotonic() - t0
+        assert calls[0] >= 2, "gave up on the first fetch timeout"
+        assert 0.9 <= dt < 5.0, dt
+    finally:
+        w.close()
+        svc.stop()
+        coord.stop()
+
+
+# -- the static fallback ------------------------------------------------------
+
+
+def test_static_worker_surfaces_moved_refusal_hard(tpu_async):
+    """No coordinator configured: a 'moved' refusal cannot be recovered
+    from — the typed error points the operator at PS_COORD_URI instead
+    of retrying forever against a topology that is simply wrong now."""
+    params = _params(n=2)
+    svc = AsyncPSService(_mkstore(params), bind="127.0.0.1")
+    w = connect_async(f"127.0.0.1:{svc.port}", 0, params)
+    try:
+        err = TableMovedError("shard says moved", server=0, table_epoch=3)
+        with pytest.raises(TableMovedError, match="no coordinator"):
+            w._on_table_moved(err, deadline=time.monotonic() + 1)
+    finally:
+        w.close()
+        svc.stop()
+
+
+def test_config_elastic_knobs_and_env(monkeypatch):
+    c = Config()
+    assert c.coord_uri is None and c.rebalance_auto is False
+    assert c.rebalance_max_skew == 2.0 and c.rebalance_report_ms == 1000
+    monkeypatch.setenv("PS_COORD_URI", "10.0.0.1:7070")
+    monkeypatch.setenv("PS_REBALANCE_AUTO", "1")
+    monkeypatch.setenv("PS_REBALANCE_MAX_SKEW", "3.5")
+    monkeypatch.setenv("PS_REBALANCE_REPORT_MS", "250")
+    c = Config.from_env()
+    assert c.coord_uri == "10.0.0.1:7070"
+    assert c.rebalance_auto is True
+    assert c.rebalance_max_skew == 3.5
+    assert c.rebalance_report_ms == 250
+    monkeypatch.setenv("PS_COORD_URI", "")  # "" = explicit static
+    assert Config.from_env().coord_uri is None
+    with pytest.raises(ValueError, match="rebalance_max_skew"):
+        Config(rebalance_max_skew=0.5)
+    with pytest.raises(ValueError, match="rebalance_report_ms"):
+        Config(rebalance_report_ms=0)
+
+
+# -- ps_top --coord -----------------------------------------------------------
+
+
+def test_ps_top_coord_view(tpu_async):
+    params = _params(n=4)
+    coord = Coordinator(bind="127.0.0.1")
+    ca = f"127.0.0.1:{coord.port}"
+    s0 = AsyncPSService(_mkstore(params), bind="127.0.0.1", coordinator=ca)
+    try:
+        env = dict(os.environ, JAX_PLATFORMS="cpu")
+        out = subprocess.run(
+            [sys.executable, "tools/ps_top.py", "--coord", ca,
+             "--once", "--json"],
+            capture_output=True, text=True, timeout=120, env=env,
+            cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+        )
+        assert out.returncode == 0, out.stderr
+        view = json.loads(out.stdout)
+        assert view["table"]["epoch"] >= 1
+        assert len(view["members"]) == 1
+        assert view["members"][0]["kind"] == "dense"
+        # the human renderer accepts the same view
+        import importlib.util
+        import io
+
+        root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        spec = importlib.util.spec_from_file_location(
+            "ps_top", os.path.join(root, "tools", "ps_top.py"))
+        ps_top = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(ps_top)
+        buf = io.StringIO()
+        ps_top.print_coord_view(view, stream=buf)
+        assert "shard table epoch" in buf.getvalue()
+    finally:
+        s0.stop()
+        coord.stop()

@@ -81,6 +81,32 @@ def test_bench_failover_smoke():
     assert d["promote_reason"] == "timeout"
 
 
+def test_bench_rebalance_smoke():
+    """bench.py --model rebalance: the elastic-membership acceptance
+    gauge — a 2→4→2 live rebalance under traffic must report move GB/s,
+    the per-phase p99 disturbance, and a balanced per-key exactly-once
+    ledger (asserted inside the bench). (Not marked slow: a few seconds
+    of hammer windows at --quick scale.)"""
+    env = {k: v for k, v in os.environ.items()
+           if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+    env["JAX_PLATFORMS"] = "cpu"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(_REPO, "bench.py"),
+         "--model", "rebalance", "--quick"],
+        env=env, capture_output=True, text=True, timeout=420,
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["metric"] == "rebalance_move_gbps"
+    assert out["value"] > 0
+    d = out["detail"]
+    assert d["exactly_once"] is True
+    assert d["pushes"] > 0
+    assert d["table_reroutes"] >= 1
+    assert d["split_moves"] and d["drain_moves"]
+    assert d["table_epoch"] >= 4  # 2 joins + >=1 split move + drain
+
+
 @pytest.mark.slow
 def test_bench_dc_asgd_smoke():
     out = _run("bench_dc_asgd.py", "--applies", "12", "--eval-every", "6",

@@ -13,6 +13,11 @@
 #      on, pushes traffic, scrapes /metrics mid-run and asserts the
 #      counters moved, then runs `tools/ps_top.py --once` against the
 #      pair and checks both roles render.
+#   4. rebalance (<60 s): spawns 2 shards + a coordinator, splits to 4
+#      shards mid-traffic over the live migration stream (then drains
+#      back to 2), and asserts zero lost pushes (the per-key exactly-once
+#      ledger), a committed table epoch, and that the worker re-routed
+#      without restarting.
 #
 # Usage: tools/ci_bench_smoke.sh   (from the repo root)
 #
@@ -144,4 +149,35 @@ print(f"  ps_top --once: {len(rows)} endpoint(s), roles {roles}")
 
 w.close(); back.stop(); prim.stop(); ps.shutdown()
 print("obs smoke OK")
+EOF
+
+# rebalance leg (<60 s): 2 shards + coordinator, split mid-traffic over
+# the live migration stream, drain back — zero lost pushes (the per-key
+# exactly-once ledger is asserted INSIDE the bench), a committed table
+# epoch, and the worker re-routed live instead of restarting.
+out=$(timeout -k 10 120 env JAX_PLATFORMS=cpu python bench.py --model rebalance --quick 2>/dev/null | tail -1)
+python - "$out" <<'EOF'
+import json
+import sys
+
+rec = json.loads(sys.argv[1])
+det = rec["detail"]
+assert rec["metric"] == "rebalance_move_gbps" and rec["value"] > 0, rec
+assert det["exactly_once"], "the per-key apply ledger did not balance"
+assert det["pushes"] > 0, "the hammer never pushed during the drill"
+assert det["table_epoch"] >= 4, \
+    f"too few committed epochs for a split+drain: {det['table_epoch']}"
+assert det["table_reroutes"] >= 1, \
+    "the worker never re-routed — the moves cannot have been live"
+assert det["split_moves"] and det["drain_moves"], det
+print(f"  move throughput   {rec['value']:8.3f} GB/s "
+      f"({det['moved_bytes'] / 1e6:.1f} MB in {det['move_seconds']}s)")
+base, split = det["cycle_p_baseline"], det["cycle_p_during_split"]
+if base and split:
+    print(f"  cycle p99: baseline {base['p99_ms']}ms, during split "
+          f"{split['p99_ms']}ms (disturbance {det['p99_disturbance_x']}x)")
+print(f"  {det['pushes']} pushes, {det['table_reroutes']} live "
+      f"re-route(s), table epoch {det['table_epoch']}; "
+      f"exactly-once ledger balanced")
+print("rebalance smoke OK")
 EOF

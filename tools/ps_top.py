@@ -13,9 +13,18 @@ Usage::
     python tools/ps_top.py --servers "h0:p0|b0:q0,h1:p1" [--interval 2]
     python tools/ps_top.py --servers ... --once          # one table, exit
     python tools/ps_top.py --servers ... --once --json   # machine-readable
+    python tools/ps_top.py --coord host:port [--once] [--json]
 
 ``--once --json`` prints one JSON object per endpoint (a list), for CI
 smoke checks and scripting (tools/ci_bench_smoke.sh's obs leg).
+
+``--coord`` renders the coordinator's membership view instead (README
+"Elastic membership"): the live shard table (epoch, per-shard key count
+and byte load, the reported push/pull QPS), each member's liveness from
+the PR-4 heartbeat detector — state AND per-peer last-beat age — and the
+progress of any in-flight rebalance (moves done/planned, keys moving).
+Elastic data-plane members also grow a ``moved`` column in the
+``--servers`` table: ``<keys moved away>@e<table epoch>``.
 """
 
 from __future__ import annotations
@@ -35,7 +44,13 @@ COLS = [
     ("shard", 5), ("addr", 21), ("role", 8), ("promoted", 14),
     ("epoch", 5), ("version", 9),
     ("applies", 9), ("lag", 5), ("repl", 14), ("dedup", 6), ("stale", 6),
-    ("gbps", 7), ("ack_p99_ms", 10), ("bkt_p99_ms", 10),
+    ("moved", 8), ("gbps", 7), ("ack_p99_ms", 10), ("bkt_p99_ms", 10),
+]
+
+COORD_COLS = [
+    ("shard", 5), ("uri", 21), ("kind", 6), ("node", 4), ("hb", 6),
+    ("age_ms", 6), ("keys", 5), ("mbytes", 8), ("push_qps", 8),
+    ("pull_qps", 8),
 ]
 
 
@@ -93,7 +108,7 @@ def render_row(st: dict) -> dict:
                 "role": "DOWN", "promoted": "-", "epoch": "-",
                 "version": "-",
                 "applies": "-", "lag": "-", "repl": st["error"][:24],
-                "dedup": "-", "stale": "-", "gbps": "-",
+                "dedup": "-", "stale": "-", "moved": "-", "gbps": "-",
                 "ack_p99_ms": "-", "bkt_p99_ms": "-"}
     repl = st.get("repl") or {}
     # a live session renders "<ack mode>@<acked seq>" so an operator sees
@@ -121,6 +136,10 @@ def render_row(st: dict) -> dict:
         "repl": repl_state,
         "dedup": st.get("dedup_hits", 0),
         "stale": st.get("stale_epochs", 0),
+        # elastic members: how many keys a rebalance moved off this shard,
+        # at which shard-table epoch (static services have no table_epoch)
+        "moved": (f"{st.get('keys_moved', 0)}@e{st['table_epoch']}"
+                  if st.get("table_epoch") is not None else "-"),
         "gbps": metrics.get("bucket_gbps", 0.0),
         # `or "-"` would eat a legitimate 0.0 ms p99 (sub-5µs acks round
         # to zero); only a MISSING histogram renders as no-data
@@ -152,11 +171,64 @@ def print_table(rows: list, stream=sys.stdout) -> None:
               file=stream)
 
 
+def render_coord_row(m: dict) -> dict:
+    """One membership row of the coordinator view: identity, the PR-4
+    heartbeat detector's state + per-peer last-beat age, and the latest
+    load report."""
+    report = m.get("report") or {}
+    nbytes = m.get("nbytes")
+    return {
+        "shard": m.get("shard"),
+        "uri": m.get("uri", "?"),
+        "kind": m.get("kind", "?"),
+        "node": m.get("node", "-"),
+        "hb": m.get("hb_state", "?"),
+        "age_ms": _opt(m.get("hb_age_ms")),
+        "keys": _opt(m.get("keys")),
+        "mbytes": (round(nbytes / 1e6, 1)
+                   if isinstance(nbytes, (int, float)) else "-"),
+        "push_qps": _opt(report.get("push_qps")),
+        "pull_qps": _opt(report.get("pull_qps")),
+    }
+
+
+def print_coord_view(view: dict, stream=sys.stdout) -> None:
+    table = view.get("table") or {}
+    mig = view.get("migration")
+    head = (f"shard table epoch {table.get('epoch', '?')}  "
+            f"shards {len(table.get('shards') or [])}  "
+            f"keys {len(table.get('assign') or {})}")
+    if mig:
+        head += (f"  |  REBALANCING: {mig.get('done', 0)}/"
+                 f"{mig.get('moves', 0)} moves, "
+                 f"{mig.get('keys', 0)} key(s) in motion")
+    print(head, file=stream)
+    hdr = "  ".join(f"{name:>{w}}" for name, w in COORD_COLS)
+    print(hdr, file=stream)
+    print("-" * len(hdr), file=stream)
+    for m in view.get("members") or []:
+        r = render_coord_row(m)
+        print("  ".join(f"{_cell(r[name], w):>{w}}"
+                        for name, w in COORD_COLS), file=stream)
+
+
+def poll_coord(addr: str) -> dict:
+    from ps_tpu.elastic.member import fetch_view
+
+    try:
+        return fetch_view(addr)
+    except Exception as e:  # render, don't crash — same policy as STATS
+        return {"error": str(e)}
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    ap.add_argument("--servers", required=True,
+    ap.add_argument("--servers",
                     help="replica-set URI, as workers take it: "
                          '"h0:p0|b0:q0,h1:p1"')
+    ap.add_argument("--coord",
+                    help="coordinator host:port — render the membership/"
+                         "shard-table view instead of per-endpoint STATS")
     ap.add_argument("--interval", type=float, default=2.0,
                     help="refresh cadence in seconds (live mode)")
     ap.add_argument("--once", action="store_true",
@@ -164,21 +236,36 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="with --once: raw per-endpoint STATS as JSON")
     args = ap.parse_args(argv)
+    if (args.servers is None) == (args.coord is None):
+        ap.error("pass exactly one of --servers or --coord")
+
+    def snapshot():
+        return poll_coord(args.coord) if args.coord \
+            else poll_fleet(args.servers)
+
+    def render(data):
+        if args.coord:
+            if "error" in data:
+                print(f"coordinator {args.coord}: DOWN ({data['error']})")
+            else:
+                print_coord_view(data)
+        else:
+            print_table(data)
 
     if args.once:
-        rows = poll_fleet(args.servers)
+        data = snapshot()
         if args.json:
-            print(json.dumps(rows, default=str))
+            print(json.dumps(data, default=str))
         else:
-            print_table(rows)
+            render(data)
         return 0
     try:
         while True:
-            rows = poll_fleet(args.servers)
+            data = snapshot()
             sys.stdout.write("\x1b[2J\x1b[H")  # clear + home
             print(f"ps_top  {time.strftime('%H:%M:%S')}  "
-                  f"({args.servers})")
-            print_table(rows)
+                  f"({args.coord or args.servers})")
+            render(data)
             sys.stdout.flush()
             time.sleep(args.interval)
     except KeyboardInterrupt:
