@@ -511,6 +511,38 @@ def bench_transport(args, retried: bool):
     trace_overhead_pct = (round(100.0 * (1.0 - trace_on_gbps / serial_gbps),
                                 2) if serial_gbps else None)
 
+    # fleet-telemetry overhead: the SAME serial worker with a live
+    # coordinator receiving delta-encoded metric reports (README "Fleet
+    # telemetry") vs a reports-off baseline. Windows ALTERNATE off/on so
+    # both legs sample the same scheduler-noise distribution (adjacent
+    # same-config windows on a 2-core sandboxed host differ by ±30% —
+    # far above the actual cost, one snapshot+frame per cadence), and
+    # best-of per leg converges both on the same ceiling. --quick
+    # windows are ~0.2 s, so the quick cadence is 200 ms (harsher than
+    # the 1 s default: several snapshots land per window); the bar on
+    # quiet hardware is < 2%.
+    from ps_tpu.elastic import Coordinator
+    from ps_tpu.elastic.member import TelemetryReporter
+    from ps_tpu.obs.collector import collect_telemetry
+
+    tel_coord = Coordinator(port=0, bind="127.0.0.1")
+    tel_cadence_ms = 200 if args.quick else 1000
+    off_rates, on_rates = [], []
+    for _ in range(4):
+        off_rates.append(run_cycles(ws, cycles)[0])
+        reporter = TelemetryReporter(
+            f"127.0.0.1:{tel_coord.port}", "bench-worker",
+            lambda: collect_telemetry(ws.transport), kind="worker",
+            report_ms=tel_cadence_ms)
+        on_rates.append(run_cycles(ws, cycles)[0])
+        reporter.close()
+    tel_coord.stop()
+    telemetry_off_gbps = max(off_rates)
+    telemetry_on_gbps = max(on_rates)
+    telemetry_overhead_pct = (
+        round(100.0 * (1.0 - telemetry_on_gbps / telemetry_off_gbps), 2)
+        if telemetry_off_gbps else None)
+
     # serial path with the legacy staging-bytearray framing: the delta to
     # serial_gbps is exactly the deleted per-frame staging copy
     wl = connect_async(uri, 1, tree, writev=False)
@@ -590,6 +622,9 @@ def bench_transport(args, retried: bool):
             "serial_gbps": round(serial_gbps, 3),
             "trace_on_gbps": round(trace_on_gbps, 3),
             "trace_overhead_pct": trace_overhead_pct,
+            "telemetry_off_gbps": round(telemetry_off_gbps, 3),
+            "telemetry_on_gbps": round(telemetry_on_gbps, 3),
+            "telemetry_overhead_pct": telemetry_overhead_pct,
             "serial_staged_gbps": round(serial_staged_gbps, 3),
             "writev_speedup_vs_staged": round(
                 serial_gbps / serial_staged_gbps, 3)
@@ -804,6 +839,11 @@ def bench_failover(args, retried: bool):
                       and s.name in ("push", "push_pull", "bucket_push")
                       and s.parent_id in worker_ids]
     srv_ids = {s.span_id for s in server_applies}
+    # the engine apply is its own child hop since the fleet-telemetry PR
+    # (span-phase tagging): push-record appends parent to it, pull-record
+    # appends still parent to the dispatch span — both are the chain
+    srv_ids |= {s.span_id for s in spans if s.name == "server_apply"
+                and s.parent_id in srv_ids}
     n_append = sum(1 for s in spans if s.name == "replica_append"
                    and s.parent_id in srv_ids)
     n_ack = sum(1 for s in spans if s.name == "replica_ack_wait"

@@ -248,9 +248,25 @@ class AsyncPSService(VanService):
                 "pull_qps": round(pull_qps, 2),
             }
 
+        # fleet telemetry (README "Fleet telemetry"): delta-encoded metric
+        # snapshots piggyback on the load reports — THIS service's own
+        # TransportStats (apply/ack histograms, dedup/stale counters) plus
+        # its apply counter, never the process-global registry, so several
+        # in-process services each report their own numbers
+        from ps_tpu.config import env_flag
+        from ps_tpu.obs.collector import collect_telemetry
+
+        telemetry = None
+        if env_flag("PS_TELEMETRY", True):
+            def telemetry() -> dict:
+                return collect_telemetry(self.transport, counters={
+                    "ps_applies_total": lambda: self.apply_log.total,
+                })
+
         self._coord_member = CoordinatorMember(
             self._coordinator, f"{advertise_host}:{self.port}",
-            key_bytes, kind="dense", report=report_extra)
+            key_bytes, kind="dense", report=report_extra,
+            telemetry=telemetry)
         self.table_epoch = self._coord_member.table.epoch
 
     # -- server internals -----------------------------------------------------
@@ -301,7 +317,17 @@ class AsyncPSService(VanService):
             # beyond this frame's lifetime (bucket-assembled trees already
             # own their buffers and skip this)
             grads = {k: np.array(v) for k, v in grads.items()}
-        with self._engine._lock:
+        # span-phase tagging for the per-step breakdown (ps_tpu/obs/
+        # breakdown.py): the apply — lock wait included, contention IS
+        # apply-path latency — gets an always-on histogram sample
+        # (ps_server_apply_seconds, the straggler detector's default
+        # signal) and, when the request is traced, a server_apply child
+        # span under the dispatch span. Dedup replays and refusals are
+        # NOT applies and record nothing.
+        t_apply = time.perf_counter()
+        apply_s = None
+        with obs.tracer().child("server_apply", cat="server"), \
+                self._engine._lock:
             fresh = grads
             if pseq is not None:
                 fresh = self._dedup_fresh(worker, pnonce, int(pseq), grads)
@@ -331,6 +357,7 @@ class AsyncPSService(VanService):
                 # keys' sub-update is still owed. Apply exactly those.
                 self.transport.record_dedup_hit()
                 self._engine.push_subtree(fresh, worker=worker)
+            apply_s = time.perf_counter() - t_apply
             self._applied[worker] = self._applied.get(worker, 0) + 1
             if pseq is not None:
                 toks = self._applied_pseq.setdefault(worker, {})
@@ -355,6 +382,8 @@ class AsyncPSService(VanService):
                 "push" if len(fresh) == len(self._key_order)
                 else "push_sub",
                 worker, fresh, {"pseq": pseq, "pnonce": pnonce})
+        if apply_s is not None:
+            self.transport.record_apply(apply_s)
         return rseq, False
 
     def _dedup_fresh(self, worker: int, pnonce, pseq: int,
@@ -1293,7 +1322,12 @@ class PendingCycle:
         """Block until the cycle lands; returns the freshly pulled params
         (or re-raises the cycle's transport failure)."""
         t0 = time.perf_counter()
-        if not self._evt.wait(timeout):
+        # flush_wait phase tag (ps_tpu/obs/breakdown.py): when a traced
+        # span is open on THIS thread the wait becomes its child; always
+        # lands in the blocked_s histogram either way (record_blocked)
+        with obs.tracer().child("flush_wait", cat="worker"):
+            done = self._evt.wait(timeout)
+        if not done:
             raise TimeoutError("transport cycle still in flight")
         if self._stats is not None:
             self._stats.record_blocked(time.perf_counter() - t0)
@@ -1377,6 +1411,12 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         # re-fetches it (_on_table_moved) instead of failing the job
         self._coord = coordinator
         self._table = table
+        # reconnect() re-runs _init_multi on a live instance: retire the
+        # old telemetry reporter before (maybe) starting a fresh one
+        old_rep = getattr(self, "_tel_reporter", None)
+        if old_rep is not None:
+            old_rep.close()
+        self._tel_reporter = None
         kv, self._treedef = keymod.flatten_with_keys(params_like)
         # placeholders, not the arrays: reconnect() only needs keys +
         # structure, and pinning a BERT-size initial tree for the worker's
@@ -1437,6 +1477,28 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                 for ch in self._chs:
                     ch.close()
                 raise
+        if coordinator is not None:
+            # fleet telemetry (README "Fleet telemetry"): the worker's
+            # op/flush/wire latency histograms are the per-step
+            # breakdown's WORKER phases — ship them to the coordinator
+            # too (no registration, no heartbeat: telemetry only).
+            # Strictly additive: any failure leaves the data plane alone.
+            from ps_tpu.config import env_flag
+
+            if env_flag("PS_TELEMETRY", True):
+                try:
+                    from ps_tpu.elastic.member import TelemetryReporter
+                    from ps_tpu.obs.collector import collect_telemetry
+
+                    self._tel_reporter = TelemetryReporter(
+                        coordinator, f"worker:{worker}",
+                        # bind the CURRENT stats object at call time: a
+                        # reconnect restores/re-points self.transport
+                        lambda: collect_telemetry(self.transport))
+                except Exception:
+                    logging.getLogger(__name__).debug(
+                        "worker telemetry reporter failed to start",
+                        exc_info=True)
 
     def _connect_and_validate(self, addrs, worker, kv) -> None:
         n = len(addrs)
@@ -2126,6 +2188,9 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         return run
 
     def close(self) -> None:
+        if self._tel_reporter is not None:
+            self._tel_reporter.close()
+            self._tel_reporter = None
         try:
             if self._pending_cycles:
                 self.flush()  # land in-flight cycles before the goodbyes

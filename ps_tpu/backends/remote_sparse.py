@@ -33,6 +33,7 @@ from __future__ import annotations
 
 import math
 import threading
+import time as _ptime
 from typing import Any, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -224,9 +225,22 @@ class SparsePSService(VanService):
                 "pull_qps": None,  # reads don't advance a sparse counter
             }
 
+        # fleet telemetry: this service's OWN stats ride the reports as
+        # delta-encoded snapshots (see AsyncPSService._join_coordinator)
+        from ps_tpu.config import env_flag
+        from ps_tpu.obs.collector import collect_telemetry
+
+        telemetry = None
+        if env_flag("PS_TELEMETRY", True):
+            def telemetry() -> dict:
+                return collect_telemetry(self.transport, counters={
+                    "ps_applies_total": lambda: self.apply_log.total,
+                })
+
         self._coord_member = CoordinatorMember(
             self._coordinator, f"{advertise_host}:{self.port}",
-            key_bytes, kind="sparse", report=report_extra)
+            key_bytes, kind="sparse", report=report_extra,
+            telemetry=telemetry)
         self.table_epoch = self._coord_member.table.epoch
 
     def stop(self, grace: float = 10.0) -> None:
@@ -304,7 +318,14 @@ class SparsePSService(VanService):
         if not todo:
             # push_pull with no rows for this server: nothing applied
             return None, False
-        with self._lock:
+        # per-step breakdown phase tagging (ps_tpu/obs/breakdown.py):
+        # the apply — lock wait included — lands in the always-on
+        # ps_server_apply_seconds histogram; a traced request also gets
+        # a server_apply child span. Dedup replays record nothing.
+        t_apply = _ptime.perf_counter()
+        apply_s = None
+        with obs.tracer().child("server_apply", cat="server"), \
+                self._lock:
             if pseq is not None:
                 last = self._applied_pseq.get(worker)
                 if (last is not None and last[0] == pnonce
@@ -324,6 +345,7 @@ class SparsePSService(VanService):
                 self._tables[name].push(ids, grads)
                 self.versions[name] += 1
                 self.rows_applied[name] += int(ids.size)
+            apply_s = _ptime.perf_counter() - t_apply
             if pseq is not None:
                 self._applied_pseq[worker] = (pnonce, int(pseq),
                                               list(pfan or []))
@@ -333,6 +355,8 @@ class SparsePSService(VanService):
             rseq = self._replicate("push", worker, wire, {  # pslint: disable=PSL101 -- deliberate backpressure: a full ack window MUST stall commits under the apply lock (that IS the bounded-lag contract), and stall_timeout degrades a corpse instead of wedging
                 "pseq": pseq, "pnonce": pnonce, "pfan": pfan,
             })
+        if apply_s is not None:
+            self.transport.record_apply(apply_s)
         return rseq, False
 
     def _admit_while_paused(self, worker: int) -> bool:

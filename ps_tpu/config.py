@@ -62,6 +62,21 @@ Environment variables honored by :meth:`Config.from_env`:
   auto rebalance fires (default 2.0)
 - ``PS_REBALANCE_REPORT_MS`` — load-report cadence the coordinator hands
   registering members (default 1000)
+- ``PS_TELEMETRY``           — fleet telemetry (ps_tpu/obs, README "Fleet
+  telemetry"): '0' stops members piggybacking delta-encoded metric
+  snapshots on their coordinator reports AND stops the coordinator
+  ingesting/evaluating them (default on; without a coordinator the knob
+  is moot — telemetry only ever rides the COORD_REPORT cadence)
+- ``PS_TELEMETRY_WINDOW_S``  — default query/signal window in seconds for
+  fleet quantiles, straggler scoring, and the breakdown (default 30)
+- ``PS_TELEMETRY_RING``      — coordinator-side samples retained per
+  (member, metric) series (default 256 — ~4 min at the 1 s report cadence)
+- ``PS_TELEMETRY_STRAGGLER_Z`` — leave-one-out z-score threshold before a
+  member is flagged ``straggler_suspect`` (default 3.0)
+- ``PS_SLO_RULES``           — ';'-separated SLO rules the coordinator
+  evaluates over fleet telemetry, e.g. ``push p99 < 10ms over 30s``
+  (unset = no rules; breaches fire ``slo_breach`` flight events and the
+  ``ps_slo_breach_total`` counter)
 - ``PS_TRACE_SAMPLE``        — distributed-tracing sample rate in [0, 1]
   (ps_tpu/obs: 0 = off, the default — the unsampled path costs nothing)
 - ``PS_TRACE_DIR``           — directory for trace exports and flight-
@@ -193,6 +208,25 @@ class Config:
       rebalance_report_ms: cadence of the load reports (keys, bytes,
         push/pull QPS) each member streams to the coordinator — the
         skew signal's freshness (default 1000).
+      telemetry: fleet telemetry (README "Fleet telemetry") — members
+        piggyback delta-encoded metric snapshots (counters, gauges, RAW
+        log2 histogram buckets) on their coordinator load reports, and
+        the coordinator merges them into true fleet quantiles, the
+        per-step breakdown, straggler detection, and SLO evaluation.
+        On by default; costs nothing without a coordinator, and a dead
+        coordinator degrades every member to local-only observability
+        with the data plane untouched.
+      telemetry_window_s: the default window (seconds) for fleet
+        quantile queries, straggler scoring, and SLO burn windows.
+      telemetry_ring: coordinator-side sample-ring bound per (member,
+        metric) — the whole tsdb's memory ceiling.
+      telemetry_straggler_z: leave-one-out z-score threshold on a
+        member's window-mean latency before it is flagged a
+        ``straggler_suspect`` (and a rebalance hint is published).
+      slo_rules: ``;``-separated declarative SLO rules evaluated in the
+        coordinator loop — ``"<metric> p99 < 10ms over 30s"`` with
+        metric one of push/pull/push_pull/cycle/bucket/apply/ack/flush
+        or a full ``ps_*_seconds`` histogram name. None = no rules.
       trace_sample: distributed-tracing sample rate in [0, 1] (README
         "Observability"; ps_tpu/obs). A sampled worker op propagates its
         trace context in the van frame headers, so the whole
@@ -291,6 +325,14 @@ class Config:
     rebalance_auto: bool = False
     rebalance_max_skew: float = 2.0
     rebalance_report_ms: int = 1000
+    # fleet telemetry (ps_tpu/obs/tsdb.py, README "Fleet telemetry"):
+    # delta-encoded metric snapshots on the report cadence, merged
+    # coordinator-side into true fleet quantiles + straggler/SLO signals
+    telemetry: bool = True
+    telemetry_window_s: float = 30.0
+    telemetry_ring: int = 256
+    telemetry_straggler_z: float = 3.0
+    slo_rules: Optional[str] = None
     # observability (ps_tpu/obs, README "Observability"): trace sampling
     # (0 = off), trace/flight output dir, the opt-in /metrics endpoint,
     # and the flight-recorder ring size. apply_obs() pushes these into
@@ -411,6 +453,18 @@ class Config:
             )
         if self.rebalance_report_ms < 1:
             raise ValueError("rebalance_report_ms must be >= 1")
+        if self.telemetry_window_s <= 0:
+            raise ValueError("telemetry_window_s must be > 0")
+        if self.telemetry_ring < 2:
+            raise ValueError("telemetry_ring must be >= 2 (a window "
+                             "needs a baseline sample)")
+        if self.telemetry_straggler_z <= 0:
+            raise ValueError("telemetry_straggler_z must be > 0")
+        if self.slo_rules:
+            from ps_tpu.obs.slo import parse_rules
+
+            parse_rules(self.slo_rules)  # a bad rule fails at config
+            # time, loudly — not silently at the coordinator mid-run
         if not (0.0 <= self.trace_sample <= 1.0):
             raise ValueError(
                 f"trace_sample {self.trace_sample} outside [0, 1]")
@@ -532,6 +586,19 @@ class Config:
             kwargs["rebalance_max_skew"] = float(env["PS_REBALANCE_MAX_SKEW"])
         if "PS_REBALANCE_REPORT_MS" in env:
             kwargs["rebalance_report_ms"] = int(env["PS_REBALANCE_REPORT_MS"])
+        if "PS_TELEMETRY" in env:
+            kwargs["telemetry"] = env_flag("PS_TELEMETRY", True)
+        if "PS_TELEMETRY_WINDOW_S" in env:
+            kwargs["telemetry_window_s"] = float(
+                env["PS_TELEMETRY_WINDOW_S"])
+        if "PS_TELEMETRY_RING" in env:
+            kwargs["telemetry_ring"] = int(env["PS_TELEMETRY_RING"])
+        if "PS_TELEMETRY_STRAGGLER_Z" in env:
+            kwargs["telemetry_straggler_z"] = float(
+                env["PS_TELEMETRY_STRAGGLER_Z"])
+        if "PS_SLO_RULES" in env:
+            # "" explicitly selects no rules
+            kwargs["slo_rules"] = env["PS_SLO_RULES"] or None
         if "PS_TRACE_SAMPLE" in env:
             kwargs["trace_sample"] = float(env["PS_TRACE_SAMPLE"] or 0)
         if "PS_TRACE_DIR" in env:

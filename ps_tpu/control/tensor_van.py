@@ -99,6 +99,13 @@ MIGRATE_COMMIT = 27   # donor -> recipient: cut over — the recipient
 #                       installs the staged rows and starts serving them
 MIGRATE_ABORT = 28    # donor -> recipient: discard the staged range
 #                       (the move failed; the donor keeps serving)
+# fleet telemetry (ps_tpu/obs/tsdb.py, served by the elastic coordinator):
+# members ship delta-encoded metric snapshots on the COORD_REPORT cadence;
+# this kind is the QUERY side — windowed fleet quantiles computed from
+# losslessly merged raw log2 histogram buckets (never averaged
+# percentiles), the per-step critical-path breakdown, straggler suspects,
+# and SLO rule states (tools/ps_top.py --fleet, tools/ps_doctor.py)
+COORD_TELEMETRY = 29  # -> coordinator: fleet telemetry query/report
 
 #: human names per kind — span labels (ps_tpu/obs/trace.py), ps_top, and
 #: flight-recorder events all resolve through here so a new kind gets a
@@ -116,7 +123,7 @@ KIND_NAMES = {
     COORD_REPORT: "coord_report", COORD_REBALANCE: "coord_rebalance",
     MIGRATE_OUT: "migrate_out", MIGRATE_BEGIN: "migrate_begin",
     MIGRATE_ROW: "migrate_row", MIGRATE_COMMIT: "migrate_commit",
-    MIGRATE_ABORT: "migrate_abort",
+    MIGRATE_ABORT: "migrate_abort", COORD_TELEMETRY: "coord_telemetry",
 }
 
 

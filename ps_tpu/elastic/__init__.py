@@ -19,13 +19,22 @@ Pieces:
 - :class:`~ps_tpu.elastic.member.CoordinatorMember` /
   :func:`~ps_tpu.elastic.member.fetch_table` /
   :func:`~ps_tpu.elastic.member.request_rebalance` — the member/worker/
-  operator clients.
+  operator clients;
+- fleet telemetry (README "Fleet telemetry"): members piggyback
+  delta-encoded metric snapshots on their reports
+  (:class:`~ps_tpu.elastic.member.TelemetryReporter` for processes that
+  report without registering), the coordinator merges raw histogram
+  buckets into true fleet quantiles + straggler/SLO signals, and
+  :func:`~ps_tpu.elastic.member.fetch_telemetry` is the query round trip
+  behind ``ps_top --fleet`` and ``ps_doctor``.
 """
 
 from ps_tpu.elastic.coordinator import Coordinator
 from ps_tpu.elastic.member import (
     CoordinatorMember,
+    TelemetryReporter,
     fetch_table,
+    fetch_telemetry,
     fetch_view,
     parse_coord,
     request_rebalance,
@@ -35,6 +44,7 @@ from ps_tpu.elastic.table import ShardTable, plan_moves, skew
 
 __all__ = [
     "Coordinator", "CoordinatorMember", "MigrationError",
-    "MigrationSession", "ShardTable", "fetch_table", "fetch_view",
-    "parse_coord", "plan_moves", "request_rebalance", "skew",
+    "MigrationSession", "ShardTable", "TelemetryReporter", "fetch_table",
+    "fetch_telemetry", "fetch_view", "parse_coord", "plan_moves",
+    "request_rebalance", "skew",
 ]

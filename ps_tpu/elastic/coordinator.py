@@ -20,6 +20,19 @@ what the data plane actually needs from it:
   key-range migration (``MIGRATE_OUT``), committing one table epoch per
   move. Workers re-route on the typed stale-table refusal and re-fetch
   here; nothing restarts and nothing pauses globally.
+- **fleet telemetry** (README "Fleet telemetry"): reports carry
+  delta-encoded metric snapshots — counters, gauges, and RAW log2
+  histogram buckets — decoded per member into a bounded time-series ring
+  (:class:`~ps_tpu.obs.tsdb.FleetTSDB`). Because raw buckets merge
+  losslessly, the coordinator computes TRUE fleet p50/p99/p999 (never
+  averaged percentiles), serves them on its /metrics endpoint as
+  fleet-labeled series, answers ``COORD_TELEMETRY`` queries (``ps_top
+  --fleet``, ``ps_doctor``) with windowed quantiles + the per-step
+  breakdown, and runs two signals on the report cadence: windowed
+  leave-one-out z-score straggler detection (a ``straggler_suspect``
+  flight event plus a rebalance HINT next to the byte-skew trigger) and
+  the declarative SLO rule set (``slo_rules`` — "push p99 < 10ms over
+  30s" — firing ``slo_breach`` events and ``ps_slo_breach_total``).
 
 The coordinator is deliberately OFF the data path: a dead coordinator
 stops rebalances and new joins, never traffic — workers keep their last
@@ -75,11 +88,34 @@ class Coordinator(VanService):
         rebalance fires (``Config.rebalance_max_skew``).
       report_ms: the load-report cadence handed to registering members
         (``Config.rebalance_report_ms``).
+      telemetry: ingest members' delta-encoded metric snapshots and run
+        the straggler/SLO signals (``Config.telemetry`` / PS_TELEMETRY;
+        None reads the env, default on). Off = PR 5-style local-only
+        observability everywhere, zero coordinator-side state.
+      telemetry_window_s / telemetry_ring: the default query window and
+        the per-(member, metric) sample-ring bound
+        (``Config.telemetry_window_s`` / ``Config.telemetry_ring``).
+      straggler_z: leave-one-out z-score threshold for straggler
+        suspicion (``Config.telemetry_straggler_z``).
+      slo_rules: ``;``-separated SLO rule lines (``Config.slo_rules`` /
+        PS_SLO_RULES), e.g. ``"push p99 < 10ms over 30s"``.
     """
 
     def __init__(self, port: int = 0, bind: str = "127.0.0.1",
                  hb_timeout_ms: int = 2000, auto: bool = False,
-                 max_skew: float = 2.0, report_ms: int = 1000):
+                 max_skew: float = 2.0, report_ms: int = 1000,
+                 telemetry: Optional[bool] = None,
+                 telemetry_window_s: Optional[float] = None,
+                 telemetry_ring: Optional[int] = None,
+                 straggler_z: Optional[float] = None,
+                 slo_rules: Optional[str] = None):
+        import os
+
+        from ps_tpu.config import Config, env_flag
+        from ps_tpu.obs.slo import SloEvaluator, parse_rules
+        from ps_tpu.obs.straggler import StragglerDetector
+        from ps_tpu.obs.tsdb import FleetTSDB
+
         self._tlock = threading.Lock()
         self._table = ShardTable(0, [], {})
         self._members: List[_Member] = []   # index == shard index
@@ -93,7 +129,50 @@ class Coordinator(VanService):
         self.moves_done = 0
         self.hb = HeartbeatServer(port=0, timeout_ms=hb_timeout_ms,
                                   bind=bind)
+        # fleet telemetry (ps_tpu/obs): the tsdb, one delta decoder per
+        # reporting uri, and the straggler/SLO signals evaluated on the
+        # report cadence (throttled). None knobs read the PS_* env so
+        # launchers that only construct Coordinator(port) get defaults.
+        # None knobs resolve exactly like Config.from_env would: same env
+        # spellings, same strict parse (a bad value raises here, not at
+        # 3am), and the DEFAULTS come from the Config dataclass fields —
+        # one source of truth, covered by the pslint four-way knob sync
+        fields = Config.__dataclass_fields__
+
+        def _env(name: str, field: str, cast):
+            v = os.environ.get(name)
+            if v is None or not v.strip():
+                return fields[field].default
+            return cast(v)
+
+        self.telemetry = (env_flag("PS_TELEMETRY",
+                                   fields["telemetry"].default)
+                          if telemetry is None else bool(telemetry))
+        if telemetry_window_s is None:
+            telemetry_window_s = _env("PS_TELEMETRY_WINDOW_S",
+                                      "telemetry_window_s", float)
+        if telemetry_ring is None:
+            telemetry_ring = _env("PS_TELEMETRY_RING",
+                                  "telemetry_ring", int)
+        if straggler_z is None:
+            straggler_z = _env("PS_TELEMETRY_STRAGGLER_Z",
+                               "telemetry_straggler_z", float)
+        if slo_rules is None:
+            slo_rules = os.environ.get("PS_SLO_RULES") or None
+        self.tsdb = FleetTSDB(window_s=float(telemetry_window_s),
+                              ring=int(telemetry_ring))
+        self._decoders: Dict[str, object] = {}
+        self.straggler = StragglerDetector(self.tsdb,
+                                           z=float(straggler_z))
+        self.slo = SloEvaluator(self.tsdb, parse_rules(slo_rules))
+        self._eval_every_s = max(min(1.0, self.tsdb.window_s / 4.0), 0.05)
+        self._last_eval = 0.0
+        self._slo_states: list = []
         reg = obs.default_registry()
+        if self.telemetry:
+            # fleet-labeled series ride this process's /metrics scrape;
+            # held weakly by the registry, removed explicitly at stop()
+            reg.add_exporter(self.tsdb.render_prometheus)
         self._m_moves = reg.counter("ps_rebalance_moves_total",
                                     "committed key-range moves")
         self._m_keys = reg.counter("ps_rebalance_keys_total",
@@ -146,10 +225,13 @@ class Coordinator(VanService):
                 return tv.encode(tv.ERR, worker, None,
                                  extra={"error": repr(e)})
             return tv.encode(tv.OK, worker, None, extra=out)
+        elif kind == tv.COORD_TELEMETRY:
+            return self._telemetry_reply(worker, extra or {})
         elif kind == tv.STATS:
             out = {"role": self.role, "members": self._members_view(),
                    "table": self._table.to_wire(),
-                   "moves_done": self.moves_done}
+                   "moves_done": self.moves_done,
+                   "hints": self.hints(), "slo": list(self._slo_states)}
             return tv.encode(tv.OK, worker, None, extra=out)
         return tv.encode(tv.ERR, worker, None,
                          extra={"error": f"bad kind {kind}"})
@@ -160,10 +242,15 @@ class Coordinator(VanService):
     def stop(self, grace: float = 10.0) -> None:
         super().stop(grace=grace)
         self.hb.close()
+        # deterministic for in-process fleets (tests, notebooks): a
+        # stopped coordinator's fleet series leave the scrape NOW, not
+        # at the next garbage collection
+        obs.default_registry().remove_exporter(self.tsdb.render_prometheus)
 
     def kill(self) -> None:
         super().kill()
         self.hb.close()
+        obs.default_registry().remove_exporter(self.tsdb.render_prometheus)
 
     # -- membership ------------------------------------------------------------
 
@@ -266,6 +353,21 @@ class Coordinator(VanService):
 
     def _report(self, worker: int, extra: dict) -> bytes:
         uri = str(extra.get("uri"))
+        reply: dict = {}
+        if self.telemetry and extra.get("telemetry") is not None:
+            # telemetry rides EVERY report, registered member or not:
+            # workers (TelemetryReporter) never register a key range but
+            # their op/flush/wire histograms are the breakdown's worker
+            # phases. Unknown URIs stay out of membership views — the
+            # tsdb keys by uri, the straggler scorer by server members.
+            from ps_tpu.obs.collector import DeltaDecoder
+
+            dec = self._decoders.setdefault(uri, DeltaDecoder())
+            cum = dec.ingest(extra["telemetry"])
+            if cum is None:
+                reply["telemetry_resync"] = True
+            else:
+                self.tsdb.ingest(uri, cum)
         with self._tlock:
             member = next((m for m in self._members if m.uri == uri), None)
             if member is not None:
@@ -286,10 +388,12 @@ class Coordinator(VanService):
                             k: max(1, v * total // old)
                             for k, v in member.key_bytes.items()}
         self._note_dead_members()
+        if self.telemetry:
+            self._maybe_evaluate()
         if self.auto and member is not None:
             self._maybe_auto_rebalance()
-        return tv.encode(tv.OK, worker, None,
-                         extra={"epoch": self._table.epoch})
+        reply["epoch"] = self._table.epoch
+        return tv.encode(tv.OK, worker, None, extra=reply)
 
     def _members_view(self) -> List[dict]:
         """The membership/liveness rows ps_top renders: per member, the
@@ -318,7 +422,99 @@ class Coordinator(VanService):
         # the table lock anyway)
         return {"table": table.to_wire(),
                 "members": self._members_view(),
-                "migration": mig}
+                "migration": mig,
+                "hints": self.hints()}
+
+    # -- fleet telemetry -------------------------------------------------------
+
+    def _maybe_evaluate(self) -> None:
+        """Run the straggler + SLO passes, throttled to a fraction of the
+        window — reports arrive per member per cadence and the signals
+        only need to move once per window fraction."""
+        now = time.monotonic()
+        with self._tlock:
+            if now - self._last_eval < self._eval_every_s:
+                return
+            self._last_eval = now
+            shards = {m.uri: i for i, m in enumerate(self._members)}
+        try:
+            self.straggler.evaluate(shards)
+            self._slo_states = self.slo.evaluate()
+            # churning ephemeral reporters (workers restart with fresh
+            # ids) must not grow the tsdb/decoder maps without bound
+            for uri in self.tsdb.prune_stale():
+                self._decoders.pop(uri, None)
+        except Exception:
+            logging.getLogger(__name__).warning(
+                "telemetry signal evaluation failed", exc_info=True)
+
+    def _telemetry_reply(self, worker: int, extra: dict) -> bytes:
+        """COORD_TELEMETRY: the fleet view ps_top --fleet / ps_doctor
+        render — windowed fleet quantiles from MERGED raw buckets,
+        per-member window summaries, the per-step breakdown, straggler
+        suspects, SLO states, and rebalance hints."""
+        from ps_tpu.obs.breakdown import breakdown
+
+        if not self.telemetry:
+            return tv.encode(tv.ERR, worker, None, extra={
+                "error": "fleet telemetry is off at this coordinator "
+                         "(telemetry=False / PS_TELEMETRY=0)"})
+        w = extra.get("window_s")
+        w = None if w is None else float(w)
+        fleet: Dict[str, dict] = {}
+        counters: Dict[str, dict] = {}
+        per_member: Dict[str, dict] = {}
+        for metric in self.tsdb.metrics():
+            win = self.tsdb.fleet_window(metric, w)
+            if not win:
+                continue
+            if win["k"] == "hist" and "summary" in win:
+                fleet[metric] = win["summary"]
+            elif win["k"] == "counter":
+                counters[metric] = {"delta": win["delta"]}
+            # per-member rows ride the same pass: fleet_window already
+            # computed every member's window to merge it
+            for m, mw in win["per_member"].items():
+                if mw.get("summary"):
+                    per_member.setdefault(m, {})[metric] = mw["summary"]
+        with self._tlock:
+            shards = {m.uri: i for i, m in enumerate(self._members)}
+        return tv.encode(tv.OK, worker, None, extra={
+            "window_s": self.tsdb.window_s if w is None else w,
+            "members": self.tsdb.members(),
+            "shards": shards,
+            "fleet": fleet,
+            "counters": counters,
+            "per_member": per_member,
+            "breakdown": breakdown(lambda name: fleet.get(name)),
+            "stragglers": self.straggler.suspects(),
+            "slo": list(self._slo_states),
+            "hints": self.hints(),
+        })
+
+    def hints(self) -> List[dict]:
+        """Current rebalance hints: straggler suspects (latency outliers
+        the byte-balancer cannot see) NEXT TO the byte-skew trigger the
+        auto-rebalancer fires on — one place an operator reads both."""
+        out: List[dict] = list(self.straggler.hints()) \
+            if self.telemetry else []
+        with self._tlock:
+            dense = {i: m.nbytes for i, m in enumerate(self._members)
+                     if m.kind != "sparse"}
+        if len(dense) >= 2:
+            s = skew(dense)
+            if s > self.max_skew:
+                out.append({
+                    "kind": "byte_skew", "skew": round(s, 2),
+                    "max_skew": self.max_skew,
+                    "action": (f"byte skew {s:.2f} exceeds "
+                               f"rebalance_max_skew={self.max_skew} — "
+                               f"a rebalance would level the shards"
+                               + ("" if self.auto else
+                                  " (rebalance_auto is off: trigger one "
+                                  "explicitly)")),
+                })
+        return out
 
     def _note_dead_members(self) -> None:
         """Flight-record each member death ONCE (lazy, on report/table
@@ -552,6 +748,7 @@ class Coordinator(VanService):
                         f"drain moves them first")
             keep = [i for i in range(len(self._members)) if i not in drained]
             remap = {old: new for new, old in enumerate(keep)}
+            dropped_uris = [self._members[i].uri for i in drained]
             self._members = [self._members[i] for i in keep]
             self._table = ShardTable(
                 table.epoch + 1,
@@ -559,4 +756,10 @@ class Coordinator(VanService):
                 {k: remap[s] for k, s in table.assign.items()},
             )
             epoch = self._table.epoch
+        for uri in dropped_uris:
+            # a drained member's series end here — its ring would only
+            # age into the 3x-window staleness cutoff anyway, but memory
+            # bounds should not depend on cutoffs
+            self.tsdb.drop_member(uri)
+            self._decoders.pop(uri, None)
         obs.record_event("coord_drain", shards=drained, epoch=epoch)

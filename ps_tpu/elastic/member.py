@@ -15,6 +15,14 @@ without the coordinator ever being on its data path:
   stale-table refusal tells them the assignment moved).
 - :func:`request_rebalance` — the operator/bench entry point for
   ``COORD_REBALANCE``.
+- fleet telemetry (README "Fleet telemetry"): a member constructed with
+  a ``telemetry`` state source piggybacks delta-encoded metric snapshots
+  (ps_tpu/obs/collector.py) on its load reports, re-baselining whenever
+  the coordinator answers ``telemetry_resync``; :class:`TelemetryReporter`
+  is the standalone form for processes that report WITHOUT registering
+  (workers); :func:`fetch_telemetry` is the ``COORD_TELEMETRY`` query
+  round trip (``ps_top --fleet``, ``ps_doctor``). A dead coordinator
+  silences all three without touching the data plane.
 """
 
 from __future__ import annotations
@@ -27,8 +35,9 @@ from typing import Callable, Dict, Optional, Tuple, Union
 from ps_tpu.control import tensor_van as tv
 from ps_tpu.elastic.table import ShardTable
 
-__all__ = ["CoordinatorMember", "fetch_table", "fetch_view",
-           "request_rebalance", "parse_coord"]
+__all__ = ["CoordinatorMember", "TelemetryReporter", "fetch_table",
+           "fetch_view", "fetch_telemetry", "request_rebalance",
+           "parse_coord"]
 
 
 def parse_coord(addr: Union[str, Tuple[str, int]]) -> Tuple[str, int]:
@@ -92,6 +101,19 @@ def fetch_table(addr, cover=None, min_epoch: Optional[int] = None,
         time.sleep(0.05)
 
 
+def fetch_telemetry(addr, window_s: Optional[float] = None,
+                    timeout_ms: int = 5000) -> dict:
+    """One ``COORD_TELEMETRY`` round trip: the coordinator's fleet view —
+    merged-raw-bucket fleet quantiles over the window, per-member window
+    summaries, the per-step breakdown table, straggler suspects, SLO rule
+    states, and rebalance hints."""
+    extra: Dict[str, object] = {}
+    if window_s is not None:
+        extra["window_s"] = float(window_s)
+    return _coord_request(addr, tv.COORD_TELEMETRY, extra=extra,
+                          timeout_ms=timeout_ms)
+
+
 def request_rebalance(addr, moves=None, targets=None, drain=None,
                       timeout_ms: int = 600_000) -> dict:
     """Ask the coordinator to rebalance (explicit ``moves``, a ``targets``
@@ -111,12 +133,21 @@ def request_rebalance(addr, moves=None, targets=None, drain=None,
 
 
 class CoordinatorMember:
-    """One serving shard's standing with the coordinator."""
+    """One serving shard's standing with the coordinator.
+
+    ``telemetry`` is an optional zero-arg callable returning this
+    member's CUMULATIVE metric state (``ps_tpu.obs.collect_telemetry``
+    over the service's own ``TransportStats``): each load report carries
+    a delta-encoded snapshot, and a ``telemetry_resync`` in the reply
+    (coordinator restarted / report lost) makes the next one a full
+    re-baseline. Telemetry failing — encode, wire, anything — degrades
+    to plain load reports, never the member."""
 
     def __init__(self, coord: Union[str, Tuple[str, int]], uri: str,
                  key_bytes: Dict[str, int], kind: str = "dense",
                  report: Optional[Callable[[], dict]] = None,
-                 report_ms: Optional[int] = None):
+                 report_ms: Optional[int] = None,
+                 telemetry: Optional[Callable[[], dict]] = None):
         from ps_tpu.control.heartbeat import HeartbeatClient
 
         self.coord = parse_coord(coord)
@@ -131,11 +162,16 @@ class CoordinatorMember:
         self._report_fn = report
         self._report_ms = int(report_ms if report_ms is not None
                               else extra.get("report_ms", 1000))
+        self._tel = None
+        if telemetry is not None:
+            from ps_tpu.obs.collector import DeltaEncoder
+
+            self._tel = DeltaEncoder(telemetry)
         self._hb = HeartbeatClient(self.coord[0], int(extra["hb_port"]),
                                    node_id=self.node)
         self._stop = threading.Event()
         self._t: Optional[threading.Thread] = None
-        if report is not None:
+        if report is not None or telemetry is not None:
             self._t = threading.Thread(target=self._report_loop,
                                        daemon=True,
                                        name="ps-coord-report")
@@ -144,9 +180,23 @@ class CoordinatorMember:
     def _report_loop(self) -> None:
         while not self._stop.wait(self._report_ms / 1e3):
             try:
-                extra = dict(self._report_fn() or {})
+                extra = dict(self._report_fn() or {}) \
+                    if self._report_fn is not None else {}
                 extra["uri"] = self.uri
-                _coord_request(self.coord, tv.COORD_REPORT, extra=extra)
+                if self._tel is not None:
+                    try:
+                        snap = self._tel.snapshot()
+                        if snap is not None:
+                            extra["telemetry"] = snap
+                    except Exception:
+                        logging.getLogger(__name__).debug(
+                            "telemetry snapshot failed", exc_info=True)
+                extra = _coord_request(self.coord, tv.COORD_REPORT,
+                                       extra=extra)
+                if self._tel is not None and extra.get("telemetry_resync"):
+                    # the coordinator holds no baseline for our deltas
+                    # (restart, dropped report): ship absolutes next time
+                    self._tel.force_full()
             except Exception:
                 # a dead coordinator must never take a serving shard's
                 # reporter thread down with a crash loop — log once per
@@ -160,3 +210,51 @@ class CoordinatorMember:
         if self._t is not None:
             self._t.join(timeout=5)
         self._hb.close(goodbye=goodbye)
+
+
+class TelemetryReporter:
+    """Telemetry WITHOUT membership: a daemon thread shipping one
+    process's delta-encoded metric snapshots as COORD_REPORT frames.
+
+    Workers (and any observer process) use this — they never register a
+    key range or beat the heartbeat monitor, but their flush-wait / wire
+    / op-latency histograms are exactly the phases the fleet's per-step
+    breakdown needs. The coordinator ingests unknown-URI telemetry into
+    its tsdb while keeping such reporters out of server-only views
+    (membership, straggler scoring). Every failure path is swallowed:
+    telemetry is strictly additive to the data plane."""
+
+    def __init__(self, coord: Union[str, Tuple[str, int]], uri: str,
+                 collect: Callable[[], dict], kind: str = "worker",
+                 report_ms: int = 1000):
+        from ps_tpu.obs.collector import DeltaEncoder
+
+        self.coord = parse_coord(coord)
+        self.uri = uri
+        self.kind = kind
+        self._tel = DeltaEncoder(collect)
+        self._report_ms = int(report_ms)
+        self._stop = threading.Event()
+        self._t = threading.Thread(target=self._loop, daemon=True,
+                                   name="ps-telemetry-report")
+        self._t.start()
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self._report_ms / 1e3):
+            try:
+                snap = self._tel.snapshot()
+                if snap is None:
+                    continue  # nothing moved: silence is free
+                extra = {"uri": self.uri, "kind": self.kind,
+                         "telemetry": snap}
+                extra = _coord_request(self.coord, tv.COORD_REPORT,
+                                       extra=extra)
+                if extra.get("telemetry_resync"):
+                    self._tel.force_full()
+            except Exception:
+                logging.getLogger(__name__).debug(
+                    "telemetry report failed", exc_info=True)
+
+    def close(self) -> None:
+        self._stop.set()
+        self._t.join(timeout=5)

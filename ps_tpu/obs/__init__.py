@@ -19,6 +19,13 @@ Three layers, each usable alone, wired through every transport hot path:
   typed events (failover, degrade, stale epoch, shm spill, reconnect,
   self-fence, promotion, peer death) dumped to JSONL on unhandled
   VanError, SIGUSR2, or on demand — the black box of a 3am shard death.
+- **Fleet telemetry** (:mod:`ps_tpu.obs.tsdb` / ``collector`` /
+  ``breakdown`` / ``straggler`` / ``slo``, README "Fleet telemetry"):
+  members ship delta-encoded metric snapshots — raw log2 histogram
+  buckets, losslessly mergeable — on the coordinator report cadence;
+  the coordinator's bounded time-series ring answers fleet-quantile /
+  breakdown queries (``COORD_TELEMETRY``, ``ps_top --fleet``,
+  ``ps_doctor``) and runs straggler + SLO signals.
 
 This module owns the per-process singletons; ``tracer()`` and
 ``flight()`` configure themselves from the environment on first use, and
@@ -32,8 +39,13 @@ import threading
 from typing import Optional
 
 from ps_tpu.obs import trace as trace  # noqa: F401 — re-export the module
+from ps_tpu.obs.breakdown import PHASES, TraceBreakdown, breakdown
 from ps_tpu.obs.clock import ClockSync
+from ps_tpu.obs.collector import DeltaDecoder, DeltaEncoder, collect_telemetry
 from ps_tpu.obs.flight import FlightRecorder
+from ps_tpu.obs.slo import SloEvaluator, SloRule, parse_rules
+from ps_tpu.obs.straggler import StragglerDetector
+from ps_tpu.obs.tsdb import FleetTSDB
 from ps_tpu.obs.http import (
     MetricsServer,
     start_metrics_server,
@@ -63,6 +75,10 @@ __all__ = [
     "MetricsServer", "start_metrics_server", "stop_metrics_server",
     "FlightRecorder", "flight", "record_event",
     "ClockSync", "configure",
+    # fleet telemetry (the coordinator-hosted aggregation pipeline)
+    "FleetTSDB", "DeltaEncoder", "DeltaDecoder", "collect_telemetry",
+    "StragglerDetector", "SloEvaluator", "SloRule", "parse_rules",
+    "breakdown", "TraceBreakdown", "PHASES",
 ]
 
 _lock = threading.Lock()

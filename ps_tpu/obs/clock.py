@@ -13,6 +13,22 @@ be wrong — the same min-RTT filter NTP uses). On loopback this lands
 within tens of microseconds; across hosts it is bounded by the path
 asymmetry, which is exactly the bound any software clock sync has.
 
+Two hardenings for long runs (fleet-telemetry PR):
+
+- **degenerate min-RTT ties**: on coarse clocks (sandboxed kernels,
+  virtualized TSCs) many probes report the SAME minimum RTT; picking the
+  first arbitrary winner keeps whatever jitter that one probe carried.
+  When several probes tie within ``tie_us`` of the minimum, the applied
+  offset is the MEDIAN of the tied probes' offsets — the tie set is
+  exactly the probes whose midpoint assumption is equally good, so the
+  median de-noises instead of gambling.
+- **TTL re-probe**: clocks DRIFT (tens of ppm is normal — milliseconds
+  per minute across a fleet), so an offset estimated once at connect
+  goes stale mid-run and cross-member breakdowns silently skew. Give the
+  sync a ``ttl_s`` and call :meth:`ensure_fresh` wherever the channel is
+  already in hand (export time, probe loops); it re-probes only when the
+  estimate aged past the TTL.
+
 Usage: ``off = ClockSync().probe(channel)`` at the worker, then
 ``tracer.clock_offset_us = off`` before ``export_chrome`` — every
 process exports in the REFERENCE server's clock and
@@ -22,18 +38,31 @@ process exports in the REFERENCE server's clock and
 from __future__ import annotations
 
 import time
-from typing import Optional
+from typing import List, Optional, Tuple
 
 __all__ = ["ClockSync"]
 
 
 class ClockSync:
-    """Min-RTT NTP-style offset estimator over a van channel."""
+    """Min-RTT NTP-style offset estimator over a van channel.
 
-    def __init__(self):
+    Args:
+      ttl_s: estimate lifetime for :meth:`ensure_fresh` (None = never
+        auto-re-probe — the one-shot connect-time behavior).
+      tie_us: RTT band above the minimum within which probes count as
+        tied; the applied offset is the median over the tie set.
+    """
+
+    def __init__(self, ttl_s: Optional[float] = None,
+                 tie_us: float = 50.0):
+        self.ttl_s = None if ttl_s is None else float(ttl_s)
+        self.tie_us = float(tie_us)
         self.offset_us: Optional[float] = None  # add to local ts → server ts
         self.rtt_us: Optional[float] = None     # best probe's round trip
         self.probes = 0
+        self.reprobes = 0                       # TTL-triggered re-probes
+        self.probed_at: Optional[float] = None  # monotonic stamp
+        self._samples: List[Tuple[float, float]] = []  # (rtt_us, offset_us)
 
     def observe(self, t_send: float, t_recv: float,
                 t_server: float) -> None:
@@ -41,16 +70,34 @@ class ClockSync:
         bases). Piggyback path: any reply that carries a server ``now``
         can refine the estimate without a dedicated probe."""
         rtt = max(t_recv - t_send, 0.0) * 1e6
+        off = (t_server - (t_send + t_recv) / 2.0) * 1e6
         self.probes += 1
-        if self.rtt_us is None or rtt < self.rtt_us:
-            self.rtt_us = rtt
-            self.offset_us = (t_server - (t_send + t_recv) / 2.0) * 1e6
+        self._samples.append((rtt, off))
+        self._refresh()
+
+    def _refresh(self) -> None:
+        """Re-derive (rtt_us, offset_us) from the sample set: min-RTT
+        winner, except that ties within ``tie_us`` of the minimum vote by
+        median — the degenerate all-min-RTT case (coarse clocks) must not
+        apply one arbitrary probe's jitter as THE offset."""
+        if not self._samples:
+            return
+        best_rtt = min(r for r, _ in self._samples)
+        tied = sorted(o for r, o in self._samples
+                      if r <= best_rtt + self.tie_us)
+        self.rtt_us = best_rtt
+        mid = len(tied) // 2
+        self.offset_us = (tied[mid] if len(tied) % 2
+                          else (tied[mid - 1] + tied[mid]) / 2.0)
 
     def probe(self, ch, worker: int = 0, n: int = 8) -> float:
-        """``n`` REPLICA_STATE round trips on ``ch``; returns the min-RTT
-        offset estimate in µs (also kept in :attr:`offset_us`)."""
+        """``n`` REPLICA_STATE round trips on ``ch``; returns the offset
+        estimate in µs (also kept in :attr:`offset_us`). Each call starts
+        a FRESH sample set — a re-probe must not let a pre-drift sample
+        keep winning on an old, now-wrong low RTT."""
         from ps_tpu.control import tensor_van as tv
 
+        self._samples = []
         for _ in range(max(int(n), 1)):
             t0 = time.time()
             reply = ch.request(tv.encode(tv.REPLICA_STATE, worker, None))
@@ -61,4 +108,24 @@ class ClockSync:
                     "clock probe failed: peer's REPLICA_STATE reply "
                     "carries no 'now' (pre-observability server?)")
             self.observe(t0, t1, float(extra["now"]))
+        self.probed_at = time.monotonic()
+        return self.offset_us
+
+    def fresh(self) -> bool:
+        """True while the estimate is younger than ``ttl_s`` (always True
+        with no TTL configured, False before the first probe)."""
+        if self.probed_at is None:
+            return False
+        if self.ttl_s is None:
+            return True
+        return (time.monotonic() - self.probed_at) < self.ttl_s
+
+    def ensure_fresh(self, ch, worker: int = 0, n: int = 8
+                     ) -> Optional[float]:
+        """Re-probe on ``ch`` iff the estimate is missing or aged past the
+        TTL; returns the (possibly refreshed) offset."""
+        if not self.fresh():
+            if self.probed_at is not None:
+                self.reprobes += 1
+            self.probe(ch, worker=worker, n=n)
         return self.offset_us

@@ -1,0 +1,204 @@
+"""Delta-encoded telemetry snapshots — the member side of fleet telemetry.
+
+Members ship their metric state to the coordinator on the COORD_REPORT
+cadence (ps_tpu/elastic/member.py). Shipping a full snapshot every second
+would put a few KB of mostly-unchanged histogram buckets on the wire per
+member per tick, so the wire form is a DELTA against the last acked
+snapshot:
+
+- counters travel as increments (``{"k": "c", "d": n}``), omitted at 0;
+- gauges are absolute (``{"k": "g", "v": x}``) — a delta of a gauge is
+  noise;
+- histograms travel as SPARSE raw-bucket increments (``{"k": "h",
+  "dc": {bucket_index: dcount}, "dn", "ds", "mx", "mn"}``) — only the
+  buckets that moved. Raw buckets, never percentiles: the coordinator
+  merges them losslessly (ps_tpu/obs/tsdb.py) into true fleet quantiles.
+
+The stream is self-healing: every payload carries a ``seq``; a decoder
+that sees a gap (coordinator restarted, report lost) answers the report
+with ``telemetry_resync`` and the encoder's next payload is a FULL
+snapshot (``"full": True``, absolute values) that rebuilds the baseline.
+A metric appearing mid-stream simply rides its first payload in full
+form — the decoder treats absolute entries as (re)baselines.
+
+:func:`collect_telemetry` is the standard collection source: one endpoint's
+:class:`~ps_tpu.utils.metrics.TransportStats` (its histograms carry prom
+names already) plus any caller-supplied counters/gauges — deliberately
+NOT the process-global registry, so several in-process services (tests,
+co-located shards) each report their OWN numbers.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, Dict, Optional
+
+from ps_tpu.obs.metrics import state_add
+
+__all__ = ["collect_telemetry", "DeltaEncoder", "DeltaDecoder"]
+
+#: TransportStats scalar counters worth shipping fleet-wide, with their
+#: Prometheus-style wire names (histograms carry their own names)
+_STATS_COUNTERS = (
+    ("stale_epochs", "ps_stale_epochs_total"),
+    ("dedup_hits", "ps_dedup_hits_total"),
+    ("failovers", "ps_failovers_total"),
+    ("table_reroutes", "ps_table_reroutes_total"),
+)
+
+
+def collect_telemetry(transport, counters: Optional[Dict[str, Callable]] = None,
+                gauges: Optional[Dict[str, Callable]] = None) -> dict:
+    """One endpoint's cumulative telemetry state: every non-empty
+    histogram of ``transport`` (raw buckets), the standard transport
+    counters, plus caller extras (``{name: zero-arg callable}``)."""
+    out: dict = {}
+    for h in transport.hist.values():
+        if h.total > 0:
+            out[h.name] = {"k": "hist", **h.state()}
+    for attr, name in _STATS_COUNTERS:
+        v = getattr(transport, attr, 0)
+        if v:
+            out[name] = {"k": "counter", "v": int(v)}
+    for name, fn in (counters or {}).items():
+        out[name] = {"k": "counter", "v": int(fn())}
+    for name, fn in (gauges or {}).items():
+        out[name] = {"k": "gauge", "v": float(fn())}
+    return out
+
+
+def _entry_delta(kind: str, now: dict, prev: Optional[dict]):
+    """The wire entry for one metric, or None when nothing moved."""
+    if kind == "gauge":
+        if prev is not None and prev.get("v") == now.get("v"):
+            return None
+        return {"k": "g", "v": now["v"]}
+    if kind == "counter":
+        if prev is None:
+            return {"k": "c", "v": int(now["v"])}
+        d = int(now["v"]) - int(prev["v"])
+        return {"k": "c", "d": d} if d else None
+    # histogram
+    if prev is None:
+        return {"k": "h", "lo": now["lo"], "hi": now["hi"],
+                "c": list(now["c"]), "n": now["n"], "s": now["s"],
+                "mx": now["mx"], "mn": now["mn"]}
+    dn = now["n"] - prev["n"]
+    if dn == 0:
+        return None
+    dc = {i: a - b for i, (a, b) in enumerate(zip(now["c"], prev["c"]))
+          if a != b}
+    return {"k": "h", "dc": dc, "dn": dn, "ds": now["s"] - prev["s"],
+            "mx": now["mx"], "mn": now["mn"]}
+
+
+class DeltaEncoder:
+    """Member side: turn successive cumulative states into wire deltas.
+
+    ``collect`` is a zero-arg callable returning the CURRENT cumulative
+    state (:func:`collect_telemetry` or equivalent). The previous state is only
+    replaced once a snapshot is BUILT — a resync request
+    (:meth:`force_full`) makes the next snapshot absolute.
+    """
+
+    def __init__(self, collect: Callable[[], dict]):
+        self._collect = collect
+        self._lock = threading.Lock()
+        self._prev: Optional[dict] = None
+        self.seq = 0
+
+    def force_full(self) -> None:
+        """Ship absolute values next time (the decoder lost its baseline
+        — coordinator restart, report gap)."""
+        with self._lock:
+            self._prev = None
+
+    def snapshot(self) -> Optional[dict]:
+        """The next wire payload, or None when nothing moved (the report
+        then travels without a telemetry field — silence is free)."""
+        state = self._collect()
+        with self._lock:
+            full = self._prev is None
+            self.seq += 1
+            payload: dict = {"seq": self.seq, "m": {}}
+            if full:
+                payload["full"] = True
+            for name, entry in state.items():
+                kind = entry.get("k", "hist")
+                prev = None if full else (self._prev or {}).get(name)
+                wire = _entry_delta(kind, entry, prev)
+                if wire is not None:
+                    payload["m"][name] = wire
+            self._prev = state
+            if not payload["m"] and not full:
+                self.seq -= 1  # nothing moved: don't burn a seq on silence
+                return None
+            return payload
+
+
+class DeltaDecoder:
+    """Coordinator side: rebuild one member's cumulative state from wire
+    deltas. :meth:`ingest` returns the cumulative ``{metric: {"k": kind,
+    ...}}`` dict ready for :meth:`~ps_tpu.obs.tsdb.FleetTSDB.ingest`, or
+    None when the stream needs a resync (seq gap, delta without a
+    baseline) — the caller then answers the report with
+    ``telemetry_resync: True``."""
+
+    def __init__(self):
+        self._cum: dict = {}
+        self._seq: Optional[int] = None
+
+    def ingest(self, payload: dict) -> Optional[dict]:
+        try:
+            seq = int(payload["seq"])
+            entries = payload.get("m") or {}
+            full = bool(payload.get("full"))
+        except (KeyError, TypeError, ValueError):
+            return None
+        if full:
+            self._cum = {}
+        elif self._seq is None or seq != self._seq + 1:
+            self._seq = None
+            return None  # gap: deltas against a baseline we don't hold
+        self._seq = seq
+        for name, wire in entries.items():
+            k = wire.get("k")
+            if k == "g":
+                self._cum[name] = {"k": "gauge", "v": float(wire["v"])}
+            elif k == "c":
+                if "v" in wire:
+                    self._cum[name] = {"k": "counter",
+                                       "v": int(wire["v"])}
+                else:
+                    cur = self._cum.get(name)
+                    if cur is None:
+                        self._seq = None
+                        return None  # delta for a metric never baselined
+                    cur["v"] = int(cur["v"]) + int(wire["d"])
+            elif k == "h":
+                if "c" in wire:  # full form: absolute buckets
+                    self._cum[name] = {
+                        "k": "hist", "lo": wire["lo"], "hi": wire["hi"],
+                        "c": list(wire["c"]), "n": wire["n"],
+                        "s": wire["s"], "mx": wire["mx"],
+                        "mn": wire.get("mn"),
+                    }
+                else:
+                    cur = self._cum.get(name)
+                    if cur is None or cur.get("k") != "hist":
+                        self._seq = None
+                        return None
+                    counts = list(cur["c"])
+                    # json stringifies int dict keys — accept both
+                    for i, d in (wire.get("dc") or {}).items():
+                        counts[int(i)] += int(d)
+                    self._cum[name] = state_add(None, {
+                        "lo": cur["lo"], "hi": cur["hi"], "c": counts,
+                        "n": cur["n"] + int(wire["dn"]),
+                        "s": cur["s"] + float(wire["ds"]),
+                        "mx": float(wire["mx"]), "mn": wire.get("mn"),
+                    })
+                    self._cum[name]["k"] = "hist"
+        # hand the tsdb an independent copy: rings must not alias a dict
+        # the next delta mutates in place
+        return {name: dict(entry) for name, entry in self._cum.items()}

@@ -29,7 +29,7 @@ import threading
 from typing import Dict, List, Optional, Tuple
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry",
-           "default_registry"]
+           "default_registry", "state_sub", "state_add"]
 
 _NAME_OK = "abcdefghijklmnopqrstuvwxyzABCDEFGHIJKLMNOPQRSTUVWXYZ0123456789_:"
 
@@ -167,6 +167,83 @@ class Histogram:
             out.append((self._upper(k), cum))
         return out
 
+    # -- raw-state export (the fleet-telemetry wire form) ----------------------
+    #
+    # Raw log2 buckets are LOSSLESSLY mergeable: summing two histograms'
+    # count arrays (same geometry) is exactly the histogram of the union
+    # of their samples, so a coordinator that merges members' raw states
+    # computes TRUE fleet quantiles — never the average of per-member
+    # percentiles, which has no statistical meaning at the tail.
+
+    def state(self) -> dict:
+        """Json-ready cumulative state: geometry + raw bucket counts +
+        the moment sums the quantile clamp needs. ``mn`` is None while
+        empty (math.inf does not survive json)."""
+        return {
+            "lo": self.lo, "hi": self.hi, "c": list(self.counts),
+            "n": self.total, "s": self.sum, "mx": self.vmax,
+            "mn": None if math.isinf(self.vmin) else self.vmin,
+        }
+
+    @classmethod
+    def from_state(cls, name: str, st: dict) -> "Histogram":
+        """Rebuild a histogram from :meth:`state` (or a merged/delta state
+        of the same geometry) so quantile/summary logic never forks."""
+        h = cls(name, lo=float(st["lo"]), hi=float(st["hi"]))
+        counts = list(st["c"])
+        if len(counts) != len(h.counts):
+            raise ValueError(
+                f"histogram state for {name!r} carries {len(counts)} "
+                f"buckets but geometry lo={st['lo']} hi={st['hi']} "
+                f"implies {len(h.counts)} — mixed geometries don't merge")
+        h.counts = counts
+        h.total = int(st["n"])
+        h.sum = float(st["s"])
+        h.vmax = float(st.get("mx", 0.0))
+        mn = st.get("mn")
+        h.vmin = math.inf if mn is None else float(mn)
+        return h
+
+
+def _check_geometry(a: dict, b: dict) -> None:
+    if (a["lo"], a["hi"]) != (b["lo"], b["hi"]) \
+            or len(a["c"]) != len(b["c"]):
+        raise ValueError(
+            f"histogram states have differing geometries "
+            f"({a['lo']}/{a['hi']} vs {b['lo']}/{b['hi']}) — "
+            f"raw-bucket merge would misbucket")
+
+
+def state_sub(now: dict, base: dict) -> dict:
+    """``now − base`` for two cumulative histogram states of the same
+    instrument: the raw-bucket delta of a time window. ``mx``/``mn`` stay
+    the cumulative observed range (the window's own extrema are unknowable
+    from cumulative counts) — quantile clamps are merely a hair looser."""
+    _check_geometry(now, base)
+    return {
+        "lo": now["lo"], "hi": now["hi"],
+        "c": [a - b for a, b in zip(now["c"], base["c"])],
+        "n": now["n"] - base["n"], "s": now["s"] - base["s"],
+        "mx": now["mx"], "mn": now["mn"],
+    }
+
+
+def state_add(a: Optional[dict], b: dict) -> dict:
+    """Merge two raw histogram states (summed buckets — the lossless
+    cross-member merge fleet quantiles are computed from). ``a`` may be
+    None (the fold's seed)."""
+    if a is None:
+        return {"lo": b["lo"], "hi": b["hi"], "c": list(b["c"]),
+                "n": b["n"], "s": b["s"], "mx": b["mx"], "mn": b["mn"]}
+    _check_geometry(a, b)
+    mn = [x for x in (a.get("mn"), b.get("mn")) if x is not None]
+    return {
+        "lo": a["lo"], "hi": a["hi"],
+        "c": [x + y for x, y in zip(a["c"], b["c"])],
+        "n": a["n"] + b["n"], "s": a["s"] + b["s"],
+        "mx": max(a["mx"], b["mx"]), "mn": min(mn) if mn else None,
+    }
+
 
 class MetricsRegistry:
     """Name → instruments, rendered as Prometheus text or a dict snapshot.
@@ -186,6 +263,10 @@ class MetricsRegistry:
         self._lock = threading.Lock()
         self._by_name: "Dict[str, List]" = {}  # name -> [weakref.ref]
         self._order: List[str] = []
+        # extra Prometheus text appended at render time (the coordinator's
+        # fleet-labeled series, rendered by its FleetTSDB). Held weakly:
+        # a garbage-collected owner's series drop out of the next scrape.
+        self._exporters: List = []  # weakref.WeakMethod / weakref.ref
 
     def register(self, inst) -> None:
         with self._lock:
@@ -270,7 +351,46 @@ class MetricsRegistry:
                     lines.append(f'{name}_bucket{{le="{le}"}} {cum}')
                 lines.append(f"{name}_sum {_fmt(h.sum)}")
                 lines.append(f"{name}_count {h.total}")
+        for text in self._render_exporters():
+            if text:
+                lines.append(text.rstrip("\n"))
         return "\n".join(lines) + "\n"
+
+    def add_exporter(self, fn) -> None:
+        """Register a callable returning extra Prometheus text lines,
+        appended after the registry's own series on every render. Bound
+        methods are held via WeakMethod so a dead owner's series vanish;
+        :meth:`remove_exporter` drops one deterministically."""
+        ref = (self._weakref.WeakMethod(fn)
+               if hasattr(fn, "__self__") else self._weakref.ref(fn))
+        with self._lock:
+            self._exporters.append(ref)
+
+    def remove_exporter(self, fn) -> None:
+        with self._lock:
+            self._exporters = [r for r in self._exporters
+                               if r() is not None and r() != fn
+                               and r() is not fn]
+
+    def _render_exporters(self) -> List[str]:
+        with self._lock:
+            refs = list(self._exporters)
+        out, live = [], []
+        for r in refs:
+            fn = r()
+            if fn is None:
+                continue
+            live.append(r)
+            try:
+                out.append(fn())
+            except Exception as e:  # one bad exporter must not 500 the
+                # whole scrape: the failure shows up as a comment line
+                out.append(f"# exporter error: {e!r}")
+        if len(live) != len(refs):
+            with self._lock:
+                self._exporters = [r for r in self._exporters
+                                   if r() is not None]
+        return out
 
 
 def _fmt(v: float) -> str:

@@ -1,0 +1,160 @@
+"""Declarative SLO rules evaluated against fleet telemetry.
+
+An SLO here is one line of operator intent — "push p99 < 10ms over 30s"
+— parsed into a :class:`SloRule` and evaluated in the coordinator loop
+against the FleetTSDB's merged-raw-bucket quantiles (never averaged
+percentiles: the fleet p99 IS the p99 of every member's samples pooled).
+The window is the burn-rate window: the rule compares the quantile of
+exactly the last ``window`` seconds of fleet samples, so a breach means
+the objective is ACTIVELY burning, not that some ancient spike still
+haunts a lifetime histogram.
+
+Rule syntax (``Config.slo_rules`` / PS_SLO_RULES, ``;``-separated)::
+
+    <metric> <quantile> < <threshold> over <window>
+    push p99 < 10ms over 30s; apply p999 < 50ms over 60s
+
+``metric`` is a short alias (push, pull, push_pull, cycle, bucket,
+apply, ack, flush) or a full histogram name (``ps_push_seconds``);
+``quantile`` is p50/p90/p99/p999 (any ``pNN...``); thresholds take
+us/ms/s. On a transition into breach the evaluator records a typed
+``slo_breach`` flight event (and ``slo_recover`` on the way back); every
+evaluation spent in breach increments ``ps_slo_breach_total`` — the
+counter's rate IS the burn.
+"""
+
+from __future__ import annotations
+
+import re
+import threading
+from typing import Dict, List, Optional
+
+__all__ = ["SloRule", "parse_rules", "SloEvaluator", "METRIC_ALIASES"]
+
+METRIC_ALIASES: Dict[str, str] = {
+    "push": "ps_push_seconds",
+    "pull": "ps_pull_seconds",
+    "push_pull": "ps_push_pull_seconds",
+    "cycle": "ps_cycle_seconds",
+    "bucket": "ps_bucket_seconds",
+    "apply": "ps_server_apply_seconds",
+    "ack": "ps_replica_ack_wait_seconds",
+    "flush": "ps_blocked_seconds",
+}
+
+_UNITS = {"us": 1e-6, "ms": 1e-3, "s": 1.0}
+
+_RULE_RE = re.compile(
+    r"^\s*(?P<metric>[A-Za-z0-9_]+)\s+p(?P<q>\d+)\s*<=?\s*"
+    r"(?P<thr>\d+(?:\.\d+)?)\s*(?P<unit>us|ms|s)\s+"
+    r"over\s+(?P<win>\d+(?:\.\d+)?)\s*(?P<wunit>ms|s|m)\s*$")
+
+
+class SloRule:
+    """One parsed objective: ``metric``'s fleet ``q``-quantile over the
+    last ``window_s`` seconds must stay under ``threshold_s``."""
+
+    __slots__ = ("text", "metric", "q", "qlabel", "threshold_s",
+                 "window_s")
+
+    def __init__(self, text: str, metric: str, q: float,
+                 threshold_s: float, window_s: float,
+                 qlabel: Optional[str] = None):
+        self.text = text
+        self.metric = metric
+        self.q = q
+        # "p99"-style label: the digits after the decimal point
+        self.qlabel = qlabel or ("p" + f"{q:.10f}".split(".")[1].rstrip("0"))
+        self.threshold_s = threshold_s
+        self.window_s = window_s
+
+    def __repr__(self) -> str:
+        return f"SloRule({self.text!r})"
+
+
+def parse_rule(text: str) -> SloRule:
+    m = _RULE_RE.match(text)
+    if m is None:
+        raise ValueError(
+            f"unparseable SLO rule {text!r} — expected "
+            f"'<metric> p99 < 10ms over 30s' "
+            f"(metric: {sorted(METRIC_ALIASES)} or a ps_*_seconds name)")
+    metric = METRIC_ALIASES.get(m["metric"], m["metric"])
+    if not metric.startswith("ps_"):
+        raise ValueError(
+            f"unknown SLO metric {m['metric']!r} — use one of "
+            f"{sorted(METRIC_ALIASES)} or a full ps_* histogram name")
+    digits = m["q"]
+    q = int(digits) / (10 ** len(digits))  # p99 -> 0.99, p999 -> 0.999
+    if not (0.0 < q < 1.0):
+        raise ValueError(f"quantile p{digits} outside (0, 1) in {text!r}")
+    thr = float(m["thr"]) * _UNITS[m["unit"]]
+    wunit = {"ms": 1e-3, "s": 1.0, "m": 60.0}[m["wunit"]]
+    win = float(m["win"]) * wunit
+    if win <= 0 or thr <= 0:
+        raise ValueError(f"threshold/window must be positive in {text!r}")
+    return SloRule(text.strip(), metric, q, thr, win,
+                   qlabel="p" + digits)
+
+
+def parse_rules(spec: Optional[str]) -> List[SloRule]:
+    """``;``-separated rule list → rules (empty for None/blank)."""
+    if not spec or not spec.strip():
+        return []
+    return [parse_rule(part) for part in spec.split(";") if part.strip()]
+
+
+class SloEvaluator:
+    """Evaluate a rule set against a FleetTSDB; latch breach state."""
+
+    def __init__(self, tsdb, rules: List[SloRule]):
+        self.tsdb = tsdb
+        self.rules = list(rules)
+        self._lock = threading.Lock()
+        self._breached: Dict[str, dict] = {}  # rule text -> live breach
+        from ps_tpu.obs.metrics import default_registry
+
+        self._m_breach = default_registry().counter(
+            "ps_slo_breach_total",
+            "SLO evaluations that found a rule in breach")
+
+    def evaluate(self) -> List[dict]:
+        """One pass; returns per-rule state dicts (value may be None when
+        no member has window data for the metric — not a breach: absence
+        of traffic is not a latency violation)."""
+        from ps_tpu import obs
+
+        out = []
+        for rule in self.rules:
+            value = self.tsdb.quantile(rule.metric, rule.q, rule.window_s)
+            breached = value is not None and value > rule.threshold_s
+            state = {
+                "rule": rule.text, "metric": rule.metric,
+                "q": rule.qlabel, "window_s": rule.window_s,
+                "threshold_ms": round(rule.threshold_s * 1e3, 3),
+                "value_ms": (None if value is None
+                             else round(value * 1e3, 3)),
+                "breached": breached,
+            }
+            with self._lock:
+                was = rule.text in self._breached
+                if breached:
+                    self._breached[rule.text] = state
+                else:
+                    self._breached.pop(rule.text, None)
+            if breached:
+                self._m_breach.inc()
+                if not was:
+                    obs.record_event("slo_breach", rule=rule.text,
+                                     value_ms=state["value_ms"],
+                                     threshold_ms=state["threshold_ms"])
+            elif was and value is not None:
+                obs.record_event("slo_recover", rule=rule.text,
+                                 value_ms=state["value_ms"],
+                                 threshold_ms=state["threshold_ms"])
+            out.append(state)
+        return out
+
+    def breached(self) -> List[dict]:
+        with self._lock:
+            return list(self._breached.values())

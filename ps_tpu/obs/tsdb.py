@@ -1,0 +1,308 @@
+"""Bounded in-memory time series for fleet telemetry.
+
+The coordinator (ps_tpu/elastic) receives delta-encoded metric snapshots
+from every member on the COORD_REPORT cadence; this module is where they
+land: one bounded ring of CUMULATIVE samples per (member, metric), plus
+the windowed queries everything downstream asks of them —
+
+- per-member window deltas (``window``): counter rates, gauge extrema,
+  and raw log2 histogram-bucket deltas over the last ``window_s``;
+- TRUE fleet quantiles (``fleet_window`` / ``quantile``): members' raw
+  bucket deltas are merged with :func:`~ps_tpu.obs.metrics.state_add`
+  (lossless — summed buckets ARE the histogram of the pooled samples),
+  so the fleet p99 is the p99 of every sample any member recorded, never
+  an average of per-member percentiles;
+- fleet-labeled Prometheus text (``render_prometheus``), appended to the
+  coordinator's /metrics by a registry exporter hook: merged cumulative
+  fleet histograms (``ps_fleet_<metric>_bucket``) plus one windowed
+  p50/p99/p999 gauge per (member, metric).
+
+Memory is bounded by construction: ``ring`` samples per series, members
+pruned on goodbye/death via :meth:`drop_member`. Everything is keyed by
+the coordinator's OWN monotonic clock at ingest time — cross-member
+windows never depend on member clocks (that alignment problem belongs to
+trace timelines and ps_tpu/obs/clock.py, not metric windows).
+"""
+
+from __future__ import annotations
+
+import collections
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+from ps_tpu.obs.metrics import Histogram, state_add, state_sub
+
+__all__ = ["FleetTSDB"]
+
+#: quantile gauges rendered per (member, metric) on /metrics
+_QUANTS = (("p50", 0.50), ("p99", 0.99), ("p999", 0.999))
+
+
+def _hist(st: dict) -> Histogram:
+    return Histogram.from_state("m", st)
+
+
+class FleetTSDB:
+    """Per-(member, metric) rings of cumulative samples + windowed views.
+
+    A sample is ``(t, kind, payload)`` where ``payload`` is an int/float
+    for counters/gauges and a raw histogram state dict for histograms.
+    Thread-safe: reports ingest from serve threads while queries run from
+    ps_top/ps_doctor round trips and the /metrics scrape.
+    """
+
+    def __init__(self, window_s: float = 30.0, ring: int = 256):
+        if window_s <= 0:
+            raise ValueError("window_s must be > 0")
+        if ring < 2:
+            raise ValueError("ring must hold at least 2 samples "
+                             "(a window needs a baseline)")
+        self.window_s = float(window_s)
+        self.ring = int(ring)
+        self._lock = threading.Lock()
+        # (member, metric) -> deque[(t, payload)]; kinds tracked per metric
+        self._series: Dict[Tuple[str, str], collections.deque] = {}
+        self._kinds: Dict[str, str] = {}
+        self._members: Dict[str, float] = {}  # member -> last ingest t
+
+    # -- ingest ----------------------------------------------------------------
+
+    def ingest(self, member: str, state: dict,
+               t: Optional[float] = None) -> None:
+        """Land one member's CUMULATIVE state dict (``{metric: {"k":
+        kind, ...}}`` — what a :class:`~ps_tpu.obs.collector.DeltaDecoder`
+        reconstructs from the wire deltas)."""
+        t = time.monotonic() if t is None else float(t)
+        with self._lock:
+            self._members[str(member)] = t
+            for name, entry in state.items():
+                kind = entry.get("k", "counter")
+                prev = self._kinds.setdefault(name, kind)
+                if prev != kind:
+                    continue  # one name, one kind — drop the imposter
+                key = (str(member), str(name))
+                ring = self._series.get(key)
+                if ring is None:
+                    ring = self._series[key] = collections.deque(
+                        maxlen=self.ring)
+                if kind == "hist":
+                    ring.append((t, {k: v for k, v in entry.items()
+                                     if k != "k"}))
+                else:
+                    ring.append((t, float(entry.get("v", 0))))
+
+    def drop_member(self, member: str) -> None:
+        """Forget a departed member's series (goodbye / death pruning)."""
+        with self._lock:
+            self._members.pop(str(member), None)
+            for key in [k for k in self._series if k[0] == str(member)]:
+                del self._series[key]
+
+    def prune_stale(self, max_age_s: Optional[float] = None) -> List[str]:
+        """Drop members whose LAST ingest is older than ``max_age_s``
+        (default 10 windows) — churning ephemeral reporters (restarted
+        workers mint new ids) must not grow the tsdb forever. Returns
+        the dropped member names so the caller can retire decoders."""
+        age = 10.0 * self.window_s if max_age_s is None else max_age_s
+        now = time.monotonic()
+        with self._lock:
+            gone = [m for m, t in self._members.items() if now - t > age]
+        for m in gone:
+            self.drop_member(m)
+        return gone
+
+    # -- introspection ---------------------------------------------------------
+
+    def members(self) -> List[str]:
+        with self._lock:
+            return sorted(self._members)
+
+    def metrics(self) -> List[str]:
+        with self._lock:
+            return sorted(self._kinds)
+
+    def kind(self, metric: str) -> Optional[str]:
+        with self._lock:
+            return self._kinds.get(metric)
+
+    # -- windowed views --------------------------------------------------------
+
+    def _window_pair(self, key, now: float, window_s: float):
+        """(baseline, latest) samples for a window ending now — the newest
+        sample at or before the window start, else the oldest (a short
+        history degrades to 'since first sight', never to nothing)."""
+        ring = self._series.get(key)
+        if not ring:
+            return None
+        t1, latest = ring[-1]
+        if now - t1 > 3 * window_s:
+            return None  # the member went quiet: stale beyond use
+        base = None
+        for t0, payload in ring:
+            if t0 <= now - window_s:
+                base = (t0, payload)
+            else:
+                break
+        if base is None:
+            base = ring[0]
+        return base, (t1, latest)
+
+    def window(self, member: str, metric: str,
+               window_s: Optional[float] = None) -> Optional[dict]:
+        """One member's view of ``metric`` over the last ``window_s``:
+
+        - counter: ``{"delta", "rate", "value"}``
+        - gauge: ``{"value"}`` (the latest sample)
+        - hist: the raw bucket DELTA state plus its ``summary`` — window
+          quantiles of exactly this member's samples
+        """
+        now = time.monotonic()
+        w = self.window_s if window_s is None else float(window_s)
+        with self._lock:
+            kind = self._kinds.get(metric)
+            pair = self._window_pair((str(member), str(metric)), now, w)
+        if kind is None or pair is None:
+            return None
+        (t0, base), (t1, latest) = pair
+        dt = max(t1 - t0, 1e-9)
+        if kind == "gauge":
+            return {"k": "gauge", "value": latest}
+        if kind == "counter":
+            # a single-sample series has NO window movement to report: a
+            # long-lived member's first (full) snapshot after a
+            # coordinator restart carries its lifetime total, and
+            # "delta = lifetime" would show a bogus fleet-wide burst.
+            # One report cadence later real deltas resume.
+            delta = (latest - base) if t1 > t0 else 0.0
+            return {"k": "counter", "value": latest, "delta": delta,
+                    "rate": (delta / dt) if t1 > t0 else 0.0}
+        # histograms degrade differently on a single sample: lifetime
+        # QUANTILES are still quantiles (merely a wider window), so the
+        # cumulative state serves until a second sample opens a window
+        st = state_sub(latest, base) if t1 > t0 else latest
+        out = {"k": "hist", "state": st}
+        if st["n"] > 0:
+            out["summary"] = _hist(st).summary()
+        return out
+
+    def fleet_window(self, metric: str,
+                     window_s: Optional[float] = None) -> Optional[dict]:
+        """Every member's window merged: summed counter deltas/rates, or
+        the merged raw-bucket histogram state + its summary (the TRUE
+        fleet distribution over the window). The reply carries the
+        per-member windows it computed along the way (``"per_member"``)
+        so callers assembling a full fleet view (COORD_TELEMETRY) never
+        re-scan the rings per member."""
+        with self._lock:
+            members = sorted(self._members)
+        kind = self.kind(metric)
+        if kind is None:
+            return None
+        merged = None
+        per_member: Dict[str, dict] = {}
+        for m in members:
+            win = self.window(m, metric, window_s)
+            if win is None:
+                continue
+            per_member[m] = win
+            if kind == "hist":
+                if win["state"]["n"] > 0:
+                    merged = state_add(merged, win["state"])
+            elif kind == "counter":
+                merged = (merged or 0.0) + win["delta"]
+        if not per_member:
+            return None
+        out = {"k": kind, "members": sorted(per_member),
+               "per_member": per_member}
+        if kind == "hist" and merged is not None:
+            out["state"] = merged
+            out["summary"] = _hist(merged).summary()
+        elif kind == "counter":
+            out["delta"] = merged or 0.0
+        elif kind == "gauge":
+            out["values"] = {m: w["value"] for m, w in per_member.items()}
+        return out
+
+    def quantile(self, metric: str, q: float,
+                 window_s: Optional[float] = None) -> Optional[float]:
+        """The fleet ``q``-quantile of ``metric`` over the window,
+        computed from merged raw buckets; None when no member reported."""
+        win = self.fleet_window(metric, window_s)
+        if not win or win.get("k") != "hist" or "state" not in win:
+            return None
+        return _hist(win["state"]).quantile(q)
+
+    def member_mean(self, member: str, metric: str,
+                    window_s: Optional[float] = None
+                    ) -> Optional[Tuple[float, int]]:
+        """``(window mean, window count)`` of a histogram metric for one
+        member — what the straggler z-score compares across members."""
+        win = self.window(member, metric, window_s)
+        if not win or win.get("k") != "hist":
+            return None
+        st = win["state"]
+        if st["n"] <= 0:
+            return None
+        return st["s"] / st["n"], int(st["n"])
+
+    # -- /metrics export -------------------------------------------------------
+
+    def render_prometheus(self) -> str:
+        """Fleet-labeled series for the coordinator's /metrics endpoint
+        (wired via ``MetricsRegistry.add_exporter``): the merged
+        CUMULATIVE fleet histogram per metric (Prometheus-native shape —
+        scrapers window it themselves) plus one windowed quantile gauge
+        per (member, metric) so "which member's p99 moved" needs no
+        PromQL joins."""
+        import math
+
+        lines: List[str] = []
+        with self._lock:
+            members = sorted(self._members)
+            metrics = sorted(self._kinds.items())
+            latest = {key: ring[-1][1]
+                      for key, ring in self._series.items() if ring}
+        for name, kind in metrics:
+            fleet = "ps_fleet_" + (name[3:] if name.startswith("ps_")
+                                   else name)
+            if kind == "hist":
+                merged = None
+                for m in members:
+                    st = latest.get((m, name))
+                    if st is not None and st["n"] > 0:
+                        merged = state_add(merged, st)
+                if merged is None:
+                    continue
+                lines.append(f"# TYPE {fleet} histogram")
+                h = _hist(merged)
+                for ub, cum in h.buckets():
+                    le = "+Inf" if math.isinf(ub) else repr(float(ub))
+                    lines.append(f'{fleet}_bucket{{le="{le}"}} {cum}')
+                lines.append(f"{fleet}_sum {repr(float(h.sum))}")
+                lines.append(f"{fleet}_count {h.total}")
+                qname = fleet[:-len("_seconds")] if fleet.endswith(
+                    "_seconds") else fleet
+                lines.append(f"# TYPE {qname}_quantile_seconds gauge")
+                for m in members:
+                    win = self.window(m, name)
+                    if not win or "summary" not in win:
+                        continue
+                    for label, q in _QUANTS:
+                        v = win["summary"][label]
+                        lines.append(
+                            f'{qname}_quantile_seconds{{member="{m}",'
+                            f'q="{label}"}} {repr(float(v))}')
+            else:
+                any_line = False
+                for m in members:
+                    v = latest.get((m, name))
+                    if v is None:
+                        continue
+                    if not any_line:
+                        lines.append(f"# TYPE {fleet} "
+                                     f"{'gauge' if kind == 'gauge' else 'counter'}")
+                        any_line = True
+                    lines.append(f'{fleet}{{member="{m}"}} '
+                                 f'{repr(float(v))}')
+        return "\n".join(lines)

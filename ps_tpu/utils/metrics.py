@@ -74,6 +74,12 @@ class TransportStats:
          "serve-thread waits on the sync replica ack"),
         ("failover_s", "ps_failover_seconds",
          "worker shard re-routes to a promoted replica"),
+        # the server-side engine apply (lock wait included): the phase a
+        # serving shard owns end to end, which makes it the per-step
+        # breakdown's server_apply row AND the straggler detector's
+        # default signal (ps_tpu/obs/breakdown.py, obs/straggler.py)
+        ("apply_s", "ps_server_apply_seconds",
+         "server engine apply of one committed push (lock held)"),
     )
 
     def __init__(self, window: int = 256):
@@ -192,6 +198,11 @@ class TransportStats:
         h = self.hist.get(name + "_s")
         if h is not None:
             h.record(seconds)
+
+    def record_apply(self, seconds: float) -> None:
+        """One server-side engine apply of a committed push, end to end
+        (lock acquisition included — contention IS apply-path latency)."""
+        self.hist["apply_s"].record(seconds)
 
     def record_repl_ack_wait(self, seconds: float) -> None:
         """Time one serve thread spent blocked on a sync replica ack."""

@@ -648,9 +648,14 @@ def test_concurrent_join_never_collides_with_move_epoch(tpu_async):
         assert late, "the concurrent join never completed"
         # strict monotonicity for every reader, no epoch reuse
         assert all(b >= a for a, b in zip(epochs, epochs[1:])), epochs
-        # the join and the move both committed, at DISTINCT epochs
+        # the join and the move both committed, at DISTINCT epochs. The
+        # join usually lands inside the move's streaming window (the
+        # sleep aims for it), but on a noisy host it may commit AFTER
+        # the install — then the final epoch is the join's, legally
+        # ahead of the move's. Either way no epoch is ever reused.
         table = coord.table()
-        assert out["epoch"] == table.epoch
+        assert table.epoch >= out["epoch"]
+        assert table.epoch <= out["epoch"] + 1  # at most the one join
         assert len(table.shards) == 3
         assert table.keys_of(1) == keys[:4]
     finally:

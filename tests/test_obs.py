@@ -226,8 +226,15 @@ def test_trace_roundtrip_through_push_pull_replica(request, sampled_tracer):
            and s.parent_id == wk[0].span_id]
     assert len(srv) == 1, [(s.name, s.cat) for s in spans]
     assert srv[0].trace_id == wk[0].trace_id
-    appends = [s for s in spans if s.name == "replica_append"
+    # the engine apply is its own child hop (fleet-telemetry PR's
+    # span-phase tagging): the push-record append parents to it, the
+    # pull-record append to the dispatch span — one linked chain
+    applies = [s for s in spans if s.name == "server_apply"
                and s.parent_id == srv[0].span_id]
+    assert len(applies) == 1 and applies[0].trace_id == wk[0].trace_id
+    chain_ids = {srv[0].span_id, applies[0].span_id}
+    appends = [s for s in spans if s.name == "replica_append"
+               and s.parent_id in chain_ids]
     # the push_pull commit replicates a push AND a pull record
     assert len(appends) >= 2
     assert all(s.trace_id == wk[0].trace_id for s in appends)

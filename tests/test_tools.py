@@ -107,6 +107,100 @@ def test_bench_rebalance_smoke():
     assert d["table_epoch"] >= 4  # 2 joins + >=1 split move + drain
 
 
+def test_ps_top_fleet_and_ps_doctor_smoke():
+    """Satellite: `ps_top --fleet` discovers the member list FROM the
+    coordinator (no hand-listed endpoints) and `ps_doctor` produces a
+    one-shot report with a non-empty breakdown; a dead coordinator makes
+    --fleet fall back to the CLI --servers list (the old path)."""
+    import time
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+    import numpy as np
+
+    import ps_tpu as ps
+    from ps_tpu.backends.remote_async import AsyncPSService, connect_async
+    from ps_tpu.elastic import Coordinator
+
+    ps.init(backend="tpu", mode="async", num_workers=1, dc_lambda=0.0)
+    coord = Coordinator(port=0, report_ms=100, telemetry_window_s=5.0)
+    caddr = f"127.0.0.1:{coord.port}"
+    params = {f"p{i}/w": jnp.asarray(np.full((32, 4), 0.5, np.float32))
+              for i in range(4)}
+    keys = sorted(params)
+    svcs = []
+    try:
+        for s in range(2):
+            st = ps.KVStore(optimizer="sgd", learning_rate=0.1,
+                            mode="async")
+            st.init({k: params[k] for k in keys[s * 2:(s + 1) * 2]})
+            svcs.append(AsyncPSService(st, bind="127.0.0.1",
+                                       coordinator=caddr))
+        w = connect_async(None, 0, params, coordinator=caddr)
+        try:
+            w.pull_all()
+            grads = {k: jnp.full_like(v, 0.01)
+                     for k, v in params.items()}
+            t0 = time.monotonic()
+            while time.monotonic() - t0 < 1.5:
+                w.push_pull(grads)
+            time.sleep(0.3)
+
+            env = {k: v for k, v in os.environ.items()
+                   if k not in ("XLA_FLAGS", "JAX_PLATFORMS")}
+            env["JAX_PLATFORMS"] = "cpu"
+            top = subprocess.run(
+                [sys.executable,
+                 os.path.join(_REPO, "tools", "ps_top.py"),
+                 "--fleet", "--coord", caddr, "--once"],
+                env=env, capture_output=True, text=True, timeout=120)
+            assert top.returncode == 0, top.stderr
+            assert "fleet window" in top.stdout
+            for svc in svcs:  # discovered, not hand-listed
+                assert f"127.0.0.1:{svc.port}" in top.stdout
+            assert "primary" in top.stdout
+
+            doc = subprocess.run(
+                [sys.executable,
+                 os.path.join(_REPO, "tools", "ps_doctor.py"),
+                 "--coord", caddr, "--json"],
+                env=env, capture_output=True, text=True, timeout=120)
+            assert doc.returncode == 0, doc.stderr or doc.stdout
+            rep = json.loads(doc.stdout)
+            assert rep["telemetry"]["breakdown"].get("total", {}) \
+                .get("count", 0) > 0
+            assert rep["telemetry"]["fleet"]
+
+            # dead coordinator: --fleet falls back to --servers
+            servers_uri = ",".join(f"127.0.0.1:{s.port}" for s in svcs)
+            coord.kill()
+            top = subprocess.run(
+                [sys.executable,
+                 os.path.join(_REPO, "tools", "ps_top.py"),
+                 "--fleet", "--coord", caddr,
+                 "--servers", servers_uri, "--once"],
+                env=env, capture_output=True, text=True, timeout=120)
+            assert top.returncode == 0, top.stderr
+            assert "falling back to --servers" in top.stdout
+            assert "primary" in top.stdout
+
+            doc = subprocess.run(
+                [sys.executable,
+                 os.path.join(_REPO, "tools", "ps_doctor.py"),
+                 "--coord", caddr],
+                env=env, capture_output=True, text=True, timeout=120)
+            assert doc.returncode == 2  # unreachable is a typed exit
+        finally:
+            w.close()
+    finally:
+        for s in svcs:
+            s.stop()
+        coord.stop()
+        ps.shutdown()
+
+
 @pytest.mark.slow
 def test_bench_dc_asgd_smoke():
     out = _run("bench_dc_asgd.py", "--applies", "12", "--eval-every", "6",

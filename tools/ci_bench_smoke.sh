@@ -18,6 +18,11 @@
 #      back to 2), and asserts zero lost pushes (the per-key exactly-once
 #      ledger), a committed table epoch, and that the worker re-routed
 #      without restarting.
+#   5. fleet telemetry (<45 s): 3 members + a coordinator + an elastic
+#      worker pushing; asserts the coordinator's /metrics serves fleet
+#      p99 series (merged raw buckets), and that `tools/ps_doctor.py
+#      --coord` exits 0 with a non-empty per-step breakdown (and
+#      `ps_top --fleet` renders).
 #
 # Usage: tools/ci_bench_smoke.sh   (from the repo root)
 #
@@ -49,6 +54,16 @@ assert det["shm_lane_stats"]["negotiated"], "shm lane failed to negotiate"
 assert det["shm_lane_stats"]["shm_frames"] > 0, \
     "shm lane negotiated but no frames rode the rings"
 print(f"  shm/tcp wire speedup: {det['shm_speedup_vs_bucketed_tcp']}x")
+# fleet-telemetry overhead: reports-on vs reports-off, back to back.
+# The real cost is one snapshot+delta per second (< 2% on a quiet
+# machine); the CI bound is loose because best-of-2 windows on a
+# 2-core host carry ±10% scheduler noise either direction.
+assert det["telemetry_on_gbps"] and det["telemetry_on_gbps"] > 0, \
+    "telemetry leg moved no data"
+assert det["telemetry_overhead_pct"] < 20.0, \
+    f"telemetry overhead way over budget: {det['telemetry_overhead_pct']}%"
+print(f"  telemetry overhead: {det['telemetry_overhead_pct']}% "
+      f"({det['telemetry_off_gbps']} -> {det['telemetry_on_gbps']} GB/s)")
 print("transport smoke OK")
 EOF
 
@@ -180,4 +195,87 @@ print(f"  {det['pushes']} pushes, {det['table_reroutes']} live "
       f"re-route(s), table epoch {det['table_epoch']}; "
       f"exactly-once ledger balanced")
 print("rebalance smoke OK")
+EOF
+
+# fleet-telemetry leg (<45 s): 3 members + coordinator + elastic worker;
+# fleet p99 series on the coordinator's /metrics (merged raw buckets),
+# ps_doctor exits 0 with a non-empty breakdown, ps_top --fleet renders.
+timeout -k 10 90 env JAX_PLATFORMS=cpu PS_SLO_RULES='push_pull p99 < 30s over 10s' python - <<'EOF'
+import json
+import subprocess
+import sys
+import time
+import urllib.request
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+import numpy as np
+import jax.numpy as jnp
+
+import ps_tpu as ps
+from ps_tpu import obs
+from ps_tpu.backends.remote_async import AsyncPSService, connect_async
+from ps_tpu.elastic import Coordinator
+
+srv = obs.start_metrics_server(0)  # the coordinator process's scrape
+ps.init(backend="tpu", mode="async", num_workers=1, dc_lambda=0.0)
+coord = Coordinator(port=0, report_ms=150, telemetry_window_s=5.0)
+caddr = f"127.0.0.1:{coord.port}"
+params = {f"p{i}/w": jnp.asarray(np.full((64, 8), 0.5, np.float32))
+          for i in range(6)}
+keys = sorted(params)
+svcs = []
+for s in range(3):
+    st = ps.KVStore(optimizer="sgd", learning_rate=0.1, mode="async")
+    st.init({k: params[k] for k in keys[s * 2:(s + 1) * 2]})
+    svcs.append(AsyncPSService(st, bind="127.0.0.1", coordinator=caddr))
+w = connect_async(None, 0, params, coordinator=caddr)
+w.pull_all()
+grads = {k: jnp.full_like(v, 0.01) for k, v in params.items()}
+t0 = time.time()
+pushes = 0
+while time.time() - t0 < 4.0:
+    w.push_pull(grads)
+    pushes += 1
+time.sleep(0.4)  # one more report cadence lands
+
+text = urllib.request.urlopen(
+    f"http://127.0.0.1:{srv.port}/metrics", timeout=5).read().decode()
+assert "ps_fleet_server_apply_seconds_bucket" in text, \
+    "coordinator /metrics serves no fleet histogram series"
+p99 = [ln for ln in text.splitlines()
+       if "quantile_seconds" in ln and 'q="p99"' in ln]
+assert p99, "no per-member fleet p99 gauges on /metrics"
+print(f"  /metrics: fleet series present ({len(p99)} p99 gauge(s))")
+
+doc = subprocess.run(
+    [sys.executable, "tools/ps_doctor.py", "--coord", caddr, "--json"],
+    capture_output=True, text=True, timeout=30)
+assert doc.returncode == 0, doc.stderr or doc.stdout
+rep = json.loads(doc.stdout)
+bd = rep["telemetry"]["breakdown"]
+assert bd and bd.get("total", {}).get("count", 0) > 0, \
+    f"ps_doctor breakdown is empty: {bd}"
+assert rep["telemetry"]["fleet"], "ps_doctor saw no fleet quantiles"
+assert any(r["rule"] for r in rep["telemetry"]["slo"]), \
+    "PS_SLO_RULES rule did not reach the coordinator"
+print(f"  ps_doctor: breakdown phases {sorted(bd)} over "
+      f"{bd['total']['count']} step(s)")
+
+top = subprocess.run(
+    [sys.executable, "tools/ps_top.py", "--fleet", "--coord", caddr,
+     "--once"],
+    capture_output=True, text=True, timeout=30)
+assert top.returncode == 0, top.stderr
+assert "fleet window" in top.stdout and "primary" in top.stdout, \
+    top.stdout
+print("  ps_top --fleet: header + member table render")
+
+w.close()
+for s in svcs:
+    s.stop()
+coord.stop()
+ps.shutdown()
+print(f"fleet-telemetry smoke OK ({pushes} pushes)")
 EOF

@@ -1,0 +1,169 @@
+#!/usr/bin/env python
+"""ps_doctor — one-shot fleet health report from the coordinator.
+
+The "where did the millisecond go" answer without ssh-ing into N
+processes: one COORD_TELEMETRY round trip (plus the membership view)
+rendered as a readable report —
+
+- membership + liveness (who serves, who beats, who left);
+- fleet latency quantiles over the telemetry window, computed from
+  MERGED raw log2 histogram buckets (README "Fleet telemetry" — a true
+  fleet p99, never an average of per-member percentiles);
+- the per-step critical-path breakdown (total / flush-wait / wire /
+  server-apply / ack-wait, with each phase's share of the step);
+- straggler suspects (windowed leave-one-out z-score) and rebalance
+  hints, next to the byte-skew trigger;
+- SLO rule states (breached / ok / no data).
+
+Usage::
+
+    python tools/ps_doctor.py --coord host:port [--window 30]
+    python tools/ps_doctor.py --coord host:port --json     # machine form
+    python tools/ps_doctor.py --coord host:port --strict   # exit 1 on
+                                                 # breaches/stragglers
+
+Exit codes: 0 = report produced; 1 = ``--strict`` and the fleet has an
+active SLO breach or straggler suspect; 2 = coordinator unreachable
+(the fleet then still has PR 5-style per-process observability — this
+tool just has nothing fleet-wide to read).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+# tools/ run from the repo root; make that explicit for direct execution
+sys.path.insert(0, ".")
+
+from ps_tpu.elastic.member import fetch_telemetry, fetch_view  # noqa: E402
+
+
+def _ms(v) -> str:
+    return "-" if v is None else f"{v:8.3f}"
+
+
+def render(view: dict, tel: dict, stream=sys.stdout) -> None:
+    table = view.get("table") or {}
+    print(f"== ps_doctor: fleet of {len(table.get('shards') or [])} "
+          f"shard(s), table epoch {table.get('epoch', '?')}, "
+          f"telemetry window {tel.get('window_s')}s ==", file=stream)
+
+    print("\n-- members --", file=stream)
+    for m in view.get("members") or []:
+        rep = m.get("report") or {}
+        print(f"  shard {m.get('shard')}  {m.get('uri'):21s} "
+              f"{m.get('kind'):6s} hb={m.get('hb_state'):6s} "
+              f"keys={m.get('keys')} "
+              f"push_qps={rep.get('push_qps')}", file=stream)
+    extra = [u for u in tel.get("members") or []
+             if u not in {m.get("uri") for m in view.get("members") or []}]
+    for u in extra:
+        print(f"  (telemetry-only) {u}", file=stream)
+
+    print("\n-- fleet quantiles (merged raw buckets) --", file=stream)
+    fleet = tel.get("fleet") or {}
+    if not fleet:
+        print("  (no histogram telemetry in the window)", file=stream)
+    for metric in sorted(fleet):
+        s = fleet[metric]
+        print(f"  {metric:32s} count={s['count']:>8d}  "
+              f"p50={_ms(s['p50'] * 1e3)}ms  p99={_ms(s['p99'] * 1e3)}ms"
+              f"  p999={_ms(s['p999'] * 1e3)}ms", file=stream)
+
+    print("\n-- per-member window --", file=stream)
+    per = tel.get("per_member") or {}
+    if not per:
+        print("  (no per-member telemetry in the window)", file=stream)
+    for uri in sorted(per):
+        row = per[uri]
+        cells = []
+        for metric in sorted(row):
+            short = metric[3:-len("_seconds")] \
+                if metric.startswith("ps_") \
+                and metric.endswith("_seconds") else metric
+            cells.append(f"{short} p99={row[metric]['p99'] * 1e3:.2f}ms")
+        print(f"  {uri:21s} " + "  ".join(cells), file=stream)
+    counters = tel.get("counters") or {}
+    if counters:
+        print("  fleet counters (window): "
+              + "  ".join(f"{name}=+{int(c['delta'])}"
+                          for name, c in sorted(counters.items())),
+              file=stream)
+
+    print("\n-- per-step breakdown --", file=stream)
+    bd = tel.get("breakdown") or {}
+    if not bd:
+        print("  (no step telemetry yet)", file=stream)
+    order = ("total", "flush_wait", "wire_round", "wire", "server_apply",
+             "ack_wait", "client")
+    for phase in order:
+        row = bd.get(phase)
+        if not row:
+            continue
+        share = row.get("share")
+        print(f"  {phase:13s} mean={_ms(row.get('mean_ms'))}ms  "
+              f"p99={_ms(row.get('p99_ms'))}ms  "
+              f"seconds={row.get('seconds'):10.3f}"
+              + (f"  share={share * 100:5.1f}%" if share is not None
+                 else ""), file=stream)
+
+    stragglers = tel.get("stragglers") or []
+    print("\n-- stragglers --", file=stream)
+    if not stragglers:
+        print("  none suspected", file=stream)
+    for s in stragglers:
+        print(f"  shard {s.get('shard')} {s.get('uri')}: "
+              f"{s.get('metric')} z={s.get('z')} "
+              f"({s.get('mean_ms')}ms vs peers {s.get('others_mean_ms')}"
+              f"ms over {s.get('window_count')} sample(s))", file=stream)
+
+    print("\n-- SLO --", file=stream)
+    slo = tel.get("slo") or []
+    if not slo:
+        print("  no rules configured (PS_SLO_RULES)", file=stream)
+    for r in slo:
+        mark = "BREACH" if r.get("breached") else (
+            "no-data" if r.get("value_ms") is None else "ok")
+        print(f"  [{mark:7s}] {r.get('rule')}  value={r.get('value_ms')}"
+              f"ms threshold={r.get('threshold_ms')}ms", file=stream)
+
+    hints = tel.get("hints") or []
+    if hints:
+        print("\n-- rebalance hints --", file=stream)
+        for h in hints:
+            print(f"  [{h.get('kind')}] {h.get('action')}", file=stream)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--coord", required=True,
+                    help="coordinator host:port")
+    ap.add_argument("--window", type=float, default=None,
+                    help="telemetry window in seconds (default: the "
+                         "coordinator's telemetry_window_s)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit one JSON object instead of the report")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 when any SLO is breached or a straggler "
+                         "is suspected")
+    args = ap.parse_args(argv)
+    try:
+        view = fetch_view(args.coord)
+        tel = fetch_telemetry(args.coord, window_s=args.window)
+    except Exception as e:
+        print(f"ps_doctor: coordinator {args.coord} unreachable: {e}",
+              file=sys.stderr)
+        return 2
+    if args.json:
+        print(json.dumps({"view": view, "telemetry": tel}, default=str))
+    else:
+        render(view, tel)
+    unhealthy = bool(tel.get("stragglers")) or any(
+        r.get("breached") for r in tel.get("slo") or [])
+    return 1 if (args.strict and unhealthy) else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

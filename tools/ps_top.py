@@ -14,9 +14,19 @@ Usage::
     python tools/ps_top.py --servers ... --once          # one table, exit
     python tools/ps_top.py --servers ... --once --json   # machine-readable
     python tools/ps_top.py --coord host:port [--once] [--json]
+    python tools/ps_top.py --fleet --coord host:port [--servers fallback]
 
 ``--once --json`` prints one JSON object per endpoint (a list), for CI
 smoke checks and scripting (tools/ci_bench_smoke.sh's obs leg).
+
+``--fleet`` discovers the member list FROM the coordinator (no more
+hand-listing every endpoint on the CLI) and renders the same per-endpoint
+STATS table, headed by the coordinator's fleet telemetry: windowed fleet
+p99s computed from merged raw histogram buckets (README "Fleet
+telemetry"), current straggler suspects, SLO breaches, and rebalance
+hints. A ``--servers`` URI passed alongside is the FALLBACK when the
+coordinator is down — the old path keeps working, just without the fleet
+header.
 
 ``--coord`` renders the coordinator's membership view instead (README
 "Elastic membership"): the live shard table (epoch, per-shard key count
@@ -203,6 +213,10 @@ def print_coord_view(view: dict, stream=sys.stdout) -> None:
                  f"{mig.get('moves', 0)} moves, "
                  f"{mig.get('keys', 0)} key(s) in motion")
     print(head, file=stream)
+    for h in view.get("hints") or []:
+        # the byte-skew trigger and straggler suspects, side by side —
+        # the two reasons an operator rebalances
+        print(f"HINT [{h.get('kind')}] {h.get('action')}", file=stream)
     hdr = "  ".join(f"{name:>{w}}" for name, w in COORD_COLS)
     print(hdr, file=stream)
     print("-" * len(hdr), file=stream)
@@ -221,6 +235,56 @@ def poll_coord(addr: str) -> dict:
         return {"error": str(e)}
 
 
+def poll_fleet_via_coord(coord: str, fallback_servers=None) -> dict:
+    """--fleet: member URIs come from the coordinator's table, telemetry
+    from COORD_TELEMETRY; a dead coordinator falls back to the CLI
+    ``--servers`` list (old behavior) when one was given."""
+    from ps_tpu.elastic.member import fetch_telemetry, fetch_view
+
+    view = poll_coord(coord)
+    if "error" in view:
+        if fallback_servers:
+            return {"fallback": view["error"],
+                    "rows": poll_fleet(fallback_servers)}
+        return {"error": view["error"]}
+    shards = (view.get("table") or {}).get("shards") or []
+    rows = poll_fleet(",".join(shards)) if shards else []
+    out = {"rows": rows, "view": view}
+    try:
+        out["telemetry"] = fetch_telemetry(coord)
+    except Exception as e:
+        out["telemetry_error"] = str(e)
+    return out
+
+
+def print_fleet_header(tel: dict, stream=sys.stdout) -> None:
+    """Fleet p99 line + stragglers/SLO/hints above the endpoint table."""
+    fleet = tel.get("fleet") or {}
+    parts = []
+    for metric in sorted(fleet):
+        s = fleet[metric]
+        short = metric[3:-len("_seconds")] if metric.startswith("ps_") \
+            and metric.endswith("_seconds") else metric
+        parts.append(f"{short} p99={s['p99'] * 1e3:.2f}ms")
+    print(f"fleet window {tel.get('window_s')}s  "
+          + ("  ".join(parts) if parts else "(no telemetry yet)"),
+          file=stream)
+    for s in tel.get("stragglers") or []:
+        print(f"  STRAGGLER shard {s.get('shard')} {s.get('uri')}: "
+              f"{s.get('metric')} z={s.get('z')} "
+              f"({s.get('mean_ms')}ms vs {s.get('others_mean_ms')}ms)",
+              file=stream)
+    for r in tel.get("slo") or []:
+        mark = "BREACH" if r.get("breached") else "ok"
+        print(f"  SLO [{mark}] {r.get('rule')}: value "
+              f"{r.get('value_ms')}ms / threshold "
+              f"{r.get('threshold_ms')}ms", file=stream)
+    for h in tel.get("hints") or []:
+        if h.get("kind") != "straggler":  # stragglers already rendered
+            print(f"  HINT [{h.get('kind')}] {h.get('action')}",
+                  file=stream)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     ap.add_argument("--servers",
@@ -229,6 +293,11 @@ def main(argv=None) -> int:
     ap.add_argument("--coord",
                     help="coordinator host:port — render the membership/"
                          "shard-table view instead of per-endpoint STATS")
+    ap.add_argument("--fleet", action="store_true",
+                    help="with --coord: discover the member list from the"
+                         " coordinator and render the per-endpoint table "
+                         "headed by fleet telemetry (p99s, stragglers, "
+                         "SLO); --servers becomes the fallback path")
     ap.add_argument("--interval", type=float, default=2.0,
                     help="refresh cadence in seconds (live mode)")
     ap.add_argument("--once", action="store_true",
@@ -236,15 +305,32 @@ def main(argv=None) -> int:
     ap.add_argument("--json", action="store_true",
                     help="with --once: raw per-endpoint STATS as JSON")
     args = ap.parse_args(argv)
-    if (args.servers is None) == (args.coord is None):
+    if args.fleet:
+        if args.coord is None:
+            ap.error("--fleet discovers members from the coordinator: "
+                     "pass --coord host:port (--servers is the fallback)")
+    elif (args.servers is None) == (args.coord is None):
         ap.error("pass exactly one of --servers or --coord")
 
     def snapshot():
+        if args.fleet:
+            return poll_fleet_via_coord(args.coord, args.servers)
         return poll_coord(args.coord) if args.coord \
             else poll_fleet(args.servers)
 
     def render(data):
-        if args.coord:
+        if args.fleet:
+            if "error" in data:
+                print(f"coordinator {args.coord}: DOWN ({data['error']}) "
+                      f"and no --servers fallback given")
+                return
+            if "fallback" in data:
+                print(f"coordinator {args.coord}: DOWN "
+                      f"({data['fallback']}) — falling back to --servers")
+            elif "telemetry" in data:
+                print_fleet_header(data["telemetry"])
+            print_table(data["rows"])
+        elif args.coord:
             if "error" in data:
                 print(f"coordinator {args.coord}: DOWN ({data['error']})")
             else:
