@@ -28,7 +28,9 @@ see; the gap between the two is the input-path cost.
 from __future__ import annotations
 
 import argparse
+import contextlib
 import json
+import os
 import sys
 import time
 
@@ -414,6 +416,169 @@ def _wire_lane_gbps(shm: bool, nbytes: float, args) -> float:
         p.close()
     svc.stop()
     return best
+
+
+#: echo server for the fleet leg, run as a SEPARATE process: the client
+#: threads must not share a GIL with the server under test, or their own
+#: interpreter time pollutes exactly the contention the curve measures
+_FLEET_SERVER_SRC = """
+import sys
+from ps_tpu.backends.van_service import VanService
+from ps_tpu.control import tensor_van as tv
+
+class Echo(VanService):
+    def _handle(self, kind, worker, tensors, extra):
+        return tv.encode_parts(tv.OK, worker, dict(tensors), extra)
+    def _set_draining(self):
+        pass
+
+svc = Echo(bind="127.0.0.1", native_loop=(sys.argv[1] == "native"))
+assert (sys.argv[1] == "native") == svc.native_loop, "loop unavailable"
+print(svc.port, flush=True)
+sys.stdin.read()  # parent closes stdin to stop
+svc.stop(grace=1.0)
+"""
+
+
+@contextlib.contextmanager
+def _fleet_server(mode: str):
+    """One echo-service process ('native' or 'threaded'); yields its
+    port."""
+    import subprocess
+
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    env.pop("PS_VAN_NATIVE_LOOP", None)  # the argv decides, not the env
+    proc = subprocess.Popen(
+        [sys.executable, "-c", _FLEET_SERVER_SRC, mode],
+        stdin=subprocess.PIPE, stdout=subprocess.PIPE, env=env,
+        cwd=os.path.dirname(os.path.abspath(__file__)))
+    try:
+        line = proc.stdout.readline().strip()
+        if not line:
+            raise RuntimeError(f"fleet echo server ({mode}) died at start")
+        yield int(line)
+    finally:
+        try:
+            proc.stdin.close()
+            proc.wait(timeout=20)
+        except Exception:
+            proc.kill()
+            try:
+                proc.wait(timeout=5)  # reap: a zombie + open pipe would
+                # outlive this leg and noise the very measurement it takes
+            except Exception:
+                pass
+
+
+def _fleet_points(port: int, n_conns: int, args) -> float:
+    """Per-connection serve overhead (µs) at ``n_conns`` simulated
+    workers. A small FIXED pool of client threads bursts one small
+    request down every connection, then collects every reply: in-flight
+    fan-in ≈ n_conns, exactly the fleet-wide flush shape, while the
+    client-side cost stays constant across the curve. A
+    perfectly-scaling server keeps (round wall time / n_conns) flat as
+    n_conns grows; thread-per-connection pays N woken Python threads
+    convoying on the server GIL per round. Best-of over short windows
+    (shared hosts; see the lane legs)."""
+    import threading
+
+    import numpy as np
+
+    from ps_tpu.control import tensor_van as tv
+
+    # one small push-shaped request: 4 KiB payload — per-REQUEST cost is
+    # the signal here, not bandwidth (the GB/s legs cover that)
+    frame = bytes(tv.encode(tv.PUSH, 0,
+                            {"g": np.zeros(1024, np.float32)}))
+    chans = [tv.Channel.connect("127.0.0.1", port)
+             for _ in range(n_conns)]
+    k = min(4, n_conns)
+    groups = [chans[i::k] for i in range(k)]
+
+    failed = []
+
+    def burst(group, rounds):
+        try:
+            for _ in range(rounds):
+                for ch in group:
+                    ch.send(frame)
+                for ch in group:
+                    ch.recv()
+        except Exception as e:  # a severed conn must FAIL the point, not
+            failed.append(e)    # silently deflate the us/conn it feeds
+            raise
+
+    for g in groups:
+        burst(g, 2)  # warm allocators + connection state
+    rounds = max(2, (128 if args.quick else 512) // n_conns)
+    reps = 3 if args.quick else 6
+    best = None
+    for _ in range(reps):
+        ts = [threading.Thread(target=burst, args=(g, rounds))
+              for g in groups]
+        t0 = time.monotonic()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        dt = max(time.monotonic() - t0, 1e-9)
+        if failed:
+            raise RuntimeError(
+                f"fleet leg at N={n_conns}: a client thread failed "
+                f"mid-burst ({failed[0]!r}) — the point would undercount"
+            )
+        us = dt / (rounds * n_conns) * 1e6
+        best = us if best is None else min(best, us)
+    for ch in chans:
+        ch.close()
+    return best
+
+
+def bench_fleet(args, retried: bool):
+    """``--model transport --fleet N``: the per-connection overhead curve
+    at N ∈ {4, 16, ..., fleet} simulated workers, native event loop vs
+    thread-per-connection (README "Native event loop"). The acceptance
+    shape: the native curve stays flat (within 2x of its N=4 value) out
+    to 64+ connections while the thread-per-connection curve grows
+    visibly super-linearly with the fan-in."""
+    ns = sorted({n for n in (4, 16, 64) if n <= args.fleet}
+                | {args.fleet})
+    native_curve = {}
+    threaded_curve = {}
+    with _fleet_server("threaded") as port:
+        for n in ns:
+            threaded_curve[n] = round(_fleet_points(port, n, args), 2)
+    with _fleet_server("native") as port:
+        for n in ns:
+            native_curve[n] = round(_fleet_points(port, n, args), 2)
+    n0, n1 = ns[0], ns[-1]
+    print(json.dumps({
+        "metric": "fleet_overhead_us_per_conn",
+        "value": native_curve[n1],
+        "unit": "us/conn",
+        "vs_baseline": None,
+        "detail": {
+            "fleet": args.fleet,
+            "curve_n": ns,
+            "native_us_per_conn": {str(n): native_curve[n] for n in ns},
+            "threaded_us_per_conn": {str(n): threaded_curve[n]
+                                     for n in ns},
+            "native_flatness": round(native_curve[n1]
+                                     / max(native_curve[n0], 1e-9), 3),
+            "threaded_flatness": round(threaded_curve[n1]
+                                       / max(threaded_curve[n0], 1e-9), 3),
+            "threaded_vs_native_at_max": round(
+                threaded_curve[n1] / max(native_curve[n1], 1e-9), 3),
+            "retried": retried,
+            "note": (
+                "per-connection overhead = wall time of one fleet-wide "
+                "burst round / N, best-of over short windows; native = "
+                "epoll event loop (PS_VAN_NATIVE_LOOP), threaded = one "
+                "Python serve thread per connection; flatness = "
+                "us_per_conn at max N / at min N (1.0 = perfectly flat)"
+            ),
+        },
+    }))
 
 
 def bench_transport(args, retried: bool):
@@ -1213,6 +1378,12 @@ def main(argv=None, retried: bool = False):
                          "same-host shared-memory lane")
     ap.add_argument("--no-shm", action="store_true",
                     help="(transport) skip the shm-lane measurement")
+    ap.add_argument("--fleet", type=int, default=None,
+                    help="(transport) run the per-connection overhead "
+                         "curve at up to N simulated workers instead of "
+                         "the bandwidth legs: native event loop vs "
+                         "thread-per-connection (README 'Native event "
+                         "loop')")
     ap.add_argument("--quick", action="store_true",
                     help="(transport) <60s smoke: small tree, few cycles "
                          "(tools/ci_bench_smoke.sh)")
@@ -1234,6 +1405,9 @@ def main(argv=None, retried: bool = False):
 
     if ps.is_initialized():  # retry path: reset the runtime
         ps.shutdown()
+    if args.model == "transport" and args.fleet:
+        bench_fleet(args, retried)
+        return
     {"resnet": bench_resnet, "bert": bench_bert,
      "widedeep": bench_widedeep,
      "transport": bench_transport,

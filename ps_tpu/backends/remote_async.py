@@ -116,7 +116,9 @@ class AsyncPSService(VanService):
                  record_full_history: bool = False,
                  history: int = 4096,
                  coordinator=None,
-                 advertise_host: str = "127.0.0.1"):
+                 advertise_host: str = "127.0.0.1",
+                 native_loop: Optional[bool] = None,
+                 loop_threads: Optional[int] = None):
         engine = store._engine
         if getattr(engine, "mode", "sync") != "async":
             raise ValueError("AsyncPSService requires an async-mode KVStore")
@@ -206,7 +208,8 @@ class AsyncPSService(VanService):
         # outbound move — same ambiguity, coordinator->donor hop
         # starts accepting: state ready
         super().__init__(port=port, bind=bind, writev=writev, shm=shm,
-                         backup=backup)
+                         backup=backup, native_loop=native_loop,
+                         loop_threads=loop_threads)
         if coordinator is not None and not backup:
             # register AFTER the listener is up (the advertised URI needs
             # the bound port); backups join the table only when promoted
@@ -1129,7 +1132,9 @@ def serve_async(store, port: int = 0, bind: str = "127.0.0.1",
                 shard: Optional[int] = None,
                 num_shards: Optional[int] = None,
                 ckpt_root: Optional[str] = None,
-                backup: bool = False) -> "AsyncPSService":
+                backup: bool = False,
+                native_loop: Optional[bool] = None,
+                loop_threads: Optional[int] = None) -> "AsyncPSService":
     """Expose an initialized async KVStore to remote worker processes.
 
     The top-level entry of the cross-process async deployment: each server
@@ -1152,7 +1157,9 @@ def serve_async(store, port: int = 0, bind: str = "127.0.0.1",
     ``svc.attach_backup(host, port, ack=...)`` before admitting workers."""
     return AsyncPSService(store, port=port, bind=bind,
                           shard=shard, num_shards=num_shards,
-                          ckpt_root=ckpt_root, backup=backup)
+                          ckpt_root=ckpt_root, backup=backup,
+                          native_loop=native_loop,
+                          loop_threads=loop_threads)
 
 
 def connect_async(uri: Optional[str], worker: int, params_like,

@@ -121,7 +121,9 @@ class SparsePSService(VanService):
                  record_full_history: bool = False,
                  history: int = 4096,
                  coordinator=None,
-                 advertise_host: str = "127.0.0.1"):
+                 advertise_host: str = "127.0.0.1",
+                 native_loop: Optional[bool] = None,
+                 loop_threads: Optional[int] = None):
         if not tables:
             raise ValueError("no tables to serve")
         if (shard is None) != (num_shards is None):
@@ -194,7 +196,8 @@ class SparsePSService(VanService):
         self._coord_member = None
         # starts accepting: state ready
         super().__init__(port=port, bind=bind, writev=writev, shm=shm,
-                         backup=backup)
+                         backup=backup, native_loop=native_loop,
+                         loop_threads=loop_threads)
         if coordinator is not None and not backup:
             self._join_coordinator(advertise_host)
 
@@ -629,7 +632,9 @@ def serve_sparse(tables: Dict[str, Any], port: int = 0,
                  num_shards: Optional[int] = None,
                  total_rows: Optional[Dict[str, int]] = None,
                  ckpt_root: Optional[str] = None,
-                 backup: bool = False
+                 backup: bool = False,
+                 native_loop: Optional[bool] = None,
+                 loop_threads: Optional[int] = None
                  ) -> "SparsePSService":
     """Expose initialized sparse tables to remote worker processes.
 
@@ -643,7 +648,9 @@ def serve_sparse(tables: Dict[str, Any], port: int = 0,
     "Replication & failover")."""
     return SparsePSService(tables, port=port, bind=bind, shard=shard,
                            num_shards=num_shards, total_rows=total_rows,
-                           ckpt_root=ckpt_root, backup=backup)
+                           ckpt_root=ckpt_root, backup=backup,
+                           native_loop=native_loop,
+                           loop_threads=loop_threads)
 
 
 def connect_sparse(uri: Optional[str], worker: int,

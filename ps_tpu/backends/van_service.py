@@ -147,6 +147,58 @@ def resolve_ckpt_dir(root: Optional[str], client_dir: str) -> str:
     return os.path.join(root, norm)
 
 
+class _DaemonPool:
+    """Tiny reusable-thread pool of DAEMON workers for the native-loop
+    punt path. Spawns a worker per submit only while none is idle (up to
+    ``max_workers``); excess tasks queue. Daemon threads on purpose: a
+    punted request can legitimately park forever (a pause nothing ever
+    resumes after ``kill()``), and that must never block interpreter
+    exit — the same reason per-connection serve threads are daemons. No
+    shutdown needed or offered; an exhausted-and-parked pool only queues
+    work that would have parked anyway, and the draining flag wakes
+    parked tasks into refusal on a normal ``stop()``."""
+
+    def __init__(self, max_workers: int = 32, name: str = "pool"):
+        import queue
+
+        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._max = int(max_workers)
+        self._name = name
+        self._lock = threading.Lock()
+        self._nthreads = 0
+        self._idle = 0
+
+    def submit(self, fn, *args) -> None:
+        # spawn BEFORE queuing: if Thread.start() raises (thread
+        # exhaustion), the exception must reach the caller with the task
+        # NOT enqueued — queue-then-fail would leave a stale task that an
+        # existing worker later runs against state the caller's error
+        # path already released. `idle` may be stale by one task either
+        # way — worst case an extra worker spawns (capped) or a task
+        # briefly queues.
+        with self._lock:
+            if self._idle == 0 and self._nthreads < self._max:
+                threading.Thread(
+                    target=self._run, daemon=True,
+                    name=f"{self._name}-{self._nthreads}",
+                ).start()
+                self._nthreads += 1  # only counted once start succeeded
+        self._q.put((fn, args))
+
+    def _run(self) -> None:
+        while True:
+            with self._lock:
+                self._idle += 1
+            fn, args = self._q.get()
+            with self._lock:
+                self._idle -= 1
+            try:
+                fn(*args)
+            except Exception:
+                logging.getLogger(__name__).exception(
+                    "punted van request failed")
+
+
 class VanService:
     """One listener + per-connection serve threads over the tensor van.
 
@@ -162,7 +214,9 @@ class VanService:
     def __init__(self, port: int = 0, bind: str = "127.0.0.1",
                  writev: Optional[bool] = None,
                  shm: Optional[bool] = None,
-                 backup: bool = False):
+                 backup: bool = False,
+                 native_loop: Optional[bool] = None,
+                 loop_threads: Optional[int] = None):
         from ps_tpu.config import env_flag
 
         # vectored replies (scatter-gather send of live snapshot tensors —
@@ -236,10 +290,86 @@ class VanService:
         self._req_counter = obs.default_registry().counter(
             "ps_server_requests_total", "frames served (all kinds)")
         obs.start_metrics_server()
-        self._accept_thread = threading.Thread(
-            target=self._accept_loop, daemon=True
-        )
-        self._accept_thread.start()
+        # native epoll event-loop data plane (README "Native event loop"):
+        # accept, frame reads, and scatter-gather reply writes run on a
+        # small fixed pool of native threads with the GIL out of the hot
+        # path; ONE Python pump thread drains batches of complete requests
+        # through the same _dispatch the threaded path uses, so typed
+        # refusals, replica forwarding, dedup tokens and tracing spans are
+        # identical by construction. None = the PS_VAN_NATIVE_LOOP env
+        # default (off); non-Linux (or a van build without the nl_* ABI)
+        # falls back to thread-per-connection with a log line.
+        from ps_tpu.control import native_loop as nlmod
+
+        want_loop = (env_flag("PS_VAN_NATIVE_LOOP", False)
+                     if native_loop is None else bool(native_loop))
+        if loop_threads is None:
+            loop_threads = int(os.environ.get("PS_VAN_LOOP_THREADS", "1")
+                               or 1)
+        if not (1 <= loop_threads <= 64):
+            # same bound Config.van_loop_threads enforces — an env value
+            # that bypassed Config must not abort server startup with an
+            # opaque nl_start failure
+            logging.getLogger(__name__).warning(
+                "van loop_threads %d outside [1, 64]; clamping", loop_threads)
+            loop_threads = min(max(loop_threads, 1), 64)
+        self._nloop = None
+        self._pump_thread = None
+        self._accept_thread = None
+        # requests that can BLOCK commit kinds (a punted CHECKPOINT whose
+        # pause flag is not yet visible): raised by the pump before the
+        # blocker thread starts, so the punt decision never races the flag
+        self._loop_blockers = 0
+        # kill() flips this so the pump DROPS queued read-ahead frames
+        # instead of applying them — the SIGKILL-equivalence contract
+        self._pump_abort = False
+        # of _pause_blocked, how many parks sit on native-loop punted
+        # threads (each holding one claimed loop body) — the native
+        # drain's nl_pending discount
+        self._loop_pause_parked = 0
+        if want_loop:
+            if not nlmod.available():
+                logging.getLogger(__name__).warning(
+                    "van_native_loop requested but the native event loop "
+                    "is unavailable on this platform — falling back to "
+                    "thread-per-connection serving"
+                )
+            else:
+                try:
+                    self._nloop = nlmod.NativeEventLoop(
+                        self._listener, threads=loop_threads)
+                except OSError as e:
+                    # genuine nl_start failure (fd exhaustion:
+                    # epoll/eventfd creation) — the documented contract
+                    # is degrade to thread-per-connection, never abort
+                    # server startup
+                    logging.getLogger(__name__).warning(
+                        "native event loop failed to start (%s); falling "
+                        "back to thread-per-connection serving", e)
+        if self._nloop is not None:
+            self._loop_conn_gauge = obs.default_registry().gauge(
+                "ps_van_live_connections",
+                "connections registered in the native event loop")
+            self._loop_iter_gauge = obs.default_registry().gauge(
+                "ps_van_loop_iterations_total",
+                "cumulative native-loop epoll iterations")
+            self._loop_req_gauge = obs.default_registry().gauge(
+                "ps_van_loop_requests_total",
+                "cumulative frames read by the native loop")
+            self._pump_thread = threading.Thread(
+                target=self._loop_pump, daemon=True
+            )
+            self._pump_thread.start()
+        else:
+            self._accept_thread = threading.Thread(
+                target=self._accept_loop, daemon=True
+            )
+            self._accept_thread.start()
+
+    @property
+    def native_loop(self) -> bool:
+        """True when this service serves through the native epoll loop."""
+        return self._nloop is not None
 
     @property
     def port(self) -> int:
@@ -515,6 +645,13 @@ class VanService:
             out["promote_reason"] = self.promote_reason
             out["promotion_s"] = self.promotion_s
         out["dedup_hits"] = self.transport.dedup_hits
+        if self._nloop is not None:
+            # native event-loop serve path: live connections + frames
+            # read — the cell ps_top renders per shard (iterations and
+            # upcall-batch distributions ride the /metrics gauges and
+            # the fleet-telemetry counters instead)
+            out["loop"] = {"conns": self.transport.loop_conns,
+                           "requests": self.transport.loop_requests}
         return out
 
     # -- bucketed-push staging -------------------------------------------------
@@ -621,14 +758,22 @@ class VanService:
 
     def _pause_wait_begin(self) -> None:
         """Subclass hook: call immediately before parking a serve thread on
-        a checkpoint-pause condition (so stop() can discount it)."""
+        a checkpoint-pause condition (so stop() can discount it). Parks
+        on native-loop punted threads are ALSO counted separately: each
+        of those holds exactly one claimed loop body, which the native
+        drain must discount from nl_pending — while a park on an
+        shm-detached classic serve thread holds none."""
         with self._inflight_cond:
             self._pause_blocked += 1
+            if getattr(threading.current_thread(), "_ps_loop_req", False):
+                self._loop_pause_parked += 1
             self._inflight_cond.notify_all()
 
     def _pause_wait_end(self) -> None:
         with self._inflight_cond:
             self._pause_blocked -= 1
+            if getattr(threading.current_thread(), "_ps_loop_req", False):
+                self._loop_pause_parked -= 1
             self._inflight_cond.notify_all()
 
     # -- accept / serve --------------------------------------------------------
@@ -680,13 +825,14 @@ class VanService:
         ``(header, chunks)`` parts (vectored TCP send / one ring write)."""
         send_payload(conn, reply)
 
-    def _serve(self, ch: tv.Channel) -> None:
+    def _serve(self, ch: tv.Channel, lane=None) -> None:
         # `conn` is the data plane: the TCP channel until a successful
         # SHM_SETUP, the shared-memory lane after (the lane's recv hands
         # out ring frames IN PLACE and polls the TCP side for oversize
-        # spills and peer death; stop() still severs via the TCP channel)
-        conn = ch
-        lane = None
+        # spills and peer death; stop() still severs via the TCP channel).
+        # `lane` is pre-set when the native event loop detached an
+        # already-upgraded connection to this thread.
+        conn = lane if lane is not None else ch
         try:
             while not self._stop.is_set():
                 try:
@@ -707,23 +853,8 @@ class VanService:
                         new_lane, reply = self._try_shm_upgrade(
                             ch, worker, extra)
                     else:
-                        try:
-                            reply = self._dispatch(kind, worker, tensors,
-                                                   extra)
-                        except NotServingError as e:  # retryable refusal
-                            reply = tv.encode(tv.ERR, worker, None, extra={
-                                "error": str(e), "backup": True,
-                                "epoch": self.epoch,
-                            })
-                        except StaleTableError as e:  # re-route, not
-                            # failover: the key range moved shards
-                            reply = tv.encode(tv.ERR, worker, None, extra={
-                                "error": str(e), "moved": True,
-                                "table_epoch": self.table_epoch,
-                            })
-                        except Exception as e:  # surface to the worker
-                            reply = tv.encode(tv.ERR, worker, None,
-                                              extra={"error": repr(e)})
+                        reply = self._dispatch_reply_payload(
+                            kind, worker, tensors, extra)
                     try:
                         self._send_reply(conn, reply)
                     except tv.VanError:
@@ -763,6 +894,287 @@ class VanService:
                     self._channels.remove(ch)
                 except ValueError:
                     pass  # stop() snapshot may already hold it
+                # self-prune: under a reconnect storm with NO later
+                # accepts, the accept-loop prune never runs again — a
+                # finished serve thread must not linger in _conns until
+                # the next connection (or forever, on an idle listener)
+                try:
+                    self._conns.remove(threading.current_thread())
+                except ValueError:
+                    pass  # stop() snapshot may already hold it
+
+    # -- native event-loop pump ------------------------------------------------
+
+    #: data-plane kinds that can PARK inside their handler waiting for a
+    #: FUTURE request of this same service (checkpoint pause wakes on
+    #: resume; the sync replica-ack gate can stall on a hung backup):
+    #: the single pump thread must never park, so these are punted to a
+    #: short-lived thread exactly when they could block — everything
+    #: else dispatches inline in the batch.
+    _COMMIT_KINDS = frozenset({tv.PUSH, tv.PUSH_PULL, tv.BUCKET_PUSH,
+                               tv.ROW_PUSH, tv.ROW_PUSH_PULL,
+                               tv.ROW_BUCKET_PUSH})
+    #: kinds whose handlers orchestrate long multi-request protocols
+    #: (checkpoint phases park between coordinator requests; a rebalance /
+    #: outbound migration runs for the whole move) — always punted.
+    _PUNT_KINDS = frozenset({tv.CHECKPOINT, tv.MIGRATE_OUT,
+                             tv.COORD_REBALANCE})
+
+    def _loop_pump(self) -> None:
+        """The ONE Python thread of the native-loop serve path: drain
+        batches of complete requests from the native loop, dispatch each
+        through the same `_dispatch` as the threaded path, reply via the
+        loop's scatter-gather writer. Exits when the loop reports
+        stopped (poll() -> None). A failure serving ONE request must
+        never kill the pump (it is the only consumer): the per-request
+        guard logs, releases the body (free is idempotent), and moves
+        on — the threaded path's one-bad-connection blast radius."""
+        nloop = self._nloop
+        last_sync = 0.0
+        while True:
+            try:
+                batch = nloop.poll(timeout_ms=100)
+            except Exception:
+                logging.getLogger(__name__).exception(
+                    "native-loop poll failed; pump exiting")
+                return
+            # gauge sync is an O(conns) native lock sweep (nl_pending
+            # touches every conn's write mutex): run it on idle ticks or
+            # at most ~1/s under load — /metrics and ps_top refresh at
+            # human timescales, the hot path must not pay per batch
+            now = time.monotonic()
+            if not batch or now - last_sync >= 1.0:
+                last_sync = now
+                st = nloop.stats()
+                self.transport.set_loop_stats(st["iters"], st["requests"],
+                                              st["conns"])
+                self._loop_conn_gauge.set(st["conns"])
+                self._loop_iter_gauge.set(st["iters"])
+                self._loop_req_gauge.set(st["requests"])
+            if batch is None:
+                return
+            if not batch:
+                continue
+            if self._pump_abort:
+                # kill(): drop read-ahead frames unserved — engine state
+                # must stay exactly as a SIGKILL would leave it
+                for _, _, ptr in batch:
+                    nloop.free(ptr)
+                continue
+            self.transport.record_upcall(len(batch))
+            with self._inflight_cond:
+                self._inflight += len(batch)
+            for cid, view, ptr in batch:
+                try:
+                    self._loop_serve_one(cid, view, ptr)
+                except Exception:
+                    logging.getLogger(__name__).exception(
+                        "native-loop request failed; connection %d "
+                        "continues", cid)
+                    nloop.free(ptr)  # idempotent: no-op if already freed
+                finally:
+                    with self._inflight_cond:
+                        self._inflight -= 1
+                        self._inflight_cond.notify_all()
+
+    def _punt_pool(self) -> "_DaemonPool":
+        """Lazily-built pool for non-blocker punted requests (threads
+        spawn on demand and are reused; only the pump calls this, so the
+        lazy init needs no lock). 32 workers: parked pause-era pushes cap
+        there and the rest queue — they would have parked anyway — while
+        resume always arrives on a fresh thread. Daemon threads, NOT a
+        ThreadPoolExecutor: its workers are joined at interpreter exit,
+        so a task parked on a pause that nothing will ever resume (e.g.
+        after kill()) would hang process shutdown — the exact hazard the
+        threaded path avoids by making serve threads daemons."""
+        pool = getattr(self, "_punt_executor", None)
+        if pool is None:
+            pool = _DaemonPool(max_workers=32, name="van-punt")
+            self._punt_executor = pool
+        return pool
+
+    def _loop_close_conn(self, cid: int) -> None:
+        """Drop one event-loop connection (malformed frame — the framing
+        is gone, like the threaded path poisoning its channel)."""
+        fd = self._nloop.detach(cid)
+        if fd >= 0:
+            os.close(fd)
+
+    def _loop_serve_one(self, cid: int, msg, ptr: int) -> None:
+        nloop = self._nloop
+        if self._pump_abort:  # kill() landed mid-batch: drop, don't apply
+            nloop.free(ptr)
+            return
+        try:
+            kind, worker, tensors, extra = tv.decode(msg)
+        except Exception:
+            nloop.free(ptr)
+            self._loop_close_conn(cid)
+            return
+        self._req_counter.inc()
+        if kind == tv.SHUTDOWN:
+            nloop.reply(cid, tv.encode(tv.OK, worker, None),
+                        close_after=True)
+            tensors = None
+            nloop.free(ptr)
+            with self._goodbye_cond:
+                self.goodbyes += 1
+                self._goodbye_cond.notify_all()
+            return
+        if kind == tv.SHM_SETUP:
+            self._loop_shm_upgrade(cid, worker, extra, ptr)
+            return
+        if kind in self._PUNT_KINDS or (
+                kind in self._COMMIT_KINDS
+                and (getattr(self, "_paused", False)
+                     or self._loop_blockers > 0
+                     or self._backup_session is not None)):
+            # a request that may park must not park THE pump: hand it a
+            # thread of its own (the threaded path's shape), bounded by
+            # one in-flight request per connection. `_loop_blockers`
+            # closes the pause TOCTOU: a punted CHECKPOINT sets `_paused`
+            # on ITS thread, so the pump could otherwise inline-dispatch
+            # a push in the race window and park forever on the pause
+            # condition — the counter is raised HERE (synchronously,
+            # before the blocker's thread even starts) and held until
+            # that blocker's reply went out, so every commit the pump
+            # sees after the blocker frame punts too.
+            blocker = kind in self._PUNT_KINDS
+            with self._inflight_cond:
+                self._inflight += 1  # the punted task's share; pump's
+                # own share is released when this method returns
+                if blocker:
+                    self._loop_blockers += 1
+            try:
+                if blocker or getattr(self, "_paused", False) \
+                        or self._loop_blockers > 0:
+                    # fresh threads whenever parking is on the table:
+                    # blockers (a resume must never queue behind pool
+                    # workers parked on the very pause it would lift),
+                    # and EVERY commit while a pause/blocker is live —
+                    # at >pool-size fan-in, a drain_to-admitted push
+                    # queued behind parked pool workers would deadlock
+                    # the checkpoint round until its timeout.
+                    threading.Thread(
+                        target=self._loop_dispatch_reply,
+                        args=(cid, kind, worker, tensors, extra, ptr,
+                              True, blocker),
+                        daemon=True,
+                    ).start()
+                else:
+                    # steady-state punts (every replicated push) reuse a
+                    # small pool — one fresh thread per request would be
+                    # strictly worse churn than the thread-per-connection
+                    # path this loop replaces. Pool exhaustion only
+                    # queues work that genuinely only needs the engine
+                    # lock (no parking condition is live on this branch).
+                    self._punt_pool().submit(
+                        self._loop_dispatch_reply, cid, kind, worker,
+                        tensors, extra, ptr, True, False)
+            except Exception as e:  # thread exhaustion: refuse, don't die
+                with self._inflight_cond:
+                    self._inflight -= 1
+                    if blocker:
+                        self._loop_blockers -= 1
+                    self._inflight_cond.notify_all()
+                nloop.reply(cid, tv.encode(tv.ERR, worker, None,
+                                           extra={"error": repr(e)}))
+                tensors = None
+                nloop.free(ptr)
+            return
+        self._loop_dispatch_reply(cid, kind, worker, tensors, extra, ptr,
+                                  False)
+
+    def _dispatch_reply_payload(self, kind: int, worker: int, tensors,
+                                extra):
+        """Dispatch + the typed-refusal ERR mapping, shared by BOTH serve
+        paths so the frames can never drift (tests pin them
+        byte-identical): NotServing -> retryable backup refusal,
+        StaleTable -> re-route (the key range moved shards), anything
+        else -> a plain ERR surfaced to the worker."""
+        try:
+            return self._dispatch(kind, worker, tensors, extra)
+        except NotServingError as e:
+            return tv.encode(tv.ERR, worker, None, extra={
+                "error": str(e), "backup": True,
+                "epoch": self.epoch,
+            })
+        except StaleTableError as e:
+            return tv.encode(tv.ERR, worker, None, extra={
+                "error": str(e), "moved": True,
+                "table_epoch": self.table_epoch,
+            })
+        except Exception as e:
+            return tv.encode(tv.ERR, worker, None,
+                             extra={"error": repr(e)})
+
+    def _loop_dispatch_reply(self, cid: int, kind: int, worker: int,
+                             tensors, extra, ptr: int,
+                             punted: bool, blocker: bool = False) -> None:
+        nloop = self._nloop
+        # mark this thread as serving a LOOP request for the dispatch's
+        # duration, so a pause park inside the handler is counted toward
+        # the native drain's claimed-body discount (reset in the finally:
+        # pool threads are reused)
+        this = threading.current_thread()
+        this._ps_loop_req = True
+        try:
+            reply = self._dispatch_reply_payload(kind, worker, tensors,
+                                                 extra)
+            try:
+                nloop.reply(cid, reply)  # False = worker vanished
+            finally:
+                # ONLY now is the request frame provably dead (the reply
+                # may alias zero-copy views of it)
+                tensors = None
+                nloop.free(ptr)
+        finally:
+            this._ps_loop_req = False
+            if punted:
+                with self._inflight_cond:
+                    self._inflight -= 1
+                    if blocker:
+                        self._loop_blockers -= 1
+                    self._inflight_cond.notify_all()
+
+    def _loop_shm_upgrade(self, cid: int, worker: int, extra: dict,
+                          ptr: int) -> None:
+        """SHM_SETUP on the event-loop path: detach the fd from the loop
+        and serve the upgraded connection from a dedicated thread — the
+        ring wait (tv_wait_u64) is already GIL-free native code, and epoll
+        cannot wait on ring cursors, so the lane gains nothing from the
+        loop. A refused upgrade keeps the connection on the thread too
+        (plain TCP), mirroring the threaded path's behavior."""
+        from ps_tpu.control import native_loop as nlmod
+
+        nloop = self._nloop
+        nloop.free(ptr)  # SHM_SETUP carries no tensors; extra is decoded
+        fd = nloop.detach(cid)
+        if fd < 0:
+            return  # connection died under the request
+        ch = nlmod.adopt_channel(fd)
+        ch.stats = self.transport
+        ch.pool = self._recv_pool
+        lane, reply = self._try_shm_upgrade(ch, worker, extra)
+        try:
+            self._send_reply(ch, reply)
+        except tv.VanError:
+            if lane is not None:
+                lane.close()
+            else:
+                ch.close()
+            return
+        with self._chan_lock:
+            self._conns = [t for t in self._conns
+                           if t.ident is None or t.is_alive()]
+            if self._stop.is_set():
+                (lane if lane is not None else ch).close()
+                return
+            self._channels.append(ch)
+            t = threading.Thread(target=self._serve, args=(ch, lane),
+                                 daemon=True)
+            self._conns.append(t)
+        t.start()
 
     # -- lifecycle -------------------------------------------------------------
 
@@ -792,7 +1204,18 @@ class VanService:
         it. Workers observe the same typed connection failure a real
         primary death produces; an attached backup session degrades."""
         self._stop.set()
-        self._accept_thread.join(timeout=5)
+        if self._nloop is not None:
+            self._pump_abort = True  # queued frames are DROPPED, not
+            # applied: a kill must leave the engine as SIGKILL would
+            self._nloop.stop_accept()
+            self._nloop.shutdown_conns()
+            self._nloop.begin_stop()
+            self._pump_thread.join(timeout=5)
+            if not self._pump_thread.is_alive():
+                self._nloop.close()  # a pump stuck mid-apply keeps the
+                # handle alive (its reply/free calls no-op after close)
+        else:
+            self._accept_thread.join(timeout=5)
         self._listener.close()
         s = self._backup_session
         if s is not None:
@@ -820,6 +1243,9 @@ class VanService:
         ``_set_draining`` and given a short bounded window to send their
         ERR replies before the sever."""
         self._stop.set()
+        if self._nloop is not None:
+            self._stop_native(grace)
+            return
         # join BEFORE closing: the accept thread may be inside tv_accept on
         # the listener handle (its 200ms timeout bounds the wait); closing
         # first would hand it a freed pointer
@@ -869,6 +1295,85 @@ class VanService:
                 "%d serve thread(s) outlived the drain join; their pushes "
                 "are refused by the draining flag", len(stragglers)
             )
+        s = self._backup_session
+        if s is not None:
+            s.close()  # after the drain: every acked commit replicated
+
+    def _stop_native(self, grace: float) -> None:
+        """stop() for the native event-loop path — the same drain
+        contract, over different machinery: "in flight" is the pump's
+        accounting PLUS the loop's pending count (frames read but not yet
+        handed out, claimed frames awaiting their reply, and unflushed
+        reply tails), so a reply the loop has not finished writing is
+        never torn by the sever."""
+        nloop = self._nloop
+        nloop.stop_accept()  # freeze the connection set
+        deadline = time.monotonic() + grace
+
+        def quiet() -> bool:
+            with self._inflight_cond:
+                infl = self._inflight - self._pause_blocked
+                parked = self._loop_pause_parked
+            # pause-parked LOOP requests each hold exactly one claimed
+            # body (freed only at their reply), so they must be
+            # discounted from the loop's pending count too — same
+            # docstring promise as the threaded drain: a coordinator
+            # dead between pause and resume must not cost the full
+            # grace. Only loop parks count here: a park on an
+            # shm-detached serve thread holds no loop body, and
+            # over-discounting could mask a genuinely unflushed tail.
+            return infl <= 0 and nloop.pending() - parked <= 0
+
+        drained = False
+        while time.monotonic() < deadline:
+            if quiet():
+                # stability confirm, as in the threaded drain: a frame
+                # the loop JUST completed may not be counted yet
+                time.sleep(0.05)
+                if quiet():
+                    drained = True
+                    break
+            else:
+                time.sleep(0.02)
+        if not drained:
+            logging.getLogger(__name__).warning(
+                "request(s) still in flight after %.1fs drain grace; "
+                "severing anyway", grace
+            )
+        self._set_draining()
+        # pause-parked punted requests just woke into refusal: bounded
+        # window for their ERR replies, then for the loop to flush them
+        with self._inflight_cond:
+            end = min(deadline, time.monotonic() + 2.0)
+            while self._inflight > 0 and time.monotonic() < end:
+                self._inflight_cond.wait(max(end - time.monotonic(), 0.01))
+        end = min(deadline, time.monotonic() + 0.5)
+        while nloop.pending() > 0 and time.monotonic() < end:
+            time.sleep(0.02)
+        nloop.shutdown_conns()  # idle peers observe EOF now
+        nloop.begin_stop()
+        self._pump_thread.join(timeout=5)
+        # shm-detached connections are classic serve threads: sever + join
+        with self._chan_lock:
+            chans = list(self._channels)
+            conns = list(self._conns)
+        for ch in chans:
+            ch.shutdown()
+        for t in conns:
+            t.join(timeout=5)
+        stragglers = [t for t in conns if t.is_alive()]
+        if self._pump_thread.is_alive():
+            stragglers.append(self._pump_thread)
+        if stragglers:
+            logging.getLogger(__name__).warning(
+                "%d serve/pump thread(s) outlived the drain join; their "
+                "pushes are refused by the draining flag", len(stragglers)
+            )
+        if not self._pump_thread.is_alive():
+            nloop.close()  # frees the loop; skipped only while the pump
+            # (the one poll() caller) could still touch the raw handle —
+            # punted threads' reply/free calls no-op after close
+        self._listener.close()
         s = self._backup_session
         if s is not None:
             s.close()  # after the drain: every acked commit replicated

@@ -41,6 +41,12 @@ Environment variables honored by :meth:`Config.from_env`:
   makes servers refuse offers (job-wide off switch)
 - ``PS_SHM_BYTES``          — ring capacity per direction for the shm lane
   (default 16 MiB — cache-resident)
+- ``PS_VAN_NATIVE_LOOP``    — '1' serves van connections from the native
+  epoll event loop (GIL-free accept/read/writev; one Python pump thread
+  for engine applies — README "Native event loop"); default off =
+  thread-per-connection, also the fallback on non-Linux platforms
+- ``PS_VAN_LOOP_THREADS``   — native event-loop thread-pool size
+  (default 1; connections are assigned round-robin)
 - ``PS_CKPT_ROOT``          — server side: confine CHECKPOINT saves under
   this root (client paths relative-only, ``..`` refused)
 - ``PS_REPLICAS``           — replica-set size per shard (1 = no
@@ -177,6 +183,17 @@ class Config:
       shm_bytes: ring capacity per direction for the shm lane (default
         16 MiB — small enough to stay cache-resident; frames over
         half a ring spill to TCP transparently).
+      van_native_loop: serve van connections from the native epoll event
+        loop (README "Native event loop"): accept, frame reads and
+        scatter-gather reply writes run on a small pool of native
+        threads with the GIL out of the hot path; Python handles only
+        batched engine applies on one pump thread. Per-connection cost
+        stays flat to 64+ workers vs the thread-per-connection default.
+        Off by default (explicit opt-in, like shm); non-Linux platforms
+        fall back to thread-per-connection regardless.
+      van_loop_threads: native event-loop thread-pool size (default 1 —
+        one loop thread saturates loopback; raise for many-NIC hosts).
+        Connections are assigned round-robin at accept.
       replicas: replica-set size per shard (ps_tpu/replica): 1 = classic
         unreplicated servers; 2 = primary + warm backup with live
         failover. Launchers size the server fleet with it; workers learn
@@ -302,6 +319,12 @@ class Config:
     writev: bool = True
     shm: bool = False
     shm_bytes: int = 16 << 20
+    # native epoll event-loop serve path (README "Native event loop"):
+    # GIL-free accept/read/writev on van_loop_threads native threads, one
+    # Python pump thread for applies. Off = thread-per-connection (also
+    # the non-Linux fallback).
+    van_native_loop: bool = False
+    van_loop_threads: int = 1
     # server: confine CHECKPOINT saves under this root (client paths must
     # be relative, '..' escapes refused). None = legacy client-names-path.
     ckpt_root: Optional[str] = None
@@ -435,6 +458,11 @@ class Config:
                 f"shm_bytes {self.shm_bytes} too small: the ring needs at "
                 f"least 64 KiB per direction to be worth negotiating"
             )
+        if not (1 <= self.van_loop_threads <= 64):
+            raise ValueError(
+                f"van_loop_threads {self.van_loop_threads} outside [1, 64] "
+                f"(the native loop's thread-pool bound)"
+            )
         if self.replicas < 1:
             raise ValueError("replicas must be >= 1 (1 = no replication)")
         if self.replica_ack not in ("sync", "async"):
@@ -567,6 +595,10 @@ class Config:
             kwargs["shm"] = env_flag("PS_SHM", False)
         if "PS_SHM_BYTES" in env:
             kwargs["shm_bytes"] = int(env["PS_SHM_BYTES"])
+        if "PS_VAN_NATIVE_LOOP" in env:
+            kwargs["van_native_loop"] = env_flag("PS_VAN_NATIVE_LOOP", False)
+        if "PS_VAN_LOOP_THREADS" in env:
+            kwargs["van_loop_threads"] = int(env["PS_VAN_LOOP_THREADS"])
         if "PS_CKPT_ROOT" in env:
             kwargs["ckpt_root"] = env["PS_CKPT_ROOT"] or None
         if "PS_REPLICAS" in env:

@@ -1,0 +1,282 @@
+"""Python driver for the native epoll event-loop data plane.
+
+The serve side of the van historically ran one Python thread per worker
+connection (:class:`~ps_tpu.backends.van_service.VanService`). At fleet
+scale the GIL and per-thread stacks become the ceiling — the reference
+family (ps-lite's ZMQVan, BytePS's core) runs its receive/send pump as a
+native event loop with the interpreter out of the hot path. This module
+wraps that loop (the ``nl_*`` ABI in ps_tpu/native/van.cpp): accept,
+frame reads, and scatter-gather reply writes run on a small fixed pool of
+native threads (default 1) with the GIL untouched; Python's involvement
+shrinks to ONE pump thread that calls :meth:`NativeEventLoop.poll` (GIL
+released for the wait) and receives a BATCH of complete request frames to
+decode/dispatch — one upcall per batch, not one thread per connection.
+
+Ownership contract (mirrors the C side):
+
+- a polled request's body buffer belongs to Python until :meth:`free`
+  (replies may alias the request's tensors, so free AFTER the reply);
+- :meth:`reply` never retains the caller's buffers — whatever the socket
+  does not take immediately is copied to a native tail buffer and flushed
+  by the loop on EPOLLOUT;
+- :meth:`close` may only run after the pump thread exited (poll returned
+  ``None``); the driver serializes that with ``begin_stop``.
+
+Linux-only (epoll); :func:`available` gates the fallback to the classic
+thread-per-connection serve path.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import sys
+import threading
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from ps_tpu.native import load
+
+#: max requests one poll() hands back — the upcall batch bound (also the
+#: natural batch-size cap the ps_van_upcall_batch histogram observes)
+MAX_BATCH = 64
+
+_configured = None
+
+
+def _lib():
+    global _configured
+    lib = load("van")
+    if _configured is lib:
+        return lib
+    lib.nl_start.restype = ctypes.c_void_p
+    lib.nl_start.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.nl_poll.restype = ctypes.c_int
+    lib.nl_poll.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_uint64),
+        ctypes.c_int, ctypes.c_int,
+    ]
+    lib.nl_reply_vec.restype = ctypes.c_int
+    lib.nl_reply_vec.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.POINTER(ctypes.c_void_p),
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_int, ctypes.c_int,
+    ]
+    lib.nl_body_free.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+    lib.nl_detach.restype = ctypes.c_int
+    lib.nl_detach.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.nl_stop_accept.argtypes = [ctypes.c_void_p]
+    lib.nl_shutdown_conns.argtypes = [ctypes.c_void_p]
+    lib.nl_pending.restype = ctypes.c_uint64
+    lib.nl_pending.argtypes = [ctypes.c_void_p]
+    lib.nl_conn_count.restype = ctypes.c_int
+    lib.nl_conn_count.argtypes = [ctypes.c_void_p]
+    lib.nl_stats.argtypes = [ctypes.c_void_p,
+                             ctypes.POINTER(ctypes.c_uint64)]
+    lib.nl_begin_stop.argtypes = [ctypes.c_void_p]
+    lib.nl_stop.argtypes = [ctypes.c_void_p]
+    lib.tv_adopt_fd.restype = ctypes.c_void_p
+    lib.tv_adopt_fd.argtypes = [ctypes.c_int]
+    _configured = lib
+    return lib
+
+
+def available() -> bool:
+    """True when the native event loop can run here: Linux (epoll) and a
+    van build exposing the ``nl_*`` symbols."""
+    if not sys.platform.startswith("linux"):
+        return False
+    try:
+        return hasattr(_lib(), "nl_start")
+    except Exception:
+        return False
+
+
+class NativeEventLoop:
+    """One running ``nl_*`` loop over an existing van Listener.
+
+    The listener stays owned by the caller and must outlive :meth:`close`
+    (the loop only borrows its fd). All methods are safe from the pump
+    thread; :meth:`close` additionally requires the pump to have exited.
+    """
+
+    def __init__(self, listener, threads: int = 1):
+        self._lib = _lib()
+        self._lock = threading.Lock()
+        # liveness pin, mirroring the C side's per-conn pin: reply() must
+        # NOT hold the driver lock across its native call (a multi-MB
+        # reply tail memcpy would serialize every other caller behind
+        # it); instead callers pin the handle, run lock-free, unpin —
+        # and close() waits out the pins before freeing
+        self._cv = threading.Condition(self._lock)
+        self._users = 0
+        self._closed = False
+        h = self._lib.nl_start(listener._h, int(threads))
+        if not h:
+            raise OSError("native event loop failed to start")
+        self._h = h
+        self.threads = int(threads)
+        self._ids = (ctypes.c_uint64 * MAX_BATCH)()
+        self._ptrs = (ctypes.c_void_p * MAX_BATCH)()
+        self._lens = (ctypes.c_uint64 * MAX_BATCH)()
+        self._stats_out = (ctypes.c_uint64 * 6)()
+        # bodies currently claimed by Python (poll handed them out, free
+        # not yet called): makes free() IDEMPOTENT — an error-path caller
+        # can release unconditionally without risking a double free
+        self._claimed = set()
+
+    # -- pump side -----------------------------------------------------------
+
+    def poll(self, timeout_ms: int = 100
+             ) -> Optional[List[Tuple[int, memoryview, int]]]:
+        """Wait (GIL released) for ready requests. Returns a list of
+        ``(conn_id, frame_view, body_ptr)`` — possibly empty on timeout —
+        or None once the loop is stopping and fully drained (the pump's
+        exit signal). The frame view aliases native memory owned by the
+        caller until :meth:`free`."""
+        if self._closed:  # racing close(): the loop is gone
+            return None
+        n = self._lib.nl_poll(self._h, self._ids, self._ptrs, self._lens,
+                              MAX_BATCH, int(timeout_ms))
+        if n < 0:
+            return None
+        out = []
+        with self._lock:
+            for i in range(n):
+                ptr, ln = self._ptrs[i], self._lens[i]
+                if ln:
+                    view = memoryview(
+                        (ctypes.c_char * ln).from_address(ptr)).cast("B")
+                else:
+                    view = memoryview(b"")
+                self._claimed.add(int(ptr))
+                out.append((int(self._ids[i]), view, int(ptr)))
+        return out
+
+    def reply(self, conn_id: int, payload, close_after: bool = False
+              ) -> bool:
+        """Send one reply frame — a contiguous bytes/bytearray or the
+        zero-copy ``(header, chunks)`` parts form. The buffers are used
+        only for the duration of the call (an unsent tail is copied
+        native-side). False = the connection is gone."""
+        if isinstance(payload, tuple):
+            header, chunks = payload
+            views = [np.frombuffer(header, np.uint8)]
+            views += [np.frombuffer(c, np.uint8) for c in chunks if len(c)]
+        else:
+            views = [np.frombuffer(payload, np.uint8)]
+        n = len(views)
+        ptrs = (ctypes.c_void_p * n)(*(v.ctypes.data for v in views))
+        lens = (ctypes.c_uint64 * n)(*(v.nbytes for v in views))
+        if not self._pin():
+            return False
+        try:
+            ok = self._lib.nl_reply_vec(self._h, conn_id, ptrs, lens, n,
+                                        1 if close_after else 0)
+        finally:
+            self._unpin()
+        del views  # pinned the sources for exactly the call's duration
+        return bool(ok)
+
+    def _pin(self) -> bool:
+        with self._cv:
+            if self._closed:
+                return False
+            self._users += 1
+            return True
+
+    def _unpin(self) -> None:
+        with self._cv:
+            self._users -= 1
+            if self._users == 0:
+                self._cv.notify_all()
+
+    def free(self, body_ptr: int) -> None:
+        """Release one request body (AFTER the reply — it may alias).
+        Idempotent: a body already freed (or never claimed) is a no-op,
+        so error paths can release unconditionally."""
+        with self._lock:
+            if self._closed or body_ptr not in self._claimed:
+                return
+            self._claimed.discard(body_ptr)
+            self._lib.nl_body_free(self._h, body_ptr)
+
+    def detach(self, conn_id: int) -> int:
+        """Pull a connection out of the loop; returns its raw fd in
+        blocking mode (-1 = connection already gone). The SHM_SETUP
+        upgrade path adopts the fd into a classic Channel + serve
+        thread."""
+        if not self._pin():  # detach can wait on the owner thread — it
+            return -1        # must not hold the driver lock meanwhile
+        try:
+            return int(self._lib.nl_detach(self._h, conn_id))
+        finally:
+            self._unpin()
+
+    # -- lifecycle / introspection -------------------------------------------
+
+    def stop_accept(self) -> None:
+        with self._lock:
+            if not self._closed:
+                self._lib.nl_stop_accept(self._h)
+
+    def shutdown_conns(self) -> None:
+        with self._lock:
+            if not self._closed:
+                self._lib.nl_shutdown_conns(self._h)
+
+    def begin_stop(self) -> None:
+        """Signal shutdown: loop threads exit, poll() drains then returns
+        None. Does not free — call :meth:`close` after the pump joined."""
+        with self._lock:
+            if not self._closed:
+                self._lib.nl_begin_stop(self._h)
+
+    def pending(self) -> int:
+        """Requests not yet fully answered (ready + claimed by Python +
+        unflushed reply tails) — what stop()'s drain waits out."""
+        with self._lock:
+            if self._closed:
+                return 0
+            return int(self._lib.nl_pending(self._h))
+
+    def conn_count(self) -> int:
+        with self._lock:
+            if self._closed:
+                return 0
+            return int(self._lib.nl_conn_count(self._h))
+
+    def stats(self) -> dict:
+        """Cumulative loop counters: epoll iterations, accepted
+        connections, requests read, live connections, pending, claimed."""
+        with self._lock:
+            if self._closed:
+                return {"iters": 0, "accepted": 0, "requests": 0,
+                        "conns": 0, "pending": 0, "claimed": 0}
+            self._lib.nl_stats(self._h, self._stats_out)
+            o = self._stats_out
+            return {"iters": int(o[0]), "accepted": int(o[1]),
+                    "requests": int(o[2]), "conns": int(o[3]),
+                    "pending": int(o[4]), "claimed": int(o[5])}
+
+    def close(self) -> None:
+        """Join the loop threads and free everything. The pump thread must
+        have exited (poll returned None) before this runs; pinned callers
+        (replies/detaches mid-call on punted threads) are waited out —
+        their calls are bounded (non-blocking writes + memcpy)."""
+        with self._cv:
+            if self._closed:
+                return
+            self._closed = True  # no NEW pin can be taken
+            while self._users > 0:
+                self._cv.wait()
+            self._lib.nl_stop(self._h)
+            self._h = None
+
+
+def adopt_channel(fd: int):
+    """Wrap a detached raw fd as a blocking :class:`tensor_van.Channel`."""
+    from ps_tpu.control import tensor_van as tv
+
+    h = _lib().tv_adopt_fd(int(fd))
+    return tv.Channel(h, tv._lib())

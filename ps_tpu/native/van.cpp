@@ -22,11 +22,14 @@
 // the GIL for the duration of each call); handles are opaque pointers.
 
 #include <arpa/inet.h>
+#include <fcntl.h>
 #include <limits.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <cerrno>
 #include <poll.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
 #include <sys/socket.h>
 #include <sys/time.h>
 #include <sys/uio.h>
@@ -34,11 +37,17 @@
 
 #include <atomic>
 #include <chrono>
+#include <condition_variable>
 #include <cstdint>
+#include <cstdlib>
 #include <cstring>
+#include <deque>
+#include <functional>
 #include <map>
+#include <memory>
 #include <mutex>
 #include <set>
+#include <string>
 #include <thread>
 #include <vector>
 
@@ -566,6 +575,692 @@ void tv_close(void* h) {
   shutdown(c->fd, SHUT_RDWR);
   close(c->fd);
   delete c;
+}
+
+// Wrap an already-connected fd (e.g. one detached from the event loop
+// below) as a blocking Conn handle the Channel wrapper can drive.
+void* tv_adopt_fd(int fd) {
+  int fl = fcntl(fd, F_GETFL, 0);
+  if (fl >= 0) fcntl(fd, F_SETFL, fl & ~O_NONBLOCK);
+  int one = 1;
+  setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+  auto* c = new Conn();
+  c->fd = fd;
+  return c;
+}
+
+// ---------------------------------------------------------------------------
+// Native epoll event loop ("nl_*"): the server-side serve/pump hot loop for
+// N connections on a small fixed pool of native threads — accept, frame
+// reads, and scatter-gather reply writes all run here with the Python
+// interpreter entirely out of the picture. Python's part shrinks to ONE
+// pump thread calling nl_poll (GIL released), which hands back a BATCH of
+// complete request frames; the pump decodes/dispatches each and answers
+// with nl_reply_vec (immediate non-blocking writev of the live reply
+// tensors; any unsent tail is buffered and dribbled out by the loop on
+// EPOLLOUT). Per-connection cost is one ~200-byte struct + one epoll
+// registration instead of a Python thread + stack — the thing that keeps
+// per-connection overhead flat to 64+ workers.
+//
+// Threading model: `nthreads` loop threads, each owning a private epoll
+// set; connections are assigned round-robin at accept and are only read /
+// destroyed by their owner thread. Cross-thread work arrives either as a
+// queued command (run by the owner between epoll_wait batches) or through
+// the per-connection write mutex (nl_reply_vec runs on the Python pump
+// thread). Lock order: loop table mutex -> per-conn write mutex; the
+// ready queue has its own mutex. Request bodies are malloc'd per frame
+// and owned by Python from nl_poll until nl_body_free.
+
+namespace {
+
+constexpr uint32_t kNlMaxOutstanding = 1024;  // queued frames per conn
+// before the peer is declared abusive (every in-tree client is
+// request/reply per connection, so the real depth is 1..window)
+constexpr uint64_t kNlMaxWbufBacklog = 64ull << 20;  // staged-reply
+// BACKLOG bound per conn: one reply of any size may stage its unsent
+// tail, but a pipelining peer that stops READING does not get further
+// replies copied behind it without limit — the threaded path's blocking
+// send bounded this to one in-flight reply; here the bound is explicit
+
+struct NlThread;
+
+struct NlConn {
+  int fd = -1;
+  uint64_t id = 0;
+  int owner = 0;  // loop-thread index
+  // read state: owner thread only
+  uint8_t lenbuf[8];
+  int lenoff = 0;
+  char* body = nullptr;  // frame body mid-read
+  uint64_t body_len = 0, body_off = 0;
+  bool dead = false;  // removed from the table; freed at iteration end
+  // write state: guarded by wmu (pump thread replies, owner flushes)
+  std::mutex wmu;
+  std::string wbuf;  // unsent reply tail (only populated when the
+  size_t woff = 0;   // immediate non-blocking writev could not finish)
+  uint32_t outstanding = 0;  // frames queued/claimed, reply not yet sent
+  uint32_t pins = 0;  // repliers inside the conn (guarded by loop tmu):
+  // nl_reply_vec pins under a BRIEF table lock, writes under wmu only,
+  // unpins; destroy waits for 0 — so a multi-MB reply memcpy never
+  // serializes accepts/destroys/other replies behind the global table
+  bool want_write = false;   // EPOLLOUT armed
+  bool close_after = false;  // goodbye: destroy once the tail drains
+};
+
+struct NlReq {
+  uint64_t conn_id;
+  char* body;
+  uint64_t len;
+};
+
+struct NlThread {
+  int epfd = -1;
+  int evfd = -1;
+  std::thread th;
+  std::mutex cmu;
+  std::vector<std::function<void(NlThread&)>> cmds;
+  std::vector<NlConn*> graveyard;  // owner-thread only (and nl_stop)
+};
+
+struct NlLoop {
+  Listener* listener = nullptr;  // borrowed: Python closes it after nl_stop
+  std::atomic<bool> stop{false};
+  std::atomic<bool> accepting{true};
+  int nthreads = 1;
+  std::deque<NlThread> threads;  // deque: NlThread is not movable
+  std::mutex tmu;                // conn table
+  std::condition_variable pin_cv;  // destroy/detach wait out repliers
+  std::map<uint64_t, NlConn*> conns;
+  uint64_t next_id = 1;
+  uint64_t rr = 0;
+  std::mutex qmu;  // ready queue
+  std::condition_variable qcv;
+  std::deque<NlReq> ready;
+  std::atomic<uint64_t> iters{0}, accepted{0}, requests{0};
+  std::atomic<uint64_t> popped{0}, freed{0};
+};
+
+void nl_wake(NlThread& t) {
+  uint64_t one = 1;
+  ssize_t r = write(t.evfd, &one, sizeof(one));
+  (void)r;
+}
+
+// Owner thread (or nl_stop after join): unlink + free one connection.
+void nl_destroy(NlLoop* l, NlThread& t, NlConn* c) {
+  {
+    std::unique_lock<std::mutex> lock(l->tmu);
+    l->conns.erase(c->id);  // erased first: no NEW pin can be taken
+    while (c->pins > 0) l->pin_cv.wait(lock);  // a replier mid-write
+    // still holds live pointers into the struct and its fd
+  }
+  epoll_ctl(t.epfd, EPOLL_CTL_DEL, c->fd, nullptr);
+  close(c->fd);
+  free(c->body);
+  c->body = nullptr;
+  c->dead = true;
+  t.graveyard.push_back(c);  // freed at iteration end: events already
+  // fetched in this batch may still point at the struct
+}
+
+// Owner thread: read everything available on c; queue complete frames.
+void nl_read(NlLoop* l, NlThread& t, NlConn* c) {
+  while (true) {
+    if (c->body == nullptr) {
+      ssize_t r = recv(c->fd, c->lenbuf + c->lenoff, 8 - c->lenoff, 0);
+      if (r == 0) { nl_destroy(l, t, c); return; }
+      if (r < 0) {
+        if (errno == EINTR) continue;
+        if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+        nl_destroy(l, t, c);
+        return;
+      }
+      c->lenoff += (int)r;
+      if (c->lenoff < 8) continue;
+      uint64_t len;
+      memcpy(&len, c->lenbuf, 8);
+      c->lenoff = 0;
+      if (len > kMaxFrame) { nl_destroy(l, t, c); return; }
+      c->body = static_cast<char*>(malloc(len ? len : 1));
+      if (!c->body) { nl_destroy(l, t, c); return; }
+      c->body_len = len;
+      c->body_off = 0;
+    }
+    while (c->body_off < c->body_len) {
+      ssize_t r = recv(c->fd, c->body + c->body_off,
+                       c->body_len - c->body_off, 0);
+      if (r == 0) { nl_destroy(l, t, c); return; }
+      if (r < 0) {
+        if (errno == EINTR) continue;
+        if (errno == EAGAIN || errno == EWOULDBLOCK) return;
+        nl_destroy(l, t, c);
+        return;
+      }
+      c->body_off += (uint64_t)r;
+    }
+    uint32_t out;
+    {
+      std::lock_guard<std::mutex> lock(c->wmu);
+      out = ++c->outstanding;
+    }
+    if (out > kNlMaxOutstanding) {
+      free(c->body);
+      c->body = nullptr;
+      nl_destroy(l, t, c);
+      return;
+    }
+    {
+      std::lock_guard<std::mutex> lock(l->qmu);
+      l->ready.push_back({c->id, c->body, c->body_len});
+    }
+    l->requests.fetch_add(1, std::memory_order_relaxed);
+    l->qcv.notify_one();
+    c->body = nullptr;
+    c->body_len = c->body_off = 0;
+  }
+}
+
+// Owner thread: flush the buffered reply tail; returns false when the
+// connection must be destroyed (hard error, or goodbye fully flushed).
+bool nl_flush(NlThread& t, NlConn* c) {
+  std::lock_guard<std::mutex> lock(c->wmu);
+  while (c->woff < c->wbuf.size()) {
+    ssize_t r = send(c->fd, c->wbuf.data() + c->woff,
+                     c->wbuf.size() - c->woff, MSG_DONTWAIT | MSG_NOSIGNAL);
+    if (r < 0) {
+      if (errno == EINTR) continue;
+      if (errno == EAGAIN || errno == EWOULDBLOCK) return true;
+      return false;
+    }
+    c->woff += (size_t)r;
+  }
+  if (c->wbuf.capacity() > (1u << 20)) {
+    // release a large spill's capacity instead of pinning it for the
+    // connection's lifetime (64 conns that each spilled once would
+    // otherwise hold their high-water marks forever)
+    std::string().swap(c->wbuf);
+  } else {
+    c->wbuf.clear();
+  }
+  c->woff = 0;
+  if (c->close_after) return false;
+  if (c->want_write) {
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.ptr = c;
+    epoll_ctl(t.epfd, EPOLL_CTL_MOD, c->fd, &ev);
+    c->want_write = false;
+  }
+  return true;
+}
+
+void nl_accept(NlLoop* l, NlThread& t0) {
+  while (l->accepting.load(std::memory_order_relaxed)) {
+    int fd = accept(l->listener->fd, nullptr, nullptr);
+    if (fd < 0) return;  // EAGAIN (nonblocking listener) or closed
+    int fl = fcntl(fd, F_GETFL, 0);
+    fcntl(fd, F_SETFL, fl | O_NONBLOCK);
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof(one));
+    auto* c = new NlConn();
+    c->fd = fd;
+    int ti;
+    {
+      std::lock_guard<std::mutex> lock(l->tmu);
+      c->id = l->next_id++;
+      ti = (int)(l->rr++ % (uint64_t)l->nthreads);
+      c->owner = ti;
+      l->conns[c->id] = c;
+    }
+    l->accepted.fetch_add(1, std::memory_order_relaxed);
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.ptr = c;
+    if (ti == 0) {
+      epoll_ctl(t0.epfd, EPOLL_CTL_ADD, fd, &ev);
+    } else {
+      NlThread& t = l->threads[ti];
+      {
+        std::lock_guard<std::mutex> lock(t.cmu);
+        t.cmds.push_back([c](NlThread& th) {
+          if (c->dead) return;
+          epoll_event e{};
+          e.events = EPOLLIN;
+          e.data.ptr = c;
+          epoll_ctl(th.epfd, EPOLL_CTL_ADD, c->fd, &e);
+        });
+      }
+      nl_wake(t);
+    }
+  }
+}
+
+void nl_thread_run(NlLoop* l, int ti) {
+  NlThread& t = l->threads[ti];
+  epoll_event evs[64];
+  while (!l->stop.load(std::memory_order_relaxed)) {
+    int n = epoll_wait(t.epfd, evs, 64, 100);
+    l->iters.fetch_add(1, std::memory_order_relaxed);
+    {
+      std::vector<std::function<void(NlThread&)>> cmds;
+      {
+        std::lock_guard<std::mutex> lock(t.cmu);
+        cmds.swap(t.cmds);
+      }
+      for (auto& cmd : cmds) cmd(t);
+    }
+    for (int i = 0; i < n; ++i) {
+      void* p = evs[i].data.ptr;
+      if (p == (void*)&t) {  // eventfd wakeup: drain it
+        uint64_t v;
+        ssize_t r = read(t.evfd, &v, sizeof(v));
+        (void)r;
+        continue;
+      }
+      if (p == (void*)l) {  // listener (thread 0 only)
+        nl_accept(l, t);
+        continue;
+      }
+      auto* c = static_cast<NlConn*>(p);
+      if (c->dead) continue;  // a command in this batch destroyed it
+      if (evs[i].events & (EPOLLHUP | EPOLLERR)) {
+        // flush what we can first: a goodbye OK may still be in the
+        // tail while the peer half-closed its side
+        if (!(evs[i].events & EPOLLOUT) || !nl_flush(t, c)) {
+          nl_destroy(l, t, c);
+          continue;
+        }
+      }
+      if (evs[i].events & EPOLLIN) nl_read(l, t, c);
+      if (!c->dead && (evs[i].events & EPOLLOUT)) {
+        if (!nl_flush(t, c)) nl_destroy(l, t, c);
+      }
+    }
+    for (auto* g : t.graveyard) delete g;
+    t.graveyard.clear();
+  }
+}
+
+}  // namespace
+
+// Start the event loop over an existing tv_listen handle: the loop takes
+// over accepting (the listener fd goes non-blocking and into thread 0's
+// epoll set). `nthreads` loop threads serve connections round-robin.
+// The listener handle stays owned by the caller — close it only AFTER
+// nl_stop. Returns nullptr on failure.
+void* nl_start(void* listener, int nthreads) {
+  auto* lst = static_cast<Listener*>(listener);
+  if (!lst || nthreads < 1 || nthreads > 64) return nullptr;
+  auto* l = new NlLoop();
+  l->listener = lst;
+  l->nthreads = nthreads;
+  int fl = fcntl(lst->fd, F_GETFL, 0);
+  fcntl(lst->fd, F_SETFL, fl | O_NONBLOCK);
+  bool ok = true;
+  for (int i = 0; i < nthreads; ++i) {
+    l->threads.emplace_back();
+    NlThread& t = l->threads.back();
+    t.epfd = epoll_create1(0);
+    t.evfd = eventfd(0, EFD_NONBLOCK);
+    if (t.epfd < 0 || t.evfd < 0) { ok = false; break; }
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.ptr = (void*)&t;
+    epoll_ctl(t.epfd, EPOLL_CTL_ADD, t.evfd, &ev);
+  }
+  if (ok) {
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.ptr = (void*)l;
+    ok = epoll_ctl(l->threads[0].epfd, EPOLL_CTL_ADD, lst->fd, &ev) == 0;
+  }
+  if (!ok) {
+    for (auto& t : l->threads) {
+      if (t.epfd >= 0) close(t.epfd);
+      if (t.evfd >= 0) close(t.evfd);
+    }
+    delete l;
+    return nullptr;
+  }
+  for (int i = 0; i < nthreads; ++i)
+    l->threads[i].th = std::thread([l, i] { nl_thread_run(l, i); });
+  return l;
+}
+
+// Pump upcall: block (GIL released by ctypes) until >= 1 complete request
+// is ready, then fill the out arrays with up to `cap` of them. Returns the
+// batch size (0 = timeout), or -1 once the loop is stopping AND drained.
+// Each body pointer is owned by the caller until nl_body_free.
+int nl_poll(void* h, uint64_t* conn_ids, void** bodies, uint64_t* lens,
+            int cap, int timeout_ms) {
+  auto* l = static_cast<NlLoop*>(h);
+  std::unique_lock<std::mutex> lock(l->qmu);
+  if (l->ready.empty()) {
+    if (l->stop.load(std::memory_order_relaxed)) return -1;
+    // wait_until(system_clock), NOT wait_for: libstdc++ 10 lowers
+    // wait_for to pthread_cond_clockwait, which this toolchain's TSan
+    // does not intercept — the wait's internal unlock/relock becomes
+    // invisible and every later qmu use reports as a phantom race /
+    // double lock. system_clock waits lower to the intercepted
+    // pthread_cond_timedwait. (A wall-clock jump can stretch one 100ms
+    // poll tick; the pump loops, so that is harmless.)
+    l->qcv.wait_until(lock, std::chrono::system_clock::now()
+                                + std::chrono::milliseconds(timeout_ms),
+                      [l] { return !l->ready.empty()
+                                 || l->stop.load(std::memory_order_relaxed); });
+  }
+  if (l->ready.empty())
+    return l->stop.load(std::memory_order_relaxed) ? -1 : 0;
+  int n = 0;
+  while (n < cap && !l->ready.empty()) {
+    NlReq& r = l->ready.front();
+    conn_ids[n] = r.conn_id;
+    bodies[n] = r.body;
+    lens[n] = r.len;
+    ++n;
+    l->ready.pop_front();
+  }
+  l->popped.fetch_add((uint64_t)n, std::memory_order_relaxed);
+  return n;
+}
+
+// Reply to one request: an immediate non-blocking scatter-gather writev of
+// the u64 length prefix + the caller's live buffers; whatever the socket
+// would not take NOW is copied to the connection's tail buffer and flushed
+// by the owner loop thread on EPOLLOUT (the caller's buffers are NEVER
+// referenced after this returns). `close_after` severs the connection once
+// the reply is fully on the wire (SHUTDOWN goodbyes). Returns 1, or 0 when
+// the connection is already gone (the worker vanished mid-reply).
+int nl_reply_vec(void* h, uint64_t conn_id, const void** bufs,
+                 const uint64_t* lens, int n, int close_after) {
+  auto* l = static_cast<NlLoop*>(h);
+  NlConn* c;
+  {
+    // pin under a BRIEF table lock, then write under the per-conn wmu
+    // only: a multi-MB reply must not serialize accepts/destroys/other
+    // repliers behind the global table. nl_destroy waits out the pin
+    // before freeing, so the struct and fd stay valid for the write.
+    std::lock_guard<std::mutex> tlock(l->tmu);
+    auto it = l->conns.find(conn_id);
+    if (it == l->conns.end()) return 0;
+    c = it->second;
+    ++c->pins;
+  }
+  std::unique_lock<std::mutex> wlock(c->wmu);
+  if (c->outstanding) --c->outstanding;
+  uint64_t total = 0;
+  for (int i = 0; i < n; ++i) total += lens[i];
+  uint64_t len_le = total;
+  bool fail = false;
+  if (c->wbuf.empty()) {
+    // fast path: hand the live buffers straight to the kernel
+    std::vector<iovec> iov;
+    iov.reserve((size_t)n + 1);
+    iov.push_back({&len_le, sizeof(len_le)});
+    for (int i = 0; i < n; ++i)
+      if (lens[i])
+        iov.push_back({const_cast<void*>(bufs[i]), (size_t)lens[i]});
+    size_t idx = 0;
+    while (idx < iov.size()) {
+      size_t cnt = iov.size() - idx;
+      if (cnt > (size_t)IOV_MAX) cnt = (size_t)IOV_MAX;
+      msghdr mh{};
+      mh.msg_iov = &iov[idx];
+      mh.msg_iovlen = cnt;
+      ssize_t r = sendmsg(c->fd, &mh, MSG_DONTWAIT | MSG_NOSIGNAL);
+      if (r < 0) {
+        if (errno == EINTR) continue;
+        if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+        fail = true;
+        break;
+      }
+      while (r > 0 && idx < iov.size()) {
+        if ((size_t)r >= iov[idx].iov_len) {
+          r -= (ssize_t)iov[idx].iov_len;
+          ++idx;
+        } else {
+          iov[idx].iov_base = (char*)iov[idx].iov_base + r;
+          iov[idx].iov_len -= (size_t)r;
+          r = 0;
+        }
+      }
+    }
+    // stage only the unsent tail (zero bytes in the common case)
+    for (; idx < iov.size(); ++idx)
+      c->wbuf.append((const char*)iov[idx].iov_base, iov[idx].iov_len);
+  } else if (c->wbuf.size() - c->woff > kNlMaxWbufBacklog) {
+    // the peer has stopped reading while pipelining more requests:
+    // refusing to buffer further replies bounds server memory (the
+    // conn is severed as protocol abuse, like the outstanding cap)
+    fail = true;
+  } else {
+    // a tail is already queued: append whole frames behind it in order
+    c->wbuf.append((const char*)&len_le, sizeof(len_le));
+    for (int i = 0; i < n; ++i)
+      if (lens[i]) c->wbuf.append((const char*)bufs[i], (size_t)lens[i]);
+  }
+  int ret = 1;
+  if (fail) {
+    // hard send error: sever; the owner thread observes EOF and reaps
+    shutdown(c->fd, SHUT_RDWR);
+    ret = 0;
+  } else {
+    if (close_after) c->close_after = true;
+    if ((!c->wbuf.empty() || c->close_after) && !c->want_write) {
+      // arm EPOLLOUT so the owner flushes the tail (or reaps the
+      // goodbye: a writable socket fires it immediately)
+      c->want_write = true;
+      epoll_event ev{};
+      ev.events = EPOLLIN | EPOLLOUT;
+      ev.data.ptr = c;
+      epoll_ctl(l->threads[c->owner].epfd, EPOLL_CTL_MOD, c->fd, &ev);
+    }
+  }
+  wlock.unlock();
+  {
+    std::lock_guard<std::mutex> tlock(l->tmu);
+    if (--c->pins == 0) l->pin_cv.notify_all();
+  }
+  return ret;
+}
+
+// Release one request body handed out by nl_poll (after the reply — the
+// reply buffers may alias the request's tensors).
+void nl_body_free(void* h, void* body) {
+  auto* l = static_cast<NlLoop*>(h);
+  free(body);
+  l->freed.fetch_add(1, std::memory_order_relaxed);
+}
+
+// Detach a connection from the loop and return its raw fd (blocking mode
+// restored) — the SHM_SETUP path: a negotiated shared-memory lane needs a
+// dedicated serve thread (its ring wait is already GIL-free native code;
+// epoll cannot wait on ring cursors). Runs ON the owner thread via the
+// command queue so it cannot race the read path. Returns -1 if the
+// connection is gone (or the loop is stopping).
+int nl_detach(void* h, uint64_t conn_id) {
+  auto* l = static_cast<NlLoop*>(h);
+  NlConn* c = nullptr;
+  int ti = 0;
+  {
+    std::lock_guard<std::mutex> lock(l->tmu);
+    auto it = l->conns.find(conn_id);
+    if (it == l->conns.end()) return -1;
+    c = it->second;
+    ti = c->owner;
+  }
+  struct DetachState {
+    std::mutex mu;
+    std::condition_variable cv;
+    bool done = false;
+    bool abandoned = false;  // caller timed out: the command must CLOSE
+    int out_fd = -1;         // the fd instead of handing it to nobody
+  };
+  // shared_ptr, not stack refs: if this wait times out, the command may
+  // still run later (nl_stop executes leftovers) and must not write to a
+  // dead frame
+  auto st = std::make_shared<DetachState>();
+  NlThread& t = l->threads[ti];
+  {
+    std::lock_guard<std::mutex> lock(t.cmu);
+    t.cmds.push_back([l, conn_id, st](NlThread& th) {
+      NlConn* c2 = nullptr;
+      {
+        std::unique_lock<std::mutex> tl(l->tmu);
+        auto it = l->conns.find(conn_id);
+        if (it != l->conns.end()) {
+          c2 = it->second;
+          l->conns.erase(it);
+          // a replier mid-write holds the struct and fd: wait it out
+          // (same discipline as nl_destroy) before handing the fd away
+          while (c2->pins > 0) l->pin_cv.wait(tl);
+        }
+      }
+      int fd = -1;
+      if (c2 != nullptr) {
+        epoll_ctl(th.epfd, EPOLL_CTL_DEL, c2->fd, nullptr);
+        fd = c2->fd;
+        int fl = fcntl(fd, F_GETFL, 0);
+        fcntl(fd, F_SETFL, fl & ~O_NONBLOCK);
+        free(c2->body);
+        c2->body = nullptr;
+        c2->dead = true;
+        th.graveyard.push_back(c2);
+      }
+      std::lock_guard<std::mutex> dl(st->mu);
+      if (st->abandoned) {
+        // the caller gave up: the conn is already out of the table, so
+        // nl_stop would never close this fd — do it here or it leaks
+        // (and the peer hangs forever with no EOF)
+        if (fd >= 0) close(fd);
+      } else {
+        st->out_fd = fd;
+      }
+      st->done = true;
+      st->cv.notify_one();
+    });
+  }
+  nl_wake(t);
+  std::unique_lock<std::mutex> lock(st->mu);
+  // bounded wait: if the loop stopped before running the command,
+  // nl_stop executes leftovers after joining — done still flips.
+  // wait_until(system_clock), not wait_for: see nl_poll (TSan does not
+  // intercept the clockwait that wait_for lowers to on this toolchain)
+  st->cv.wait_until(lock, std::chrono::system_clock::now()
+                              + std::chrono::seconds(10),
+                    [&st] { return st->done; });
+  if (!st->done) st->abandoned = true;  // late command closes the fd
+  return st->done ? st->out_fd : -1;
+}
+
+// Stop admitting connections (the first leg of the drain): the listener
+// leaves thread 0's epoll set and pending accepts are abandoned.
+void nl_stop_accept(void* h) {
+  auto* l = static_cast<NlLoop*>(h);
+  l->accepting.store(false, std::memory_order_relaxed);
+  epoll_ctl(l->threads[0].epfd, EPOLL_CTL_DEL, l->listener->fd, nullptr);
+}
+
+// Sever every live connection NOW (stop()/kill()): each peer observes EOF
+// and each owner thread reaps its conns on the resulting events.
+void nl_shutdown_conns(void* h) {
+  auto* l = static_cast<NlLoop*>(h);
+  std::lock_guard<std::mutex> lock(l->tmu);
+  for (auto& kv : l->conns) shutdown(kv.second->fd, SHUT_RDWR);
+}
+
+// Requests not yet fully answered: ready-queue frames + frames claimed by
+// Python (nl_poll'd, not yet nl_body_free'd) + connections with an
+// unflushed reply tail. The drain in stop() waits for 0.
+uint64_t nl_pending(void* h) {
+  auto* l = static_cast<NlLoop*>(h);
+  uint64_t claimed = l->popped.load(std::memory_order_relaxed)
+                     - l->freed.load(std::memory_order_relaxed);
+  uint64_t unflushed = 0;
+  {
+    std::lock_guard<std::mutex> lock(l->tmu);
+    for (auto& kv : l->conns) {
+      std::lock_guard<std::mutex> wl(kv.second->wmu);
+      if (!kv.second->wbuf.empty()) ++unflushed;
+    }
+  }
+  uint64_t ready;
+  {
+    std::lock_guard<std::mutex> lock(l->qmu);
+    ready = (uint64_t)l->ready.size();
+  }
+  return ready + claimed + unflushed;
+}
+
+int nl_conn_count(void* h) {
+  auto* l = static_cast<NlLoop*>(h);
+  std::lock_guard<std::mutex> lock(l->tmu);
+  return (int)l->conns.size();
+}
+
+// out[6]: iterations, accepted, requests, live conns, pending, claimed.
+void nl_stats(void* h, uint64_t* out) {
+  auto* l = static_cast<NlLoop*>(h);
+  out[0] = l->iters.load(std::memory_order_relaxed);
+  out[1] = l->accepted.load(std::memory_order_relaxed);
+  out[2] = l->requests.load(std::memory_order_relaxed);
+  out[3] = (uint64_t)nl_conn_count(h);
+  out[4] = nl_pending(h);
+  out[5] = l->popped.load(std::memory_order_relaxed)
+           - l->freed.load(std::memory_order_relaxed);
+}
+
+// Begin shutdown WITHOUT freeing: loop threads exit, nl_poll drains the
+// remaining ready frames and then returns -1. The Python pump exits on
+// that -1; only then may nl_stop run.
+void nl_begin_stop(void* h) {
+  auto* l = static_cast<NlLoop*>(h);
+  l->stop.store(true, std::memory_order_relaxed);
+  l->accepting.store(false, std::memory_order_relaxed);
+  for (auto& t : l->threads) nl_wake(t);
+  l->qcv.notify_all();
+}
+
+// Join + free. Contract: no nl_poll/nl_reply_vec/nl_detach caller may be
+// inside the handle (the Python driver joins its pump first). Bodies still
+// claimed by Python are NOT freed here (Python may hold live views into
+// them); unclaimed ready-queue bodies are.
+void nl_stop(void* h) {
+  auto* l = static_cast<NlLoop*>(h);
+  nl_begin_stop(h);
+  for (auto& t : l->threads)
+    if (t.th.joinable()) t.th.join();
+  for (auto& t : l->threads) {
+    // leftover commands (e.g. a detach posted as the loop stopped) must
+    // still resolve their waiters
+    std::vector<std::function<void(NlThread&)>> cmds;
+    {
+      std::lock_guard<std::mutex> lock(t.cmu);
+      cmds.swap(t.cmds);
+    }
+    for (auto& cmd : cmds) cmd(t);
+    for (auto* g : t.graveyard) delete g;
+    t.graveyard.clear();
+  }
+  {
+    std::lock_guard<std::mutex> lock(l->tmu);
+    for (auto& kv : l->conns) {
+      close(kv.second->fd);
+      free(kv.second->body);
+      delete kv.second;
+    }
+    l->conns.clear();
+  }
+  {
+    std::lock_guard<std::mutex> lock(l->qmu);
+    for (auto& r : l->ready) free(r.body);
+    l->ready.clear();
+  }
+  for (auto& t : l->threads) {
+    close(t.epfd);
+    close(t.evfd);
+  }
+  delete l;
 }
 
 }  // extern "C"

