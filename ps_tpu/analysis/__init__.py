@@ -1,6 +1,8 @@
 """Repo-aware static analysis for the PS data plane (``tools/pslint.py``).
 
-Four rule families over the ``ps_tpu`` tree (README "Static analysis"):
+Six rule families over the ``ps_tpu`` tree (README "Static analysis") —
+four Python, and since PR 10 two that cross the language boundary into
+the native van:
 
 - **PSL1xx concurrency** (:mod:`ps_tpu.analysis.locks`): blocking calls
   under hot locks, foreign condition waits, logging I/O in critical
@@ -12,11 +14,29 @@ Four rule families over the ``ps_tpu`` tree (README "Static analysis"):
   RecvBufferPool borrow/return pairing, shm segment close/unlink
   pairing, span open/close exception safety, non-daemon threads.
 - **PSL4xx knob/doc drift** (:mod:`ps_tpu.analysis.knobs`): Config field
-  ↔ ``PS_*`` env mirror ↔ README ↔ config docstrings, four-way.
+  ↔ ``PS_*`` env mirror ↔ README ↔ config docstrings, four-way — plus
+  PSL406, raw ``os.environ`` reads of ``PS_*`` names outside the Config
+  module (service-level mirrors go through the validated
+  ``config.env_*`` readers).
+- **PSL5xx native concurrency & ownership**
+  (:mod:`ps_tpu.analysis.native`, over the clang-free C++ model in
+  :mod:`ps_tpu.analysis.cpp`): lock-order cycles against the declared
+  ``tmu -> wmu`` hierarchy, blocking/allocating under ``hot-lock``
+  mutexes, the ``wait_for``→``pthread_cond_clockwait`` TSan ban, and
+  malloc/free pairing against ``// pslint: owns:``/``transfers:``
+  ownership annotations on the ``nl_*`` ABI.
+- **PSL6xx cross-language ABI drift** (:mod:`ps_tpu.analysis.abi`):
+  every ``extern "C"`` signature in the van diffed against each ctypes
+  site's ``argtypes``/``restype`` (arity, pointer-vs-int width, the
+  missing-restype-defaults-to-c_int truncation), calls without
+  declarations, and exported-but-never-bound symbols.
 
 Run as a gate: ``python tools/pslint.py ps_tpu/`` must exit 0; the
 tier-1 test ``tests/test_analysis.py::test_repo_lints_clean`` enforces
-the same. Suppress a deliberate violation inline, with a reason::
+the same — ``--native-only``/``--py-only`` select a language side, and
+``--baseline``/``--write-baseline`` give future PRs a ratchet. Suppress
+a deliberate violation inline, with a reason (the C++ spelling is the
+same after ``//``)::
 
     blocking_call()  # pslint: disable=PSL101 -- bounded by stall_timeout
 

@@ -1,0 +1,279 @@
+"""PSL6xx — cross-language ABI drift: extern "C" vs the ctypes sites.
+
+The native van exports one C ABI (``ps_tpu/native/van.cpp``) consumed by
+three separately-maintained ctypes declaration sites
+(``control/heartbeat.py``, ``control/tensor_van.py``,
+``control/native_loop.py``). Nothing but convention kept them in sync:
+a parameter added on the C side, a forgotten ``restype`` (ctypes then
+defaults to ``c_int`` and silently TRUNCATES a 64-bit pointer/size on
+the way out — the classic heisenbug), or a symbol renamed in one place
+only, all compile fine and fail at a distance. This family parses every
+``extern "C"`` *definition* in the indexed C++ sources and every
+``lib.<sym>.argtypes``/``.restype`` assignment plus ``lib.<sym>(...)``
+call in the linted Python tree, and diffs them:
+
+- **PSL601** — ``argtypes`` disagrees with the C signature: wrong
+  arity, or a parameter whose ctypes width/kind cannot carry the C type
+  (``c_int`` for a ``uint64_t``, a typed ``POINTER`` of the wrong
+  element, an integer where C takes a pointer). The finding names the
+  authoritative C signature and its location.
+- **PSL602** — ``restype`` missing for a non-int return (the
+  silent-truncation default), or declared but wrong (including a
+  restype on a ``void`` function).
+- **PSL603** — Python calls an exported symbol that no linted file ever
+  declared ``argtypes`` for: every argument then crosses the boundary
+  un-checked.
+- **PSL604** — drift: a symbol exported but neither bound nor called
+  anywhere (dead ABI surface — or the binding was dropped), or Python
+  binding a symbol the C side does not export (caught before the
+  ``AttributeError`` at runtime, and only for symbols sharing a prefix
+  family — ``tv_``/``hb_``/``nl_`` — with real exports, so bindings of
+  unrelated libraries never false-positive).
+
+Width notes encoded in ``_PARAM_OK``: ``c_void_p`` is accepted for any
+pointer (the repo deliberately passes buffer pointers that way), and
+``c_char_p`` only for ``char*``/``void*`` (it re-encodes, so a typed
+pointer declared ``c_char_p`` is drift, not style).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from ps_tpu.analysis.core import (
+    Finding,
+    RepoIndex,
+    rule,
+    terminal_name,
+)
+
+_INT_OK = {
+    "int": {"c_int"},
+    "uint32_t": {"c_uint32"},
+    "uint64_t": {"c_uint64"},
+    "int64_t": {"c_int64"},
+    "int32_t": {"c_int32"},
+}
+
+_PTR_OK = {
+    "char": {"c_char_p", "c_void_p"},
+    "void": {"c_void_p", "c_char_p"},
+    "uint64_t": {"POINTER(c_uint64)", "c_void_p"},
+    "uint32_t": {"POINTER(c_uint32)", "c_void_p"},
+    "int64_t": {"POINTER(c_int64)", "c_void_p"},
+    "int": {"POINTER(c_int)", "c_void_p"},
+}
+
+#: return-type acceptance; "" means "no restype declared" (ctypes
+#: defaults to c_int, which is only correct for int)
+_RET_OK = {
+    "void": {"", "None"},
+    "int": {"c_int", ""},
+    "int32_t": {"c_int32", "c_int", ""},
+    "uint32_t": {"c_uint32"},
+    "uint64_t": {"c_uint64"},
+    "int64_t": {"c_int64"},
+}
+
+
+class CExport:
+    def __init__(self, name: str, signature: str, path: str, line: int,
+                 ret: Tuple[str, int], params: List[Tuple[str, int]]):
+        self.name = name
+        self.signature = signature
+        self.path = path
+        self.line = line
+        self.ret = ret          # (base type, pointer depth)
+        self.params = params
+
+
+def _c_type(tok: str) -> Optional[Tuple[str, int]]:
+    """``"const char* bind_addr"`` -> ``("char", 1)``; None = no type."""
+    stars = tok.count("*")
+    words = [w for w in tok.replace("*", " ").split()
+             if w not in ("const", "struct", "volatile", "restrict",
+                          "static", "inline", "constexpr")]
+    if not words:
+        return None
+    # drop the parameter name when present ("int port" -> int)
+    base = words[0]
+    return base, stars
+
+
+def _param_ok(ctype: Tuple[str, int]) -> Set[str]:
+    base, stars = ctype
+    if stars >= 2:
+        return {"POINTER(c_void_p)", "c_void_p"}
+    if stars == 1:
+        return _PTR_OK.get(base, {"c_void_p"})
+    return _INT_OK.get(base, set())  # unknown scalar: never flagged
+
+
+def _ret_ok(ctype: Tuple[str, int]) -> Set[str]:
+    base, stars = ctype
+    if stars >= 1:
+        return {"c_void_p"}  # handles/buffers must come back full-width
+    return _RET_OK.get(base, set())
+
+
+def _exports(index: RepoIndex) -> Dict[str, CExport]:
+    out: Dict[str, CExport] = {}
+    for sf in index.cpp_files:
+        for fn in sf.functions:
+            if not fn.extern_c:
+                continue
+            ret = _c_type(fn.ret)
+            if ret is None:
+                continue
+            raw = [p for p in fn.params.split(",")]
+            params: List[Tuple[str, int]] = []
+            ok = True
+            for p in raw:
+                p = p.strip()
+                if not p or p == "void":
+                    continue
+                ct = _c_type(p)
+                if ct is None:
+                    ok = False
+                    break
+                params.append(ct)
+            if ok:
+                out.setdefault(fn.name, CExport(
+                    fn.name, fn.signature, sf.path, fn.line, ret, params))
+    return out
+
+
+def _ctypes_name(node: ast.AST) -> Optional[str]:
+    """Canonical string for a ctypes type expression: ``c_void_p``,
+    ``POINTER(c_uint64)``, ``None``; None-return = unrecognized."""
+    if isinstance(node, ast.Constant) and node.value is None:
+        return "None"
+    if isinstance(node, ast.Call):
+        if terminal_name(node.func) == "POINTER" and len(node.args) == 1:
+            inner = terminal_name(node.args[0])
+            return f"POINTER({inner})" if inner else None
+        return None
+    return terminal_name(node)
+
+
+class _Binding:
+    def __init__(self):
+        self.argtypes: Optional[List[Optional[str]]] = None
+        self.argtypes_line = 0
+        self.restype: Optional[str] = None  # None = never declared
+        self.restype_line = 0
+
+
+def _scan_python(index: RepoIndex, symbols: Set[str]):
+    """Per (file, symbol) bindings + first call site per (file, symbol).
+    A binding is any ``<recv>.<sym>.argtypes/.restype = ...`` whose
+    ``sym`` shares a prefix family with the exports (so bindings of
+    other ctypes libraries never join this diff)."""
+    prefixes = {s.split("_", 1)[0] + "_" for s in symbols if "_" in s}
+    bindings: Dict[Tuple[str, str], _Binding] = {}
+    calls: Dict[Tuple[str, str], int] = {}
+    for sf in index.files:
+        for node in ast.walk(sf.tree):
+            if isinstance(node, ast.Assign) and len(node.targets) == 1:
+                tgt = node.targets[0]
+                if not (isinstance(tgt, ast.Attribute)
+                        and tgt.attr in ("argtypes", "restype")
+                        and isinstance(tgt.value, ast.Attribute)):
+                    continue
+                sym = tgt.value.attr
+                if sym not in symbols \
+                        and not any(sym.startswith(p) for p in prefixes):
+                    continue
+                b = bindings.setdefault((sf.path, sym), _Binding())
+                if tgt.attr == "argtypes":
+                    b.argtypes_line = node.lineno
+                    if isinstance(node.value, (ast.List, ast.Tuple)):
+                        b.argtypes = [_ctypes_name(e)
+                                      for e in node.value.elts]
+                else:
+                    b.restype_line = node.lineno
+                    b.restype = _ctypes_name(node.value) or "?"
+            elif isinstance(node, ast.Call):
+                fn = node.func
+                if isinstance(fn, ast.Attribute) and fn.attr in symbols:
+                    calls.setdefault((sf.path, fn.attr), node.lineno)
+    return bindings, calls
+
+
+@rule("PSL6", "cross-language ABI drift: extern \"C\" signatures vs "
+              "ctypes argtypes/restype/call sites")
+def check_abi(index: RepoIndex):
+    findings: List[Finding] = []
+    exports = _exports(index)
+    if not exports:
+        return findings
+    bindings, calls = _scan_python(index, set(exports))
+
+    declared_symbols = {sym for (_path, sym), b in bindings.items()
+                        if b.argtypes is not None}
+    for (path, sym), b in sorted(bindings.items()):
+        exp = exports.get(sym)
+        line = b.argtypes_line or b.restype_line
+        if exp is None:
+            findings.append(Finding(
+                "PSL604", "P1", path, line,
+                f"ctypes binds {sym!r} but no extern \"C\" definition "
+                f"exports it — renamed or dropped on the C side "
+                f"(drift; this fails as AttributeError at runtime)"))
+            continue
+        where = f"{exp.path}:{exp.line}"
+        if b.argtypes is not None:
+            if len(b.argtypes) != len(exp.params):
+                findings.append(Finding(
+                    "PSL601", "P0", path, b.argtypes_line,
+                    f"argtypes arity {len(b.argtypes)} != {len(exp.params)}"
+                    f" parameters of C `{exp.signature}` ({where}) — "
+                    f"every call site corrupts the native stack"))
+            else:
+                for i, (decl, cparam) in enumerate(
+                        zip(b.argtypes, exp.params)):
+                    ok = _param_ok(cparam)
+                    if decl is not None and ok and decl not in ok:
+                        base, stars = cparam
+                        cstr = base + "*" * stars
+                        findings.append(Finding(
+                            "PSL601", "P0", path, b.argtypes_line,
+                            f"argtypes[{i}] is {decl} but parameter {i} "
+                            f"of C `{exp.signature}` ({where}) is "
+                            f"{cstr} — width/kind mismatch corrupts the "
+                            f"value at the boundary"))
+        ret_ok = _ret_ok(exp.ret)
+        declared_ret = b.restype if b.restype is not None else ""
+        if ret_ok and declared_ret not in ret_ok and declared_ret != "?":
+            if declared_ret == "":
+                findings.append(Finding(
+                    "PSL602", "P0", path, line,
+                    f"no restype declared for C `{exp.signature}` "
+                    f"({where}) — ctypes defaults to c_int, silently "
+                    f"TRUNCATING the 64-bit return on the way out"))
+            else:
+                findings.append(Finding(
+                    "PSL602", "P0", path, b.restype_line,
+                    f"restype {declared_ret} does not match the return "
+                    f"of C `{exp.signature}` ({where})"))
+
+    for (path, sym), line in sorted(calls.items()):
+        if sym not in declared_symbols:
+            exp = exports[sym]
+            findings.append(Finding(
+                "PSL603", "P1", path, line,
+                f"{sym}() is called but no linted file declares its "
+                f"argtypes (C `{exp.signature}`, {exp.path}:{exp.line})"
+                f" — arguments cross the ABI unchecked"))
+
+    used = {sym for (_p, sym) in bindings} | {sym for (_p, sym) in calls}
+    for sym, exp in sorted(exports.items()):
+        if sym not in used:
+            findings.append(Finding(
+                "PSL604", "P2", exp.path, exp.line,
+                f"extern \"C\" {sym} is exported but never bound or "
+                f"called from Python — dead ABI surface, or the "
+                f"binding site was dropped (drift)"))
+    return findings

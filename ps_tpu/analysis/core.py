@@ -89,6 +89,10 @@ class SourceFile:
         return entry is not None and rule_id in entry[0]
 
 
+#: native sources the cross-language families (PSL5xx/PSL6xx) scan
+CPP_SUFFIXES = (".cpp", ".cc", ".cxx", ".h", ".hpp")
+
+
 class RepoIndex:
     """Every file a lint run can see.
 
@@ -97,6 +101,14 @@ class RepoIndex:
     ``tools/`` keeps the producing site in ``ps_tpu/`` clean, but nothing
     in a context file is ever reported. ``readme`` is the prose side of
     the knob-drift family.
+
+    ``cpp_files`` are the native sources, collected from the linted
+    roots AND the context roots, and — unlike Python context — always
+    linted: the producer/consumer asymmetry that context exists for is a
+    Python-rule concept, while the native invariants (lock order, the
+    ``wait_for`` toolchain ban, ownership annotations) bind the
+    sanitizer driver under ``tools/`` exactly as hard as the shipped
+    ``ps_tpu/native`` sources.
     """
 
     def __init__(self, paths: Iterable[str],
@@ -104,6 +116,7 @@ class RepoIndex:
                  readme: Optional[str] = None):
         self.files: List[SourceFile] = []
         self.context: List[SourceFile] = []
+        self.cpp_files: list = []  # List[cpp.CppSourceFile]
         self.readme_path = readme
         self.readme_text = ""
         self.errors: List[Finding] = []
@@ -114,14 +127,16 @@ class RepoIndex:
             seen.add(path)
             sf = self._load(path)
             if sf is not None:
-                self.files.append(sf)
+                (self.cpp_files if path.endswith(CPP_SUFFIXES)
+                 else self.files).append(sf)
         for path in self._expand(context):
             if path in seen:
                 continue
             seen.add(path)
             sf = self._load(path)
             if sf is not None:
-                self.context.append(sf)
+                (self.cpp_files if path.endswith(CPP_SUFFIXES)
+                 else self.context).append(sf)
         if readme:
             try:
                 with open(readme, encoding="utf-8") as f:
@@ -130,6 +145,7 @@ class RepoIndex:
                 self.readme_text = ""
 
     def _expand(self, paths: Iterable[str]) -> List[str]:
+        exts = (".py",) + CPP_SUFFIXES
         out: List[str] = []
         for p in paths:
             if os.path.isdir(p):
@@ -137,9 +153,9 @@ class RepoIndex:
                     dirs[:] = sorted(d for d in dirs
                                      if d not in ("__pycache__", ".git"))
                     for n in sorted(names):
-                        if n.endswith(".py"):
+                        if n.endswith(exts):
                             out.append(os.path.join(root, n))
-            elif os.path.isfile(p) and p.endswith(".py"):
+            elif os.path.isfile(p) and p.endswith(exts):
                 out.append(p)
             else:
                 # a typo'd/renamed root must FAIL the gate, not silently
@@ -150,10 +166,14 @@ class RepoIndex:
                     "nothing was linted for this argument"))
         return out
 
-    def _load(self, path: str) -> Optional[SourceFile]:
+    def _load(self, path: str):
         try:
             with open(path, encoding="utf-8") as f:
                 text = f.read()
+            if path.endswith(CPP_SUFFIXES):
+                from ps_tpu.analysis.cpp import CppSourceFile
+
+                return CppSourceFile(path, text)
             return SourceFile(path, text)
         except (OSError, SyntaxError) as e:
             self.errors.append(Finding(
@@ -186,16 +206,24 @@ def rule(rule_id_prefix: str, doc: str):
 
 def all_rules() -> Dict[str, Tuple[str, RuleFn]]:
     # import for side effect: each family module registers itself
-    from ps_tpu.analysis import knobs, locks, resources, wire  # noqa: F401
+    from ps_tpu.analysis import (  # noqa: F401
+        abi,
+        knobs,
+        locks,
+        native,
+        resources,
+        wire,
+    )
 
     return dict(_RULES)
 
 
 def _suppression_findings(index: RepoIndex) -> List[Finding]:
     """PSL001: a suppression with no reason is a violation itself —
-    the gate must never be quietable without a justification string."""
+    the gate must never be quietable without a justification string.
+    Applies to both languages (``# pslint:`` and ``// pslint:``)."""
     out: List[Finding] = []
-    for sf in index.files:
+    for sf in index.files + index.cpp_files:
         for line, (ids, reason) in sorted(sf.suppressions.items()):
             if not reason:
                 out.append(Finding(
@@ -212,15 +240,18 @@ def _suppression_findings(index: RepoIndex) -> List[Finding]:
 
 def run_lint(paths: Iterable[str], context: Iterable[str] = (),
              readme: Optional[str] = None,
-             rules: Optional[Iterable[str]] = None) -> List[Finding]:
+             rules: Optional[Iterable[str]] = None,
+             timings: Optional[Dict[str, float]] = None) -> List[Finding]:
     """Run every registered rule family over ``paths``; returns the
     surviving (unsuppressed) findings, worst severity first.
 
     ``rules`` entries may be family prefixes (``PSL1``) or concrete ids
     (``PSL101`` — runs the family, keeps only matching findings). An
     entry matching no registered family raises ``ValueError``: a typo'd
-    selection must never yield a silent 'clean'.
+    selection must never yield a silent 'clean'. ``timings``, when a
+    dict, receives per-family wall seconds (the CI budget probe).
     """
+    import time
     registry = sorted(all_rules().items())
     selected = None
     if rules is not None:
@@ -240,7 +271,10 @@ def run_lint(paths: Iterable[str], context: Iterable[str] = (),
                 r.startswith(prefix) or prefix.startswith(r)
                 for r in selected):
             continue
-        fam = fn(index)
+        t0 = time.monotonic()
+        fam = list(fn(index))
+        if timings is not None:
+            timings[prefix] = time.monotonic() - t0
         if selected is not None:
             # a concrete id (PSL101) keeps only its own findings out of
             # the family run; a bare prefix keeps the whole family
@@ -250,7 +284,7 @@ def run_lint(paths: Iterable[str], context: Iterable[str] = (),
         findings.extend(fam)
     # suppression pass: a finding whose line carries its rule id survives
     # only as nothing; the reason requirement is enforced separately
-    by_path = {sf.path: sf for sf in index.files}
+    by_path = {sf.path: sf for sf in index.files + index.cpp_files}
     kept = []
     for f in findings:
         sf = by_path.get(f.path)

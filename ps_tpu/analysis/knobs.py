@@ -20,6 +20,14 @@ nothing happens).
   absent from the README.
 - **PSL405** — a ``PS_*`` var documented (README or config docstring)
   that no code reads: doc rot pointing operators at a dead knob.
+- **PSL406** — a raw ``os.environ``/``os.getenv`` read of a ``PS_*``
+  name OUTSIDE the Config module. Config's ``from_env`` clamps and
+  validates; a service-level raw read bypasses all of it — the exact
+  hole PR 9's review pass found (``PS_VAN_LOOP_THREADS`` read at the
+  service reached ``nl_start`` unclamped and failed as an opaque
+  nullptr). Service-level mirrors go through the validated readers
+  ``config.env_flag``/``env_int``/``env_float``/``env_str`` (or Config
+  itself); a deliberate raw read carries a suppression saying why.
 """
 
 from __future__ import annotations
@@ -45,8 +53,16 @@ _DOC_ENV_RE = re.compile(r"(?<![A-Z0-9_])PS_[A-Z][A-Z0-9_]*")
 _ATTR_ROW_RE = re.compile(
     r"^ {1,4}([a-z_][a-z0-9_]*(?:\s*/\s*[a-z_][a-z0-9_]*)*):")
 
-_ENV_CALL_FNS = {"get", "getenv", "env_flag"}
+#: calls whose first string arg names an env var the code READS (the
+#: validated config readers included — their reads keep knobs alive for
+#: PSL404/405 exactly like raw ones)
+_ENV_CALL_FNS = {"get", "getenv", "env_flag", "env_int", "env_float",
+                 "env_str"}
 _ENV_RECEIVERS = {"env", "environ"}
+
+#: the sanctioned service-level readers (defined in the Config module);
+#: anything else touching os.environ for a PS_* name is PSL406
+_VALIDATED_READERS = {"env_flag", "env_int", "env_float", "env_str"}
 
 
 def _find_config(index: RepoIndex) -> Optional[Tuple[SourceFile,
@@ -147,6 +163,32 @@ def _env_reads(files) -> Dict[str, Tuple[str, int]]:
     return reads
 
 
+def _raw_env_reads(files) -> List[Tuple[str, str, int]]:
+    """Every RAW value read of a constant-named PS_* env var: a direct
+    ``os.environ.get``/``os.environ[...]``/``os.getenv`` — precisely,
+    so dict ``.get`` calls and environ WRITES never match. Reads routed
+    through the validated config readers are not raw."""
+    out: List[Tuple[str, str, int]] = []
+    for sf in files:
+        for node in ast.walk(sf.tree):
+            name = None
+            if isinstance(node, ast.Call) and node.args:
+                t = terminal_name(node.func)
+                if t == "get" and isinstance(node.func, ast.Attribute) \
+                        and terminal_name(node.func.value) == "environ":
+                    name = str_const(node.args[0])
+                elif t == "getenv" and isinstance(
+                        node.func, (ast.Attribute, ast.Name)):
+                    name = str_const(node.args[0])
+            elif isinstance(node, ast.Subscript) \
+                    and isinstance(node.ctx, ast.Load) \
+                    and terminal_name(node.value) == "environ":
+                name = str_const(node.slice)
+            if name and _ENV_RE.match(name):
+                out.append((name, sf.path, node.lineno))
+    return out
+
+
 @rule("PSL4", "knob/doc drift: Config <-> PS_* env <-> README <-> docstrings")
 def check_knobs(index: RepoIndex):
     findings: List[Finding] = []
@@ -203,4 +245,14 @@ def check_knobs(index: RepoIndex):
                 "PSL405", "P2", config_path or index.readme_path or "?", 1,
                 f"{env} is documented in the {where} but no code reads "
                 f"it — doc rot (or the consumer was dropped)"))
+    for env, path, line in sorted(_raw_env_reads(index.files)):
+        if config_path is not None and path == config_path:
+            continue  # Config IS the validated reader
+        findings.append(Finding(
+            "PSL406", "P2", path, line,
+            f"raw os.environ read of {env} outside the Config module "
+            f"bypasses Config's clamping/validation (the "
+            f"PS_VAN_LOOP_THREADS lesson) — use config.env_flag/"
+            f"env_int/env_float/env_str, or suppress with the reason "
+            f"this read must stay raw"))
     return findings

@@ -360,12 +360,13 @@ def check_locks(index: RepoIndex):
     return findings
 
 
-def _lock_order_cycles(pairs) -> List[Finding]:
-    """PSL102: ANY cycle in the lock-order graph is a deadlock finding —
-    the pairwise A->B / B->A inversion, but also longer chains
-    (A->B, B->C, C->A) where no single pair is ever reversed. The graph
-    is tiny (a dozen lock identities), so a bounded DFS per start node is
-    plenty; each cycle is reported once (deduped on its node set)."""
+def _lock_order_cycles(pairs, rule_id: str = "PSL102") -> List[Finding]:
+    """PSL102 (and, via ``rule_id``, its C++ twin PSL501): ANY cycle in
+    the lock-order graph is a deadlock finding — the pairwise A->B /
+    B->A inversion, but also longer chains (A->B, B->C, C->A) where no
+    single pair is ever reversed. The graph is tiny (a dozen lock
+    identities), so a bounded DFS per start node is plenty; each cycle
+    is reported once (deduped on its node set)."""
     adj: Dict[str, Dict[str, Tuple[str, int]]] = {}
     for (a, b), site in pairs.items():
         if a != b:
@@ -390,14 +391,14 @@ def _lock_order_cycles(pairs) -> List[Finding]:
                         a, b = path_nodes
                         rpath, rline = adj[b][a]
                         findings.append(Finding(
-                            "PSL102", "P1", path, line,
+                            rule_id, "P1", path, line,
                             f"inconsistent lock order: {a} -> {b} here "
                             f"but {b} -> {a} at {rpath}:{rline} — "
                             f"opposite nesting can deadlock"))
                     else:
                         chain = " -> ".join(path_nodes + (start,))
                         findings.append(Finding(
-                            "PSL102", "P1", path, line,
+                            rule_id, "P1", path, line,
                             f"lock-order cycle: {chain} — these paths "
                             f"can deadlock even though no single pair "
                             f"is ever reversed"))

@@ -416,7 +416,7 @@ class BucketedTransportMixin:
         import os
         import uuid
 
-        from ps_tpu.config import env_flag
+        from ps_tpu.config import env_flag, env_int
         from ps_tpu.control.shm_lane import DEFAULT_SHM_BYTES
 
         # <= 0 selects the serial transport, matching the PS_BUCKET_BYTES=0
@@ -431,8 +431,11 @@ class BucketedTransportMixin:
         self.writev = (env_flag("PS_WRITEV", True)
                        if writev is None else bool(writev))
         self.shm = env_flag("PS_SHM", False) if shm is None else bool(shm)
-        self.shm_bytes = (int(os.environ.get("PS_SHM_BYTES",
-                                             DEFAULT_SHM_BYTES))
+        # validated service-level read (pslint PSL406): Config's >=64KiB
+        # ring floor applies here too — an env value below it would
+        # break the ring's wrap-sentinel framing math, not just be slow
+        self.shm_bytes = (env_int("PS_SHM_BYTES", DEFAULT_SHM_BYTES,
+                                  lo=1 << 16)
                           if shm_bytes is None else int(shm_bytes))
         # incarnation nonce, sent with every push bucket: a restarted (or
         # reconnected) worker reuses epoch NUMBERS from zero, so the server
@@ -632,8 +635,12 @@ class BucketedTransportMixin:
                     f"replica set {s}"
                 )
         if failover_timeout is None:
-            failover_timeout = float(
-                os.environ.get("PS_FAILOVER_TIMEOUT_MS", 10_000)) / 1e3
+            from ps_tpu.config import env_float
+
+            # validated service-level read (pslint PSL406); a negative
+            # horizon would make every failover fail instantly
+            failover_timeout = env_float("PS_FAILOVER_TIMEOUT_MS",
+                                         10_000.0, lo=0.0) / 1e3
         self.failover_timeout = float(failover_timeout)
         self._epochs = [0] * n  # shard-table epochs, learned from HELLO
 

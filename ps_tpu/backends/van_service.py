@@ -304,12 +304,15 @@ class VanService:
         want_loop = (env_flag("PS_VAN_NATIVE_LOOP", False)
                      if native_loop is None else bool(native_loop))
         if loop_threads is None:
-            loop_threads = int(os.environ.get("PS_VAN_LOOP_THREADS", "1")
-                               or 1)
+            # validated service-level read (pslint PSL406): env_int
+            # clamps to Config.van_loop_threads' [1, 64] with a warning,
+            # so a value that bypassed Config cannot abort server
+            # startup with an opaque nl_start failure
+            from ps_tpu.config import env_int
+
+            loop_threads = env_int("PS_VAN_LOOP_THREADS", 1, lo=1, hi=64)
         if not (1 <= loop_threads <= 64):
-            # same bound Config.van_loop_threads enforces — an env value
-            # that bypassed Config must not abort server startup with an
-            # opaque nl_start failure
+            # explicit arguments clamp to the same bound, same warning
             logging.getLogger(__name__).warning(
                 "van loop_threads %d outside [1, 64]; clamping", loop_threads)
             loop_threads = min(max(loop_threads, 1), 64)

@@ -128,6 +128,71 @@ def env_flag(name: str, default: bool) -> bool:
     return default
 
 
+def _env_number(name, default, lo, hi, cast, strict):
+    v = os.environ.get(name)
+    if v is None or not v.strip():
+        return default
+    try:
+        out = cast(v.strip())
+    except ValueError:
+        if strict:
+            raise ValueError(
+                f"{name}={v!r} is not a valid {cast.__name__}") from None
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "%s=%r is not a valid %s; keeping default %r",
+            name, v, cast.__name__, default)
+        return default
+    clamped = out
+    if lo is not None:
+        clamped = max(clamped, cast(lo))
+    if hi is not None:
+        clamped = min(clamped, cast(hi))
+    if clamped != out:
+        # the PR-9 lesson generalized: an env value that bypassed
+        # Config's validation must not abort (or corrupt) a service —
+        # clamp to the documented bound, loudly
+        import logging
+
+        logging.getLogger(__name__).warning(
+            "%s=%r outside [%s, %s]; clamping to %r",
+            name, out, lo, hi, clamped)
+    return clamped
+
+
+def env_int(name: str, default: Optional[int], lo: Optional[int] = None,
+            hi: Optional[int] = None, strict: bool = True) -> Optional[int]:
+    """The validated reader for integer ``PS_*`` knobs consumed at the
+    *service* level (not through :meth:`Config.from_env`): unset/blank
+    keeps ``default``, an unparseable value raises naming the variable
+    (or warns and keeps the default with ``strict=False`` — for
+    observability paths that must never take a service down), and a
+    value outside ``[lo, hi]`` is clamped with a warning instead of
+    surfacing later as an opaque native failure. Every service-level
+    mirror resolves through here/:func:`env_float`/:func:`env_str`/
+    :func:`env_flag` — pslint PSL406 flags raw ``os.environ`` reads."""
+    return _env_number(name, default, lo, hi, int, strict)
+
+
+def env_float(name: str, default: Optional[float],
+              lo: Optional[float] = None, hi: Optional[float] = None,
+              strict: bool = True) -> Optional[float]:
+    """Float twin of :func:`env_int` (see there for the contract)."""
+    return _env_number(name, default, lo, hi, float, strict)
+
+
+def env_str(name: str, default: Optional[str] = None) -> Optional[str]:
+    """String twin of :func:`env_int`: unset or blank keeps ``default``
+    (a blank path/rule-string is never a meaningful knob value here).
+    Exists so every service-level env read goes through ONE greppable,
+    PSL4xx-visible surface even when no further validation applies."""
+    v = os.environ.get(name)
+    if v is None or not v.strip():
+        return default
+    return v
+
+
 @dataclasses.dataclass
 class Config:
     """Runtime configuration for :func:`ps_tpu.init`.

@@ -32,6 +32,11 @@ class WorkerFailureError(RuntimeError):
 
 
 def _lib():
+    # one of THE three ctypes declaration sites (the tv_*/nl_* _lib
+    # twins are the others): every argtypes/restype row here is
+    # machine-diffed against van.cpp's extern "C" signatures by pslint
+    # PSL6xx, so a C-side signature change cannot silently
+    # truncate/corrupt at this boundary
     lib = load("van")
     lib.hb_server_start.restype = ctypes.c_void_p
     lib.hb_server_start.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]

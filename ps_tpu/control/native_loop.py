@@ -49,6 +49,9 @@ def _lib():
     lib = load("van")
     if _configured is lib:
         return lib
+    # one of THE three ctypes declaration sites (with heartbeat._lib and
+    # tensor_van._lib): every argtypes/restype row here is machine-diffed
+    # against van.cpp's extern "C" signatures by pslint PSL6xx
     lib.nl_start.restype = ctypes.c_void_p
     lib.nl_start.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.nl_poll.restype = ctypes.c_int

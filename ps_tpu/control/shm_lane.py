@@ -559,7 +559,9 @@ def try_upgrade(ch, worker: int, shm_bytes: int = DEFAULT_SHM_BYTES,
             seg.unlink()
         return ch
     c2s, s2c = segs
-    bid = os.environ.get("PS_SHM_BOOT_ID") or boot_id()
+    from ps_tpu.config import env_str
+
+    bid = env_str("PS_SHM_BOOT_ID") or boot_id()
     try:
         reply = ch.request(tv.encode(tv.SHM_SETUP, worker, None, extra={
             "boot_id": bid, "c2s": c2s.name, "s2c": s2c.name,

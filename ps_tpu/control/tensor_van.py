@@ -135,6 +135,10 @@ _HDR = struct.Struct("<BIQ")  # kind, worker_id, meta_len
 
 
 def _lib():
+    # one of THE three ctypes declaration sites (with heartbeat._lib and
+    # native_loop._lib): every argtypes/restype row here is machine-diffed
+    # against van.cpp's extern "C" signatures by pslint PSL6xx (arity,
+    # pointer width, missing-restype-defaults-to-c_int truncation)
     lib = load("van")
     lib.tv_listen.restype = ctypes.c_void_p
     lib.tv_listen.argtypes = [ctypes.c_char_p, ctypes.c_int, ctypes.c_int]

@@ -158,7 +158,11 @@ class Coordinator(VanService):
             straggler_z = _env("PS_TELEMETRY_STRAGGLER_Z",
                                "telemetry_straggler_z", float)
         if slo_rules is None:
-            slo_rules = os.environ.get("PS_SLO_RULES") or None
+            from ps_tpu.config import env_str
+
+            # validated service-level read (pslint PSL406); the rule
+            # grammar itself is parsed loudly by obs.slo right below
+            slo_rules = env_str("PS_SLO_RULES")
         self.tsdb = FleetTSDB(window_s=float(telemetry_window_s),
                               ring=int(telemetry_ring))
         self._decoders: Dict[str, object] = {}

@@ -70,7 +70,7 @@ struct Server {
   int timeout_ms = 1000;
   std::atomic<bool> stop{false};
   std::thread rx;
-  std::mutex mu;
+  std::mutex mu;  // beat table — pslint: hot-lock
   std::map<uint32_t, Clock::time_point> last_seen;
   std::map<uint32_t, uint64_t> last_seq;
   std::map<uint32_t, uint64_t> beat_addr;  // ip:port the node beats from
@@ -465,6 +465,7 @@ int tv_poll_readable(void* h, int timeout_ms) {
 // approximate on TSO hardware.
 
 // memcpy with the GIL released (ctypes drops it for the call's duration).
+// pslint: hot-path
 void tv_memcpy(void* dst, const void* src, uint64_t n) {
   memcpy(dst, src, n);
 }
@@ -476,6 +477,7 @@ void tv_memcpy(void* dst, const void* src, uint64_t n) {
 // mode 0: read-touch only. Without this, every first pass around a ring
 // pays a page fault per 4 KiB — an order of magnitude over the copy
 // itself on sandboxed kernels.
+// pslint: hot-path
 void tv_prefault(void* addr, uint64_t n, int mode) {
   if (mode == 1) {
     memset(addr, 0, n);
@@ -491,11 +493,13 @@ void tv_prefault(void* addr, uint64_t n, int mode) {
   (void)sum;
 }
 
+// pslint: hot-path
 uint64_t tv_load_u64(const void* addr) {
   return reinterpret_cast<const std::atomic<uint64_t>*>(addr)->load(
       std::memory_order_acquire);
 }
 
+// pslint: hot-path
 void tv_store_u64(void* addr, uint64_t v) {
   reinterpret_cast<std::atomic<uint64_t>*>(addr)->store(
       v, std::memory_order_release);
@@ -516,6 +520,7 @@ void tv_store_u64(void* addr, uint64_t v) {
 // `skip_spin`: nonzero jumps straight to the sleep phase — the caller
 // passes it after a previous slice already timed out, so long-idle
 // connections pay sleeps only, never re-burning the spin phases.
+// pslint: hot-path
 int tv_wait_u64(const void* addr, uint64_t last, int timeout_us,
                 int skip_spin) {
   auto* p = reinterpret_cast<const std::atomic<uint64_t>*>(addr);
@@ -668,12 +673,13 @@ struct NlLoop {
   std::atomic<bool> accepting{true};
   int nthreads = 1;
   std::deque<NlThread> threads;  // deque: NlThread is not movable
-  std::mutex tmu;                // conn table
+  // pslint: lock-order: tmu -> wmu
+  std::mutex tmu;                // conn table — pslint: hot-lock
   std::condition_variable pin_cv;  // destroy/detach wait out repliers
   std::map<uint64_t, NlConn*> conns;
   uint64_t next_id = 1;
   uint64_t rr = 0;
-  std::mutex qmu;  // ready queue
+  std::mutex qmu;  // ready queue — pslint: hot-lock
   std::condition_variable qcv;
   std::deque<NlReq> ready;
   std::atomic<uint64_t> iters{0}, accepted{0}, requests{0};
@@ -687,6 +693,9 @@ void nl_wake(NlThread& t) {
 }
 
 // Owner thread (or nl_stop after join): unlink + free one connection.
+// pslint: owns: body -- c->body here is a MID-READ frame that was never
+// queued (queued frames move their pointer into the ready queue and
+// null c->body), so no ownership ever transferred to Python
 void nl_destroy(NlLoop* l, NlThread& t, NlConn* c) {
   {
     std::unique_lock<std::mutex> lock(l->tmu);
@@ -744,6 +753,8 @@ void nl_read(NlLoop* l, NlThread& t, NlConn* c) {
       out = ++c->outstanding;
     }
     if (out > kNlMaxOutstanding) {
+      // pslint: owns: body -- abuse path, BEFORE the queue push: this
+      // frame is still thread-private, nothing transferred yet
       free(c->body);
       c->body = nullptr;
       nl_destroy(l, t, c);
@@ -751,6 +762,9 @@ void nl_read(NlLoop* l, NlThread& t, NlConn* c) {
     }
     {
       std::lock_guard<std::mutex> lock(l->qmu);
+      // pslint: transfers: body -- from this push the body is Python's,
+      // nl_poll hands it out and ONLY nl_body_free may release it; the
+      // UAF gate: any new native free of a body needs an owns: claim
       l->ready.push_back({c->id, c->body, c->body_len});
     }
     l->requests.fetch_add(1, std::memory_order_relaxed);
@@ -1066,6 +1080,8 @@ int nl_reply_vec(void* h, uint64_t conn_id, const void** bufs,
 
 // Release one request body handed out by nl_poll (after the reply — the
 // reply buffers may alias the request's tensors).
+// pslint: owns: body -- THE release endpoint of the transfer contract:
+// Python (the owner since nl_poll) is the only caller
 void nl_body_free(void* h, void* body) {
   auto* l = static_cast<NlLoop*>(h);
   free(body);
@@ -1122,6 +1138,8 @@ int nl_detach(void* h, uint64_t conn_id) {
         fd = c2->fd;
         int fl = fcntl(fd, F_GETFL, 0);
         fcntl(fd, F_SETFL, fl & ~O_NONBLOCK);
+        // pslint: owns: body -- mid-read frame only (same as
+        // nl_destroy): a queued frame's pointer already left c2->body
         free(c2->body);
         c2->body = nullptr;
         c2->dead = true;
@@ -1225,6 +1243,9 @@ void nl_begin_stop(void* h) {
 // inside the handle (the Python driver joins its pump first). Bodies still
 // claimed by Python are NOT freed here (Python may hold live views into
 // them); unclaimed ready-queue bodies are.
+// pslint: owns: body -- only mid-read conn bodies and UNCLAIMED ready
+// entries are freed; claimed bodies stay Python-owned until
+// nl_body_free (the exact UAF window PR 9 closed)
 void nl_stop(void* h) {
   auto* l = static_cast<NlLoop*>(h);
   nl_begin_stop(h);

@@ -93,10 +93,13 @@ def tracer() -> Tracer:
     if _tracer is None:
         with _lock:
             if _tracer is None:
-                try:
-                    sample = float(os.environ.get("PS_TRACE_SAMPLE", "0") or 0)
-                except ValueError:
-                    sample = 0.0
+                from ps_tpu.config import env_float
+
+                # strict=False: a garbage PS_TRACE_SAMPLE must never
+                # take a service down with its observability (pslint
+                # PSL406 — validated, warn-and-default on parse error)
+                sample = env_float("PS_TRACE_SAMPLE", 0.0, lo=0.0,
+                                   hi=1.0, strict=False)
                 _tracer = Tracer(service=f"pid{os.getpid()}", sample=sample)
     return _tracer
 
@@ -108,11 +111,11 @@ def flight() -> FlightRecorder:
     if _flight is None:
         with _lock:
             if _flight is None:
-                try:
-                    cap = int(os.environ.get("PS_FLIGHT_EVENTS", "4096")
-                              or 4096)
-                except ValueError:
-                    cap = 4096
+                from ps_tpu.config import env_int
+
+                # strict=False, same contract as the tracer's knob
+                cap = env_int("PS_FLIGHT_EVENTS", 4096, lo=1,
+                              strict=False)
                 fr = FlightRecorder(capacity=cap,
                                     service=f"pid{os.getpid()}")
                 fr.install()

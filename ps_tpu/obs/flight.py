@@ -94,8 +94,10 @@ class FlightRecorder:
             return None
         try:
             if path is None:
-                base = (self.dir or os.environ.get("PS_FLIGHT_DIR")
-                        or os.environ.get("PS_TRACE_DIR") or ".")
+                from ps_tpu.config import env_str
+
+                base = (self.dir or env_str("PS_FLIGHT_DIR")
+                        or env_str("PS_TRACE_DIR") or ".")
                 os.makedirs(base, exist_ok=True)
                 path = os.path.join(
                     base,

@@ -77,14 +77,15 @@ def start_metrics_server(port: Optional[int] = None,
     Idempotent: the first successful start wins — later calls return the
     live server regardless of the port they asked for (one process, one
     scrape target)."""
-    import os
-
     global _server
     if port is None:
-        v = os.environ.get("PS_METRICS_PORT")
-        if v is None or v.strip() == "":
+        from ps_tpu.config import env_int
+
+        # validated service-level read (pslint PSL406): unset/blank
+        # keeps the endpoint disabled, exactly as before
+        port = env_int("PS_METRICS_PORT", None, lo=0, hi=65535)
+        if port is None:
             return _server
-        port = int(v)
     err: Optional[OSError] = None
     with _lock:
         if _server is None:

@@ -250,7 +250,9 @@ class Tracer:
         """Write the ring as Perfetto-openable JSON; returns the path
         (default: ``<trace_dir>/trace-<service>-<pid>.json``)."""
         if path is None:
-            base = os.environ.get("PS_TRACE_DIR") or "."
+            from ps_tpu.config import env_str
+
+            base = env_str("PS_TRACE_DIR", ".")
             path = os.path.join(base, f"trace-{self.service}-{self.pid}.json")
         os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
         with open(path, "w") as f:

@@ -726,7 +726,7 @@ def test_list_rules():
          "--list-rules"],
         capture_output=True, text=True, timeout=120)
     assert proc.returncode == 0
-    for family in ("PSL1", "PSL2", "PSL3", "PSL4"):
+    for family in ("PSL1", "PSL2", "PSL3", "PSL4", "PSL5", "PSL6"):
         assert family in proc.stdout
 
 
@@ -768,3 +768,781 @@ def test_concrete_rule_id_selects_its_family(tmp_path):
         """)
     f = _lint(tmp_path, rules=["PSL101"])
     assert _rules_of(f) == ["PSL101"]  # the PSL103 logging hit filtered
+
+
+# -- PSL4xx: PSL406 service-level env bypass -----------------------------------
+
+
+def test_psl406_raw_env_read_outside_config(tmp_path):
+    _write(tmp_path, "config.py", """
+        import os
+
+        class Config:
+            pass
+
+            @classmethod
+            def from_env(cls):
+                return os.environ.get("PS_FOO")
+        """)
+    _write(tmp_path, "svc.py", """
+        import os
+
+        def start():
+            return os.environ.get("PS_FOO")
+        """)
+    f = [x for x in _lint(tmp_path, rules=["PSL4"]) if x.rule == "PSL406"]
+    assert len(f) == 1 and f[0].path.endswith("svc.py")
+
+
+def test_psl406_validated_reader_and_config_are_clean(tmp_path):
+    _write(tmp_path, "config.py", """
+        import os
+
+        def env_int(name, default, lo=None, hi=None):
+            return int(os.environ.get(name) or default)
+
+        class Config:
+            pass
+        """)
+    _write(tmp_path, "svc.py", """
+        from config import env_int
+
+        def start():
+            return env_int("PS_FOO", 1, lo=1, hi=64)
+        """)
+    assert not [x for x in _lint(tmp_path, rules=["PSL4"])
+                if x.rule == "PSL406"]
+
+
+def test_psl406_environ_write_is_not_a_read(tmp_path):
+    _write(tmp_path, "config.py", """
+        class Config:
+            pass
+        """)
+    _write(tmp_path, "svc.py", """
+        import os
+
+        def configure(d):
+            os.environ["PS_TRACE_DIR"] = d
+        """)
+    assert not [x for x in _lint(tmp_path, rules=["PSL4"])
+                if x.rule == "PSL406"]
+
+
+# -- PSL5xx native C++ ---------------------------------------------------------
+
+
+def _write_cpp(tmp_path, name, src):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(src))
+    return str(p)
+
+
+_CPP_HEADER = """
+    #include <mutex>
+    struct T {
+      std::mutex tmu;
+      std::mutex wmu;
+      std::mutex amu;
+      std::mutex bmu;
+      std::mutex cmu;
+      std::condition_variable cv;
+      char* body;
+      int fd;
+    };
+    """
+
+
+def test_psl501_inverted_cpp_lock_order(tmp_path):
+    _write_cpp(tmp_path, "m.cpp", _CPP_HEADER + """
+        void f(T* t) {
+          std::lock_guard<std::mutex> a(t->tmu);
+          std::lock_guard<std::mutex> b(t->wmu);
+        }
+        void g(T* t) {
+          std::lock_guard<std::mutex> a(t->wmu);
+          std::lock_guard<std::mutex> b(t->tmu);
+        }
+        """)
+    f = [x for x in _lint(tmp_path, rules=["PSL5"]) if x.rule == "PSL501"]
+    assert len(f) == 1 and "tmu" in f[0].message and "wmu" in f[0].message
+
+
+def test_psl501_declared_hierarchy_inversion(tmp_path):
+    """Only ONE order is ever observed — the inversion exists solely
+    against the declared `lock-order:` hierarchy."""
+    _write_cpp(tmp_path, "m.cpp", """
+        #include <mutex>
+        // pslint: lock-order: tmu -> wmu
+        struct T {
+          std::mutex tmu;
+          std::mutex wmu;
+        };
+        void g(T* t) {
+          std::lock_guard<std::mutex> a(t->wmu);
+          std::lock_guard<std::mutex> b(t->tmu);
+        }
+        """)
+    f = [x for x in _lint(tmp_path, rules=["PSL5"]) if x.rule == "PSL501"]
+    assert len(f) == 1
+
+
+def test_psl501_three_lock_cpp_cycle_no_reversed_pair(tmp_path):
+    _write_cpp(tmp_path, "m.cpp", _CPP_HEADER + """
+        void f(T* t) {
+          std::lock_guard<std::mutex> a(t->amu);
+          std::lock_guard<std::mutex> b(t->bmu);
+        }
+        void g(T* t) {
+          std::lock_guard<std::mutex> a(t->bmu);
+          std::lock_guard<std::mutex> b(t->cmu);
+        }
+        void h(T* t) {
+          std::lock_guard<std::mutex> a(t->cmu);
+          std::lock_guard<std::mutex> b(t->amu);
+        }
+        """)
+    f = [x for x in _lint(tmp_path, rules=["PSL5"]) if x.rule == "PSL501"]
+    assert len(f) == 1 and "cycle" in f[0].message
+
+
+def test_psl501_consistent_order_and_unlock_are_clean(tmp_path):
+    """The nl_reply_vec shape: guard.unlock() before re-taking the outer
+    lock must NOT read as an inversion."""
+    _write_cpp(tmp_path, "m.cpp", _CPP_HEADER + """
+        void consistent(T* t) {
+          std::lock_guard<std::mutex> a(t->tmu);
+          std::lock_guard<std::mutex> b(t->wmu);
+        }
+        void pin_then_write(T* t) {
+          {
+            std::lock_guard<std::mutex> a(t->tmu);
+          }
+          std::unique_lock<std::mutex> w(t->wmu);
+          w.unlock();
+          std::lock_guard<std::mutex> a2(t->tmu);
+        }
+        """)
+    assert not [x for x in _lint(tmp_path, rules=["PSL5"])
+                if x.rule == "PSL501"]
+
+
+def test_psl502_blocking_call_under_hot_lock(tmp_path):
+    _write_cpp(tmp_path, "m.cpp", """
+        #include <mutex>
+        struct T {
+          std::mutex tmu;  // pslint: hot-lock
+          int fd;
+        };
+        void bad(T* t, const void* buf) {
+          std::lock_guard<std::mutex> a(t->tmu);
+          send(t->fd, buf, 1024, 0);
+        }
+        void fine(T* t, const void* buf) {
+          {
+            std::lock_guard<std::mutex> a(t->tmu);
+          }
+          send(t->fd, buf, 1024, 0);
+        }
+        """)
+    f = [x for x in _lint(tmp_path, rules=["PSL5"]) if x.rule == "PSL502"]
+    assert len(f) == 1 and "send()" in f[0].message
+
+
+def test_psl502_memcpy_bound(tmp_path):
+    """An 8-byte length-prefix copy under the hot lock is legal; an
+    unbounded (variable-size) memcpy is the nl_reply_vec bug class."""
+    _write_cpp(tmp_path, "m.cpp", """
+        #include <mutex>
+        struct T {
+          std::mutex tmu;  // pslint: hot-lock
+          char* dst;
+        };
+        void bad(T* t, const char* src, unsigned long n) {
+          std::lock_guard<std::mutex> a(t->tmu);
+          memcpy(t->dst, src, n);
+        }
+        void fine(T* t, const char* src) {
+          std::lock_guard<std::mutex> a(t->tmu);
+          memcpy(t->dst, src, 8);
+        }
+        """)
+    f = [x for x in _lint(tmp_path, rules=["PSL5"]) if x.rule == "PSL502"]
+    assert len(f) == 1 and "memcpy" in f[0].message
+
+
+def test_psl502_hot_lock_annotation_on_line_above(tmp_path):
+    """The standalone-comment style must arm the mutex too — silently
+    attaching to nothing would disarm the whole rule."""
+    _write_cpp(tmp_path, "m.cpp", """
+        #include <mutex>
+        struct T {
+          // pslint: hot-lock
+          std::mutex tmu;
+          int fd;
+        };
+        void bad(T* t, const void* buf) {
+          std::lock_guard<std::mutex> a(t->tmu);
+          send(t->fd, buf, 1024, 0);
+        }
+        """)
+    f = [x for x in _lint(tmp_path, rules=["PSL5"]) if x.rule == "PSL502"]
+    assert len(f) == 1
+
+
+def test_psl500_dangling_hot_lock_annotation(tmp_path):
+    """A hot-lock directive attached to NO mutex declaration guards
+    nothing — that must be a loud finding, not a silent no-op."""
+    _write_cpp(tmp_path, "m.cpp", """
+        #include <mutex>
+        // pslint: hot-lock
+        struct T {
+          std::mutex tmu;
+        };
+        """)
+    f = [x for x in _lint(tmp_path, rules=["PSL5"]) if x.rule == "PSL500"]
+    assert len(f) == 1 and "hot-lock" in f[0].message
+
+
+def test_psl502_defer_lock_is_not_held(tmp_path):
+    """unique_lock(mu, defer_lock) holds nothing until .lock(): the
+    scanner must not invent a blocking-under-lock finding, and must
+    still see the hold AFTER .lock()."""
+    _write_cpp(tmp_path, "m.cpp", """
+        #include <mutex>
+        struct T {
+          std::mutex tmu;  // pslint: hot-lock
+          int fd;
+        };
+        void fine_then_bad(T* t, const void* buf) {
+          std::unique_lock<std::mutex> g(t->tmu, std::defer_lock);
+          send(t->fd, buf, 1024, 0);
+          g.lock();
+          send(t->fd, buf, 1024, 0);
+        }
+        """)
+    f = [x for x in _lint(tmp_path, rules=["PSL5"]) if x.rule == "PSL502"]
+    assert len(f) == 1
+    assert f[0].line == 11  # only the send AFTER g.lock()
+
+
+def test_psl502_cond_wait_on_held_guard_is_exempt(tmp_path):
+    _write_cpp(tmp_path, "m.cpp", """
+        #include <mutex>
+        #include <condition_variable>
+        struct T {
+          std::mutex tmu;  // pslint: hot-lock
+          std::condition_variable cv;
+          bool done;
+        };
+        void waits(T* t) {
+          std::unique_lock<std::mutex> lock(t->tmu);
+          while (!t->done) t->cv.wait(lock);
+        }
+        """)
+    assert not [x for x in _lint(tmp_path, rules=["PSL5"])
+                if x.rule == "PSL502"]
+
+
+def test_psl502_transitive_block_via_helper(tmp_path):
+    _write_cpp(tmp_path, "m.cpp", """
+        #include <mutex>
+        struct T {
+          std::mutex tmu;  // pslint: hot-lock
+          int fd;
+        };
+        void wake(T* t) {
+          unsigned long one = 1;
+          write(t->fd, &one, sizeof(one));
+        }
+        void bad(T* t) {
+          std::lock_guard<std::mutex> a(t->tmu);
+          wake(t);
+        }
+        """)
+    f = [x for x in _lint(tmp_path, rules=["PSL5"]) if x.rule == "PSL502"]
+    assert len(f) == 1 and "wake()" in f[0].message \
+        and "write()" in f[0].message
+
+
+def test_psl503_wait_for_is_banned(tmp_path):
+    _write_cpp(tmp_path, "m.cpp", """
+        #include <mutex>
+        #include <condition_variable>
+        #include <chrono>
+        struct T {
+          std::mutex qmu;
+          std::condition_variable qcv;
+        };
+        void bad(T* t) {
+          std::unique_lock<std::mutex> lock(t->qmu);
+          t->qcv.wait_for(lock, std::chrono::milliseconds(100));
+        }
+        """)
+    f = [x for x in _lint(tmp_path, rules=["PSL5"]) if x.rule == "PSL503"]
+    assert len(f) == 1 and "clockwait" in f[0].message
+
+
+def test_psl503_steady_clock_wait_until_is_banned(tmp_path):
+    _write_cpp(tmp_path, "m.cpp", """
+        #include <mutex>
+        #include <condition_variable>
+        #include <chrono>
+        struct T {
+          std::mutex qmu;
+          std::condition_variable qcv;
+        };
+        void bad(T* t) {
+          std::unique_lock<std::mutex> lock(t->qmu);
+          t->qcv.wait_until(lock, std::chrono::steady_clock::now()
+                                      + std::chrono::milliseconds(100));
+        }
+        """)
+    f = [x for x in _lint(tmp_path, rules=["PSL5"]) if x.rule == "PSL503"]
+    assert len(f) == 1
+
+
+def test_psl503_system_clock_wait_until_is_clean(tmp_path):
+    _write_cpp(tmp_path, "m.cpp", """
+        #include <mutex>
+        #include <condition_variable>
+        #include <chrono>
+        struct T {
+          std::mutex qmu;
+          std::condition_variable qcv;
+        };
+        void fine(T* t) {
+          std::unique_lock<std::mutex> lock(t->qmu);
+          t->qcv.wait_until(lock, std::chrono::system_clock::now()
+                                      + std::chrono::milliseconds(100));
+        }
+        """)
+    assert not [x for x in _lint(tmp_path, rules=["PSL5"])
+                if x.rule == "PSL503"]
+
+
+_CPP_TRANSFER = """
+    #include <cstdlib>
+    struct C {
+      char* body;
+    };
+    struct Q {
+      C* c;
+    };
+    void queue_it(Q* q) {
+      // pslint: transfers: body -- Python-owned from poll to body_free
+      q->c = nullptr;
+    }
+    """
+
+
+def test_psl504_free_after_transfer(tmp_path):
+    _write_cpp(tmp_path, "m.cpp", _CPP_TRANSFER + """
+        void stop(C* c) {
+          free(c->body);
+        }
+        """)
+    f = [x for x in _lint(tmp_path, rules=["PSL5"]) if x.rule == "PSL504"]
+    assert len(f) == 1 and "body" in f[0].message \
+        and "owns" in f[0].message
+
+
+def test_psl504_owns_annotation_is_clean(tmp_path):
+    _write_cpp(tmp_path, "m.cpp", _CPP_TRANSFER + """
+        // pslint: owns: body -- mid-read frame, never queued
+        void destroy(C* c) {
+          free(c->body);
+        }
+        """)
+    assert not [x for x in _lint(tmp_path, rules=["PSL5"])
+                if x.rule == "PSL504"]
+
+
+def test_psl500_owns_without_reason(tmp_path):
+    _write_cpp(tmp_path, "m.cpp", _CPP_TRANSFER + """
+        // pslint: owns: body
+        void destroy(C* c) {
+          free(c->body);
+        }
+        """)
+    rules = _rules_of(_lint(tmp_path, rules=["PSL5"]))
+    assert "PSL500" in rules
+
+
+def test_psl500_malformed_annotation(tmp_path):
+    _write_cpp(tmp_path, "m.cpp", """
+        // pslint: frobnicate: everything
+        int f() { return 0; }
+        """)
+    f = [x for x in _lint(tmp_path, rules=["PSL5"]) if x.rule == "PSL500"]
+    assert len(f) == 1
+
+
+def test_psl505_malloc_in_hot_path(tmp_path):
+    _write_cpp(tmp_path, "m.cpp", """
+        #include <cstdlib>
+        // pslint: hot-path
+        void hot(char** out) {
+          *out = (char*)malloc(64);
+        }
+        void cold(char** out) {
+          *out = (char*)malloc(64);
+        }
+        """)
+    f = [x for x in _lint(tmp_path, rules=["PSL5"]) if x.rule == "PSL505"]
+    assert len(f) == 1 and "hot()" in f[0].message
+
+
+def test_cpp_suppression_with_reason_silences(tmp_path):
+    _write_cpp(tmp_path, "m.cpp", """
+        #include <mutex>
+        #include <condition_variable>
+        struct T {
+          std::mutex qmu;
+          std::condition_variable qcv;
+        };
+        void f(T* t) {
+          std::unique_lock<std::mutex> lock(t->qmu);
+          t->qcv.wait_for(lock, d);  // pslint: disable=PSL503 -- fixture: pretend this toolchain's TSan intercepts clockwait
+        }
+        """)
+    f = _lint(tmp_path, rules=["PSL5"])
+    assert not [x for x in f if x.rule == "PSL503"]
+    assert not [x for x in f if x.rule == "PSL001"]
+
+
+def test_cpp_bare_suppression_is_psl001(tmp_path):
+    _write_cpp(tmp_path, "m.cpp", """
+        #include <mutex>
+        #include <condition_variable>
+        struct T {
+          std::mutex qmu;
+          std::condition_variable qcv;
+        };
+        void f(T* t) {
+          std::unique_lock<std::mutex> lock(t->qmu);
+          t->qcv.wait_for(lock, d);  // pslint: disable=PSL503
+        }
+        """)
+    assert "PSL001" in _rules_of(_lint(tmp_path, rules=["PSL5"]))
+
+
+# -- PSL6xx cross-language ABI drift -------------------------------------------
+
+
+_ABI_CPP = """
+    #include <cstdint>
+    extern "C" {
+    void* mk_handle(const char* name, int port) { return nullptr; }
+    int mk_use(void* h, uint64_t n, const void** bufs) { return 0; }
+    uint64_t mk_count(void* h) { return 0; }
+    void mk_free(void* h) {}
+    }
+    """
+
+_ABI_PY_OK = """
+    import ctypes
+
+    def _lib(lib):
+        lib.mk_handle.restype = ctypes.c_void_p
+        lib.mk_handle.argtypes = [ctypes.c_char_p, ctypes.c_int]
+        lib.mk_use.restype = ctypes.c_int
+        lib.mk_use.argtypes = [ctypes.c_void_p, ctypes.c_uint64,
+                               ctypes.POINTER(ctypes.c_void_p)]
+        lib.mk_count.restype = ctypes.c_uint64
+        lib.mk_count.argtypes = [ctypes.c_void_p]
+        lib.mk_free.argtypes = [ctypes.c_void_p]
+        return lib
+
+    def use(lib, h):
+        lib.mk_use(h, 1, None)
+        lib.mk_free(h)
+    """
+
+
+def test_psl6_matching_abi_is_clean(tmp_path):
+    _write_cpp(tmp_path, "van.cpp", _ABI_CPP)
+    _write(tmp_path, "bind.py", _ABI_PY_OK)
+    assert _lint(tmp_path, rules=["PSL6"]) == []
+
+
+def test_psl601_argtypes_width_mutation_names_c_signature(tmp_path):
+    """THE ABI-gate liveness drill: one mutated argtypes entry (c_int
+    where the C side takes uint64_t) must be caught, with the
+    authoritative C signature named in the finding."""
+    _write_cpp(tmp_path, "van.cpp", _ABI_CPP)
+    _write(tmp_path, "bind.py",
+           _ABI_PY_OK.replace(
+               "lib.mk_use.argtypes = [ctypes.c_void_p, ctypes.c_uint64,",
+               "lib.mk_use.argtypes = [ctypes.c_void_p, ctypes.c_int,"))
+    f = [x for x in _lint(tmp_path, rules=["PSL6"]) if x.rule == "PSL601"]
+    assert len(f) == 1
+    assert "int mk_use(void* h, uint64_t n, const void** bufs)" \
+        in f[0].message
+    assert "van.cpp" in f[0].message
+
+
+def test_psl601_argtypes_arity(tmp_path):
+    _write_cpp(tmp_path, "van.cpp", _ABI_CPP)
+    _write(tmp_path, "bind.py",
+           _ABI_PY_OK.replace(
+               "lib.mk_free.argtypes = [ctypes.c_void_p]",
+               "lib.mk_free.argtypes = [ctypes.c_void_p, ctypes.c_int]"))
+    f = [x for x in _lint(tmp_path, rules=["PSL6"]) if x.rule == "PSL601"]
+    assert len(f) == 1 and "arity 2 != 1" in f[0].message
+
+
+def test_psl602_missing_restype_on_64bit_return(tmp_path):
+    _write_cpp(tmp_path, "van.cpp", _ABI_CPP)
+    _write(tmp_path, "bind.py",
+           _ABI_PY_OK.replace(
+               "        lib.mk_count.restype = ctypes.c_uint64\n", ""))
+    f = [x for x in _lint(tmp_path, rules=["PSL6"]) if x.rule == "PSL602"]
+    assert len(f) == 1 and "uint64_t mk_count(void* h)" in f[0].message
+    assert "TRUNCAT" in f[0].message
+
+
+def test_psl602_missing_restype_on_handle_return(tmp_path):
+    _write_cpp(tmp_path, "van.cpp", _ABI_CPP)
+    _write(tmp_path, "bind.py",
+           _ABI_PY_OK.replace(
+               "        lib.mk_handle.restype = ctypes.c_void_p\n", ""))
+    f = [x for x in _lint(tmp_path, rules=["PSL6"]) if x.rule == "PSL602"]
+    assert len(f) == 1 and "mk_handle" in f[0].message
+
+
+def test_psl603_call_without_declaration(tmp_path):
+    _write_cpp(tmp_path, "van.cpp", _ABI_CPP)
+    _write(tmp_path, "bind.py", """
+        def use(lib, h):
+            return lib.mk_count(h)
+        """)
+    f = [x for x in _lint(tmp_path, rules=["PSL6"]) if x.rule == "PSL603"]
+    assert len(f) == 1 and "mk_count" in f[0].message
+
+
+def test_psl604_bound_but_not_exported(tmp_path):
+    _write_cpp(tmp_path, "van.cpp", _ABI_CPP)
+    _write(tmp_path, "bind.py",
+           _ABI_PY_OK + """
+    def bind_gone(lib):
+        import ctypes
+        lib.mk_gone.argtypes = [ctypes.c_void_p]
+    """)
+    f = [x for x in _lint(tmp_path, rules=["PSL6"]) if x.rule == "PSL604"]
+    assert len(f) == 1 and "mk_gone" in f[0].message
+
+
+def test_psl604_exported_but_never_bound(tmp_path):
+    _write_cpp(tmp_path, "van.cpp", _ABI_CPP + """
+        extern "C" {
+        void mk_orphan(void* h) {}
+        }
+        """)
+    _write(tmp_path, "bind.py", _ABI_PY_OK)
+    f = [x for x in _lint(tmp_path, rules=["PSL6"]) if x.rule == "PSL604"]
+    assert len(f) == 1 and "mk_orphan" in f[0].message \
+        and f[0].path.endswith("van.cpp")
+
+
+def test_psl6_single_declaration_extern_form(tmp_path):
+    """`extern "C" int f(...) {` (no block) is exported exactly like
+    the block form — its binding must diff, not false-positive PSL604."""
+    _write_cpp(tmp_path, "van.cpp", """
+        #include <cstdint>
+        extern "C" uint64_t mk_single(void* h) { return 0; }
+        """)
+    _write(tmp_path, "bind.py", """
+        import ctypes
+
+        def _lib(lib):
+            lib.mk_single.restype = ctypes.c_uint64
+            lib.mk_single.argtypes = [ctypes.c_void_p]
+            return lib
+        """)
+    assert _lint(tmp_path, rules=["PSL6"]) == []
+    # and the gate is live for it: drop the restype -> PSL602
+    _write(tmp_path, "bind.py", """
+        import ctypes
+
+        def _lib(lib):
+            lib.mk_single.argtypes = [ctypes.c_void_p]
+            return lib
+        """)
+    f = [x for x in _lint(tmp_path, rules=["PSL6"]) if x.rule == "PSL602"]
+    assert len(f) == 1 and "mk_single" in f[0].message
+
+
+def test_psl604_internal_namespace_helpers_are_not_exports(tmp_path):
+    """Functions in an anonymous namespace INSIDE extern "C" have
+    internal linkage — they are not ABI surface (read_exact et al)."""
+    _write_cpp(tmp_path, "van.cpp", """
+        extern "C" {
+        namespace {
+        int helper(int x) { return x; }
+        }
+        }
+        """)
+    _write(tmp_path, "bind.py", "X = 1\n")
+    assert _lint(tmp_path, rules=["PSL6"]) == []
+
+
+# -- CLI selectors / baseline ratchet ------------------------------------------
+
+
+def _mixed_violations(tmp_path):
+    _write(tmp_path, "m.py", """
+        import threading
+        import time
+
+        class S:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad(self):
+                with self._lock:
+                    time.sleep(1)
+        """)
+    _write_cpp(tmp_path, "m.cpp", """
+        #include <mutex>
+        #include <condition_variable>
+        struct T {
+          std::mutex qmu;
+          std::condition_variable qcv;
+        };
+        void f(T* t) {
+          std::unique_lock<std::mutex> lock(t->qmu);
+          t->qcv.wait_for(lock, d);
+        }
+        """)
+
+
+def _run_cli(*args, timeout=120):
+    return subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "pslint.py"),
+         *args], capture_output=True, text=True, timeout=timeout)
+
+
+def test_cli_native_only_and_py_only(tmp_path):
+    import json
+
+    _mixed_violations(tmp_path)
+    native = _run_cli(str(tmp_path), "--no-default-context",
+                      "--native-only", "--json")
+    assert native.returncode == 1
+    rules = {f["rule"] for f in json.loads(native.stdout)}
+    assert rules == {"PSL503"}
+    py = _run_cli(str(tmp_path), "--no-default-context", "--py-only",
+                  "--json")
+    assert py.returncode == 1
+    rules = {f["rule"] for f in json.loads(py.stdout)}
+    assert "PSL101" in rules and not any(r.startswith("PSL5")
+                                         for r in rules)
+    both = _run_cli(str(tmp_path), "--no-default-context", "--native-only",
+                    "--py-only")
+    assert both.returncode == 2  # conflicting selectors = usage error
+
+
+def test_cli_rules_space_separated(tmp_path):
+    _mixed_violations(tmp_path)
+    proc = _run_cli(str(tmp_path), "--no-default-context",
+                    "--rules", "PSL5", "PSL6")
+    assert proc.returncode == 1 and "PSL503" in proc.stdout
+    assert "PSL101" not in proc.stdout
+
+
+def test_cli_baseline_ratchet(tmp_path):
+    _mixed_violations(tmp_path)
+    base = str(tmp_path / "baseline.json")
+    wrote = _run_cli(str(tmp_path), "--no-default-context",
+                     "--write-baseline", base)
+    assert wrote.returncode == 0 and os.path.isfile(base)
+    # same findings vs the snapshot: clean, exit 0 (the ratchet holds)
+    same = _run_cli(str(tmp_path), "--no-default-context",
+                    "--baseline", base)
+    assert same.returncode == 0, same.stdout + same.stderr
+    assert "clean vs baseline" in same.stderr
+    # a NEW violation (different file) fails with ONLY the new finding
+    _write(tmp_path, "fresh.py", """
+        import threading
+        import logging
+
+        class F:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def bad(self):
+                with self._lock:
+                    logging.warning("held")
+        """)
+    new = _run_cli(str(tmp_path), "--no-default-context",
+                   "--baseline", base)
+    assert new.returncode == 1
+    assert "fresh.py" in new.stdout and "m.py" not in new.stdout
+    # a missing baseline file is a usage error, never a silent clean
+    gone = _run_cli(str(tmp_path), "--no-default-context",
+                    "--baseline", str(tmp_path / "nope.json"))
+    assert gone.returncode == 2
+
+
+def test_cli_baseline_counts_duplicate_occurrences(tmp_path):
+    """The snapshot is a MULTISET: a SECOND wait_for in the same file
+    carries the identical (rule, path, message) key as the baselined
+    one, and must still fail the ratchet as new."""
+    _mixed_violations(tmp_path)
+    base = str(tmp_path / "baseline.json")
+    assert _run_cli(str(tmp_path), "--no-default-context",
+                    "--write-baseline", base).returncode == 0
+    src = (tmp_path / "m.cpp").read_text()
+    (tmp_path / "m.cpp").write_text(src.replace(
+        "t->qcv.wait_for(lock, d);",
+        "t->qcv.wait_for(lock, d);\n  t->qcv.wait_for(lock, d);"))
+    new = _run_cli(str(tmp_path), "--no-default-context",
+                   "--baseline", base)
+    assert new.returncode == 1 and "PSL503" in new.stdout
+
+
+def test_cli_baseline_survives_refactor_shifting_referenced_lines(
+        tmp_path):
+    """Messages that embed OTHER sites' line numbers (PSL504's
+    'transfers: at line N') are normalized in the snapshot key — adding
+    a comment above the annotation must not thrash the ratchet."""
+    _write_cpp(tmp_path, "m.cpp", _CPP_TRANSFER + """
+        void stop(C* c) {
+          free(c->body);
+        }
+        """)
+    base = str(tmp_path / "baseline.json")
+    assert _run_cli(str(tmp_path), "--no-default-context",
+                    "--write-baseline", base).returncode == 0
+    (tmp_path / "m.cpp").write_text(
+        "// a refactor comment shifting every line below\n"
+        + (tmp_path / "m.cpp").read_text())
+    held = _run_cli(str(tmp_path), "--no-default-context",
+                    "--baseline", base)
+    assert held.returncode == 0, held.stdout + held.stderr
+
+
+def test_cli_repo_native_families_exit_zero():
+    """Acceptance: `pslint.py ps_tpu/ --rules PSL5 PSL6` exits 0 on the
+    shipped tree (annotations armed, ABI in sync)."""
+    proc = _run_cli(os.path.join(REPO, "ps_tpu"),
+                    "--rules", "PSL5", "PSL6", timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+def test_repo_native_annotations_are_armed():
+    """The shipped van.cpp must actually carry the contract the PSL5xx
+    family enforces — deleting the annotations would otherwise turn the
+    gate into a no-op that still exits 0."""
+    from ps_tpu.analysis.cpp import CppSourceFile
+
+    path = os.path.join(REPO, "ps_tpu", "native", "van.cpp")
+    with open(path, encoding="utf-8") as f:
+        sf = CppSourceFile(path, f.read())
+    keys = {a.key for a in sf.annotations}
+    assert {"lock-order", "hot-lock", "transfers", "owns",
+            "hot-path"} <= keys
+    order = [a.value for a in sf.annotations if a.key == "lock-order"]
+    assert any("tmu" in v and "wmu" in v for v in order)
+    assert sf.bad_annotations == []

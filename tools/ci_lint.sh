@@ -1,8 +1,12 @@
 #!/usr/bin/env bash
 # Static-analysis + native-sanitizer CI leg (total budget < 120 s):
-#   1. pslint  — repo-aware AST lint of ps_tpu/ (README "Static
-#      analysis": concurrency, wire protocol, resource safety, knob
-#      drift); exit nonzero on any unsuppressed finding.
+#   1. pslint  — repo-aware lint of ps_tpu/ (README "Static analysis"):
+#      the Python families (concurrency, wire protocol, resource
+#      safety, knob drift) AND the cross-language ones (PSL5xx native
+#      C++ concurrency/ownership, PSL6xx ctypes<->C ABI drift) run by
+#      default; --timings prints per-family wall time so a family that
+#      starts eating the budget is visible in the log, not a mystery.
+#      Exit nonzero on any unsuppressed finding.
 #   2. TSan    — the native van's full concurrent surface (heartbeat,
 #      TCP echo, tv_send_vec, shm-ring primitives, cross-thread sever)
 #      under ThreadSanitizer.
@@ -15,8 +19,8 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 t0=$SECONDS
-echo "== pslint =="
-timeout -k 10 60 python tools/pslint.py ps_tpu/
+echo "== pslint (PSL1xx-PSL6xx) =="
+timeout -k 10 60 python tools/pslint.py ps_tpu/ --timings
 
 echo "== tsan van =="
 timeout -k 10 60 bash tools/tsan_van.sh

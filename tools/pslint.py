@@ -5,29 +5,47 @@ Usage::
 
     python tools/pslint.py ps_tpu/              # the CI/tier-1 gate
     python tools/pslint.py ps_tpu/ --json       # machine-readable
-    python tools/pslint.py path/a.py path/b.py  # spot-check files
+    python tools/pslint.py path/a.py b.cpp      # spot-check files
+    python tools/pslint.py ps_tpu/ --rules PSL5 PSL6   # native families
+    python tools/pslint.py ps_tpu/ --native-only       # C++ + ABI only
+    python tools/pslint.py ps_tpu/ --write-baseline lint.json
+    python tools/pslint.py ps_tpu/ --baseline lint.json  # ratchet mode
     python tools/pslint.py --list-rules
 
-Exit status: 0 = clean (every finding fixed or suppressed-with-reason),
-1 = findings, 2 = usage error.
+Exit status: 0 = clean (every finding fixed or suppressed-with-reason;
+with ``--baseline``, no finding OUTSIDE the snapshot), 1 = findings
+(with ``--baseline``, NEW findings — the snapshot's are tolerated and
+ones that disappeared are reported as fixed), 2 = usage error (unknown
+--rules selection, missing baseline file, conflicting selectors).
 
 By default, when the linted paths live inside this repository, the
 repo's ``README.md`` joins as the doc side of the knob-drift rules and
 ``tools/*.py`` + ``bench.py`` join as *context* (consumers of STATS/
 trace header keys live there; context files are read for evidence but
-never reported on). ``--no-default-context`` disables that, ``--context``
-adds more roots, ``--readme`` points elsewhere.
+never reported on). C++ sources (``*.cpp`` — the native van and the
+sanitizer driver) are collected from linted AND context roots and are
+always linted: the native rule families (PSL5xx) and the ABI drift gate
+(PSL6xx) bind them all. ``--no-default-context`` disables the
+auto-context, ``--context`` adds more roots, ``--readme`` points
+elsewhere.
+
+``--baseline`` is the ratchet for future PRs: emit a snapshot once with
+``--write-baseline``, then compare against it so new code cannot add
+findings while the existing debt is burned down incrementally instead
+of big-banged.
 
 See ``ps_tpu/analysis/`` for the rule families and the README's
-"Static analysis" section for the suppression syntax and how to add a
-rule.
+"Static analysis" section for the suppression/annotation syntax and how
+to add a rule.
 """
 
 from __future__ import annotations
 
 import argparse
+import collections
 import json
 import os
+import re
 import sys
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -35,6 +53,10 @@ if _REPO not in sys.path:
     sys.path.insert(0, _REPO)
 
 from ps_tpu.analysis import all_rules, run_lint  # noqa: E402
+
+#: the language split --native-only / --py-only select between
+NATIVE_FAMILIES = ("PSL5", "PSL6")
+PY_FAMILIES = ("PSL1", "PSL2", "PSL3", "PSL4")
 
 
 def _default_context(paths, repo):
@@ -49,6 +71,25 @@ def _default_context(paths, repo):
     return out
 
 
+def _finding_key(f) -> dict:
+    # line numbers shift with every edit, and several rules embed OTHER
+    # locations' line numbers in their message ("at path:746", "line 52",
+    # the C signature's site) — a ratchet baseline keys on (rule, path,
+    # message with location digits normalized) so a refactor above a
+    # finding (or above its cross-referenced site) does not thrash the
+    # snapshot. Identical keys are counted, not deduped: a SECOND
+    # occurrence of an already-baselined finding is still NEW (see
+    # main()), so the ratchet's no-new-findings promise holds even for
+    # rules whose messages carry no per-site detail.
+    msg = re.sub(r"(?<=:)\d+", "_", f.message)
+    msg = re.sub(r"\bline \d+", "line _", msg)
+    return {"rule": f.rule, "path": f.path, "message": msg}
+
+
+def _key_tuple(f):
+    return tuple(sorted(_finding_key(f).items()))
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(prog="pslint", description=__doc__)
     ap.add_argument("paths", nargs="*", help="files/dirs to lint")
@@ -59,9 +100,25 @@ def main(argv=None) -> int:
                          "(default: the repo's README.md)")
     ap.add_argument("--no-default-context", action="store_true",
                     help="do not auto-add tools/ + bench.py + README.md")
-    ap.add_argument("--rules", default=None,
-                    help="comma-separated rule-family prefixes "
-                         "(e.g. PSL1,PSL4); default: all")
+    ap.add_argument("--rules", nargs="+", default=None, metavar="PSLn",
+                    help="rule-family prefixes or concrete ids, space- "
+                         "or comma-separated (e.g. --rules PSL5 PSL6)")
+    ap.add_argument("--native-only", action="store_true",
+                    help=f"only the native families "
+                         f"{'/'.join(NATIVE_FAMILIES)} (C++ rules + the "
+                         f"ctypes ABI drift gate)")
+    ap.add_argument("--py-only", action="store_true",
+                    help=f"only the Python families "
+                         f"{'/'.join(PY_FAMILIES)}")
+    ap.add_argument("--baseline", default=None, metavar="FILE",
+                    help="compare against a findings snapshot: only "
+                         "findings NOT in it fail the run (the ratchet)")
+    ap.add_argument("--write-baseline", default=None, metavar="FILE",
+                    help="write the current findings as a snapshot for "
+                         "--baseline and exit 0")
+    ap.add_argument("--timings", action="store_true",
+                    help="print per-family wall time to stderr (the CI "
+                         "budget probe)")
     ap.add_argument("--json", action="store_true", dest="as_json",
                     help="emit findings as a JSON array")
     ap.add_argument("--list-rules", action="store_true")
@@ -73,6 +130,11 @@ def main(argv=None) -> int:
         return 0
     if not args.paths:
         ap.error("no paths given (try: python tools/pslint.py ps_tpu/)")
+    if sum([bool(args.rules), args.native_only, args.py_only]) > 1:
+        ap.error("--rules, --native-only and --py-only are mutually "
+                 "exclusive")
+    if args.baseline and args.write_baseline:
+        ap.error("--baseline and --write-baseline are mutually exclusive")
 
     context = list(args.context)
     readme = args.readme
@@ -83,14 +145,56 @@ def main(argv=None) -> int:
             readme = cand if os.path.isfile(cand) else None
     # never lint what is also context; never let pslint lint itself into
     # its own evidence twice
-    rules = ([r.strip() for r in args.rules.split(",") if r.strip()]
-             if args.rules else None)
+    rules = None
+    if args.rules:
+        rules = [r.strip() for tok in args.rules
+                 for r in tok.split(",") if r.strip()]
+    elif args.native_only:
+        rules = list(NATIVE_FAMILIES)
+    elif args.py_only:
+        rules = list(PY_FAMILIES)
 
+    timings = {} if args.timings else None
     try:
         findings = run_lint(args.paths, context=context, readme=readme,
-                            rules=rules)
+                            rules=rules, timings=timings)
     except ValueError as e:
         ap.error(str(e))  # unknown --rules selection: exit 2, not 'clean'
+    if timings is not None:
+        for prefix, secs in sorted(timings.items()):
+            print(f"pslint: {prefix}xx {secs*1e3:7.1f} ms", file=sys.stderr)
+
+    if args.write_baseline:
+        snap = {"version": 1, "findings": [_finding_key(f)
+                                           for f in findings]}
+        with open(args.write_baseline, "w", encoding="utf-8") as f:
+            json.dump(snap, f, indent=2)
+        print(f"pslint: baseline with {len(findings)} finding(s) "
+              f"written to {args.write_baseline}", file=sys.stderr)
+        return 0
+
+    fixed = 0
+    if args.baseline:
+        try:
+            with open(args.baseline, encoding="utf-8") as f:
+                snap = json.load(f)
+        except (OSError, ValueError) as e:
+            ap.error(f"--baseline {args.baseline}: {e}")
+        # multiset comparison: each key is tolerated only as many times
+        # as the snapshot recorded it — a second wait_for in the same
+        # file is NEW even though its key matches a baselined one
+        old = collections.Counter(tuple(sorted(d.items()))
+                                  for d in snap.get("findings", []))
+        seen: collections.Counter = collections.Counter()
+        new = []
+        for f in findings:
+            k = _key_tuple(f)
+            seen[k] += 1
+            if seen[k] > old.get(k, 0):
+                new.append(f)
+        fixed = sum((old - seen).values())
+        findings = new
+
     if args.as_json:
         print(json.dumps([vars(f) for f in findings], indent=2))
     else:
@@ -99,12 +203,18 @@ def main(argv=None) -> int:
         sev = {}
         for f in findings:
             sev[f.severity] = sev.get(f.severity, 0) + 1
+        tag = "new " if args.baseline else ""
         if findings:
             counts = ", ".join(f"{k}: {v}" for k, v in sorted(sev.items()))
-            print(f"pslint: {len(findings)} finding(s) ({counts})",
+            print(f"pslint: {len(findings)} {tag}finding(s) ({counts})",
                   file=sys.stderr)
         else:
-            print("pslint: clean", file=sys.stderr)
+            print(f"pslint: clean{' vs baseline' if args.baseline else ''}",
+                  file=sys.stderr)
+        if args.baseline and fixed > 0:
+            print(f"pslint: {fixed} baseline finding(s) no longer fire — "
+                  f"regenerate with --write-baseline to ratchet down",
+                  file=sys.stderr)
     return 1 if findings else 0
 
 
