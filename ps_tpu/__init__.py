@@ -34,6 +34,7 @@ from ps_tpu.api import init, shutdown, is_initialized, current_context
 from ps_tpu.kv.store import KVStore
 from ps_tpu.kv.sparse import SparseEmbedding
 from ps_tpu.train import make_composite_step
+from ps_tpu.backends.aggregator import AggregatorService, serve_aggregator
 from ps_tpu.backends.remote_async import (
     ServerFailureError,
     connect_async,
@@ -67,6 +68,8 @@ __all__ = [
     "serve_async",
     "connect_async",
     "shard_tree",
+    "serve_aggregator",
+    "AggregatorService",
     "serve_sparse",
     "connect_sparse",
     "row_range",

@@ -1,0 +1,588 @@
+"""Hierarchical two-level aggregation: the per-host aggregator role.
+
+The reference's transport is two-tier (SURVEY §2, the ps-lite/BytePS
+family design): gradients reduce INTRA-node first, then cross the slow
+inter-node path once per node. Our remote data plane was flat
+worker→shard — every worker on a host independently pushed the same-shaped
+gradient tree over cross-host TCP. This module composes two finished
+subsystems into that missing tier: the PR 3 shm lane makes the intra-host
+worker→aggregator hop nearly free, and the PR 9 native epoll loop gives
+the aggregator a GIL-free serve path, so the pre-reduction itself is the
+only new work on the hot path.
+
+:class:`AggregatorService` is a van service the host group's workers dial
+INSTEAD of the shards (``connect_async(..., aggregator="host:port")``,
+or discovered from the coordinator's membership table). To its group it
+looks like a single shard owning the whole tree; upstream it is one
+:class:`~ps_tpu.backends.remote_async.RemoteAsyncWorker` under a
+synthetic identity (:data:`~ps_tpu.backends.common.AGG_WORKER_BASE` +
+group index):
+
+- **push pre-reduction**: member pushes stage into the current ROUND;
+  when ``group_size`` distinct members staged (or the flush timeout
+  passes — a dead member must not wedge its group), the round's trees
+  are summed in ascending-member order (deterministic merge) and
+  forwarded as ONE upstream push_pull. Cross-host bytes/step drop by the
+  realized fan-in; the path composes unchanged with compression (the
+  upstream client's codec) and the exactly-once ledger (below).
+- **pull coalescing**: the merged flush's returned snapshot answers the
+  whole group's pulls for that round locally; a pull with no flush in
+  between triggers ONE upstream wire fetch, shared by every concurrent
+  reader — one fetch per host per version.
+- **exactly-once across the handoff**: the merged push travels under the
+  aggregator's own derived (nonce, seq) token AND carries each
+  constituent member's (nonce, seq) in ``members``; the shard records
+  both (different worker ids — neither evicts the other). An aggregator
+  death therefore cannot violate the ledger in either direction: the
+  group degrades to the flat worker→shard path (the worker-side
+  ``_on_server_lost`` hook), and a member's flat replay of a push its
+  dead aggregator already forwarded is acked without re-applying.
+
+Semantics note: the shards see ONE apply per group round (the summed
+tree, DC-corrected against the AGGREGATOR's last pull) instead of
+``group_size`` separate applies — the standard hierarchical-PS trade.
+Under plain SGD the sum-then-apply is exactly the sequential applies;
+under DC-ASGD the group shares one staleness term, which is the BytePS
+semantic. tests/test_aggregation.py pins the exactly-once ledger bitwise
+with integer-exact gradients.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from typing import Dict, List, Optional
+
+import numpy as np
+
+from ps_tpu.backends.common import (
+    AGG_WORKER_BASE,
+    DEFAULT_BUCKET_BYTES,
+    BucketPlan,
+    parse_replica_uri,
+)
+from ps_tpu.backends.van_service import VanService
+from ps_tpu.compress import decode_tree
+from ps_tpu.control import tensor_van as tv
+from ps_tpu.kv import keys as keymod
+
+__all__ = ["AggregatorService", "serve_aggregator"]
+
+
+class AggregatorService(VanService):
+    """Pre-reduce a host group's pushes into one upstream push per round.
+
+    Args:
+      uri: upstream shard URI list (``h0:p0,h1:p1,...``, ``|`` replica
+        sets) — or None with ``coordinator`` set (the shard table is
+        fetched, and this aggregator registers itself under this host's
+        name so the group's workers discover it).
+      params_like: the model's parameter structure (what the upstream
+        client validates the partition against).
+      group_size: local fan-in — how many same-host workers share this
+        aggregator (None = PS_AGG_GROUP_SIZE, default 1). A round
+        forwards as soon as this many distinct members staged.
+      flush_timeout_ms: how long an incomplete round waits for its
+        remaining members before flushing partial (None =
+        PS_AGG_FLUSH_TIMEOUT_MS, default 2000) — a dead member degrades
+        its group's latency, never wedges it.
+      group: this aggregator's group index (its upstream identity is
+        ``AGG_WORKER_BASE + group``).
+      bucket_bytes/pool_size/compress/...: the UPSTREAM client's
+        transport knobs (the cross-host hop — where compression and
+        bucketing pay); the member-facing side accepts the same bucketed
+        frames and shm-lane offers any VanService does.
+      host: the group key this aggregator registers under at the
+        coordinator (default: this machine's hostname — same-host
+        workers resolve the same name).
+    """
+
+    def __init__(self, uri: Optional[str], params_like,
+                 group_size: Optional[int] = None,
+                 flush_timeout_ms: Optional[float] = None,
+                 group: int = 0,
+                 port: int = 0, bind: str = "127.0.0.1",
+                 bucket_bytes: Optional[int] = None,
+                 pool_size: Optional[int] = None,
+                 compress=None, writev: Optional[bool] = None,
+                 shm: Optional[bool] = None,
+                 shm_bytes: Optional[int] = None,
+                 failover_timeout: Optional[float] = None,
+                 coordinator=None, host: Optional[str] = None,
+                 advertise_host: str = "127.0.0.1",
+                 native_loop: Optional[bool] = None,
+                 loop_threads: Optional[int] = None):
+        from ps_tpu.backends.remote_async import RemoteAsyncWorker
+        from ps_tpu.config import env_float, env_int
+
+        if group_size is None:
+            # validated service-level read (pslint PSL406): Config's
+            # agg_group_size floor of 1 applies here too
+            group_size = env_int("PS_AGG_GROUP_SIZE", 1, lo=1)
+        self.group_size = max(int(group_size), 1)
+        if flush_timeout_ms is None:
+            flush_timeout_ms = env_float("PS_AGG_FLUSH_TIMEOUT_MS",
+                                         2000.0, lo=1.0)
+        self._flush_timeout = float(flush_timeout_ms) / 1e3
+        self.group = int(group)
+        table = None
+        if coordinator is not None:
+            from ps_tpu.elastic.member import fetch_table
+
+            want, _ = keymod.flatten_with_keys(params_like)
+            table = fetch_table(coordinator, cover=want)
+            addrs, replica_sets = table.addrs(), table.replica_sets()
+        elif uri is None:
+            raise ValueError("AggregatorService needs an upstream uri or "
+                             "a coordinator address")
+        else:
+            addrs, replica_sets = parse_replica_uri(uri)
+        # the upstream identity: ONE worker per group, outside the real
+        # id space, so merged pushes get their own dedup/staleness slots
+        self._client = RemoteAsyncWorker.connect_many(
+            addrs, AGG_WORKER_BASE + self.group, params_like,
+            bucket_bytes=bucket_bytes, pool_size=pool_size,
+            compress=compress, writev=writev, shm=shm,
+            shm_bytes=shm_bytes, replica_sets=replica_sets,
+            failover_timeout=failover_timeout,
+            coordinator=coordinator, table=table, agg_role=True)
+        self._key_order = list(self._client._key_order)
+        # the push key-set check runs per member per round: sort ONCE
+        self._sorted_keys = sorted(self._key_order)
+        # round state, all under _rcv: the CURRENT round fills until
+        # group_size members staged (or its deadline passes), then the
+        # flusher thread forwards it and installs a fresh one
+        self._rcv = threading.Condition()
+        self._rounds_done = 0
+        self._round = self._new_round()
+        self._draining = False
+        self._stopped = False
+        # coalesced-pull snapshot (one wire fetch per host per version):
+        # guarded by _pcv; "round" names the flush count it reflects
+        self._pcv = threading.Condition()
+        self._pull_snap: Optional[dict] = None
+        self._pull_fetching = False
+        # THE upstream-client lock: the flusher thread (merged push_pull)
+        # and member-serving threads (coalesced pull_all) share ONE
+        # RemoteAsyncWorker whose channels allow a single driving thread
+        # at a time — every upstream round trip serializes here
+        self._ulock = threading.Lock()
+        # member-facing bucketed pulls: per-worker snapshot + plan cache
+        # (same shape as AsyncPSService._pull_cache, under _stage_lock)
+        self._pull_cache: Dict[int, dict] = {}
+        self._flusher = threading.Thread(target=self._flush_loop,
+                                         daemon=True, name="ps-agg-flush")
+        super().__init__(port=port, bind=bind, writev=writev, shm=shm,
+                         native_loop=native_loop, loop_threads=loop_threads)
+        self.role = "aggregator"  # after super(): introspection truth
+        self._flusher.start()
+        self._coord = coordinator
+        self.host = host
+        if coordinator is not None:
+            import socket
+
+            self.host = host or socket.gethostname()
+            self._register(coordinator, f"{advertise_host}:{self.port}")
+
+    #: member pushes/pulls PARK on the group barrier (a push waits for
+    #: the round's other members) — on the native loop they must never
+    #: run inline on the pump, and never queue behind parked pool workers
+    _BARRIER_KINDS = frozenset({tv.PUSH, tv.PUSH_PULL, tv.BUCKET_PUSH,
+                                tv.PULL, tv.BUCKET_PULL})
+
+    def _register(self, coordinator, uri: str) -> None:
+        """Join the membership table as this host's aggregator (the
+        coordinator-assigned grouping: workers on ``self.host`` discover
+        ``uri`` from the table reply and dial it instead of the shards)."""
+        if isinstance(coordinator, str):
+            chost, cport = coordinator.rsplit(":", 1)
+        else:
+            chost, cport = coordinator
+        ch = tv.Channel.connect(chost, int(cport))
+        try:
+            kind, _, _, extra = tv.decode(ch.request(tv.encode(
+                tv.COORD_HELLO, 0, None,
+                extra={"role": "aggregator", "uri": uri,
+                       "host": self.host})))
+            if kind != tv.OK:
+                raise RuntimeError(f"aggregator registration refused: "
+                                   f"{extra.get('error')}")
+        finally:
+            ch.close()
+        logging.getLogger(__name__).info(
+            "aggregator for host %s registered at %s (group %d, "
+            "fan-in %d)", self.host, uri, self.group, self.group_size)
+
+    # -- rounds ----------------------------------------------------------------
+
+    def _new_round(self) -> dict:
+        return {
+            "id": self._rounds_done,
+            "state": "filling",          # -> flush -> flushing -> done
+            "members": {},               # worker -> grad tree (host kv)
+            "tokens": {},                # worker -> (pnonce, pseq)
+            "deadline": None,            # armed by the first stager
+            "kv": None,                  # post-flush params snapshot
+            "version": None,
+            "error": None,
+        }
+
+    def _flush_loop(self) -> None:
+        """THE flusher: waits for the current round to fill (or time
+        out), swaps in a fresh round, and forwards the merged push —
+        upstream I/O always OUTSIDE the round lock, so staging for the
+        next round proceeds while this one crosses the host boundary."""
+        while True:
+            with self._rcv:
+                while True:
+                    if self._stopped:
+                        return
+                    r = self._round
+                    if self._draining:
+                        # stop() already woke this round's parked members
+                        # into refusal — their staged gradients must NOT
+                        # go upstream behind those failed replies (the
+                        # member would retry under a new seq and
+                        # double-apply). Abandon the round and idle
+                        # until the stop completes.
+                        if r["state"] != "done":
+                            r["state"] = "done"
+                            r["error"] = RuntimeError(
+                                "aggregator is draining; push refused")
+                            self._rcv.notify_all()
+                        self._rcv.wait(0.05)
+                        continue
+                    if r["state"] == "flush":
+                        break
+                    if (r["members"] and r["deadline"] is not None
+                            and time.monotonic() >= r["deadline"]):
+                        # partial flush: a member died / lags — its group
+                        # pays latency once per round, never a wedge
+                        break
+                    self._rcv.wait(0.05)
+                r["state"] = "flushing"
+                self._round = self._new_round()
+                self._rcv.notify_all()  # stagers may start the next round
+            self._do_flush(r)
+
+    def _do_flush(self, r: dict) -> None:
+        t0 = time.perf_counter()
+        try:
+            order = sorted(r["members"])  # deterministic merge order
+            merged: Dict[str, np.ndarray] = {}
+            for w in order:
+                tree = r["members"][w]
+                if not merged:
+                    # own-memory accumulator (member trees may view
+                    # request frames that die at their reply)
+                    merged = {k: np.array(v) for k, v in tree.items()}
+                else:
+                    for k, v in tree.items():
+                        merged[k] += v
+            r["members"] = None  # release the members' frame views early
+            members = {str(w): [t[0], int(t[1])]
+                       for w, t in r["tokens"].items()
+                       if t is not None and t[1] is not None}
+            # ONE upstream round trip: apply the merged tree and bring
+            # the post-apply snapshot back — it answers the whole group's
+            # pulls for this round
+            with self._ulock:
+                params = self._client.push_pull(merged,
+                                                members=members or None)
+                version = self._client.version
+            kv, _ = keymod.flatten_with_keys(params)
+            r["kv"] = {k: np.ascontiguousarray(np.asarray(v))
+                       for k, v in kv.items()}
+            r["version"] = version
+        except BaseException as e:  # surfaced at every parked member
+            r["error"] = e
+        if r["error"] is None:
+            self.transport.record_agg_round(len(r["tokens"]))
+            # publish the snapshot BEFORE the round-done transition:
+            # _rounds_done is written only by this thread, so a puller
+            # that races the gap sees a snapshot round AHEAD of its rid
+            # (>= is what it checks) instead of launching the redundant
+            # upstream fetch the coalescing exists to eliminate
+            with self._pcv:
+                self._pull_snap = {"round": self._rounds_done + 1,
+                                   "kv": r["kv"],
+                                   "version": r["version"]}
+                self._pcv.notify_all()
+        with self._rcv:
+            self._rounds_done += 1
+            ordinal = self._rounds_done
+            r["state"] = "done"
+            self._rcv.notify_all()
+        logging.getLogger(__name__).debug(
+            "aggregator group %d flushed round %d (%d member(s), "
+            "%.1fms)%s", self.group, ordinal, len(r["tokens"]),
+            (time.perf_counter() - t0) * 1e3,
+            f" FAILED: {r['error']!r}" if r["error"] else "")
+
+    def _agg_push(self, worker: int, tree: Dict[str, np.ndarray],
+                  extra: dict) -> dict:
+        """Stage one member's push into the current round and park until
+        the merged upstream flush commits; returns the finished round."""
+        if sorted(tree) != self._sorted_keys:
+            raise KeyError("push keys do not match the registered tree")
+        t0 = time.perf_counter()
+        token = (extra.get("pnonce"), extra.get("pseq"))
+        with self._rcv:
+            while True:
+                if self._draining:
+                    raise RuntimeError(
+                        "aggregator is draining; push refused")
+                r = self._round
+                if r["state"] == "filling" and worker not in r["members"]:
+                    break
+                if r["state"] == "filling":
+                    # this member is a round ahead of its group: force
+                    # the staged round out so one member's pushes can
+                    # never interleave within a merged apply
+                    r["state"] = "flush"
+                    self._rcv.notify_all()
+                self._rcv.wait(0.05)
+            r["members"][worker] = tree
+            r["tokens"][worker] = token
+            if r["deadline"] is None:
+                r["deadline"] = time.monotonic() + self._flush_timeout
+            if len(r["members"]) >= self.group_size:
+                r["state"] = "flush"
+                self._rcv.notify_all()
+            # park until the flusher commits the round upstream. Counted
+            # like a checkpoint-pause park so stop()'s drain never burns
+            # its grace on barrier waiters (they wake into refusal).
+            self._pause_wait_begin()
+            try:
+                while r["state"] != "done":
+                    if self._draining:
+                        raise RuntimeError(
+                            "aggregator is draining; push refused")
+                    self._rcv.wait(0.1)
+            finally:
+                self._pause_wait_end()
+        if r["error"] is not None:
+            raise RuntimeError(
+                f"merged upstream push failed: {r['error']!r}")
+        self.transport.record_agg_hold(time.perf_counter() - t0)
+        return r
+
+    # -- coalesced pulls -------------------------------------------------------
+
+    def _coalesced_pull(self) -> dict:
+        """The group's shared snapshot for the CURRENT round: served from
+        the last merged flush when fresh, else ONE upstream wire fetch —
+        concurrent readers wait on the same fetch instead of fanning N
+        identical pulls over the cross-host path."""
+        while True:
+            with self._rcv:
+                rid = self._rounds_done
+            with self._pcv:
+                snap = self._pull_snap
+                if snap is not None and snap["round"] >= rid:
+                    return snap
+                if self._pull_fetching:
+                    self._pcv.wait(0.1)
+                    continue
+                self._pull_fetching = True
+            try:
+                with self._ulock:  # never drive the shared upstream
+                    # client concurrently with the flusher
+                    params = self._client.pull_all()
+                    version = self._client.version
+                kv, _ = keymod.flatten_with_keys(params)
+                snap = {"round": rid,
+                        "kv": {k: np.ascontiguousarray(np.asarray(v))
+                               for k, v in kv.items()},
+                        "version": version}
+            except BaseException:
+                with self._pcv:
+                    self._pull_fetching = False
+                    self._pcv.notify_all()
+                raise
+            with self._pcv:
+                self._pull_fetching = False
+                cur = self._pull_snap
+                if cur is None or cur["round"] <= snap["round"]:
+                    self._pull_snap = snap
+                self._pcv.notify_all()
+                return self._pull_snap
+
+    def _params_reply(self, worker: int, snap: dict) -> bytes:
+        if self.writev:
+            return tv.encode_parts(tv.OK, worker, snap["kv"],
+                                   extra={"version": snap["version"]})
+        return tv.encode(tv.OK, worker, snap["kv"],
+                         extra={"version": snap["version"]})
+
+    # -- protocol --------------------------------------------------------------
+
+    def _dispatch_traced(self, kind: int, worker: int, tensors,
+                         extra) -> bytes:
+        # no primary/backup gate: an aggregator serves its group directly
+        # (REPLICA_STATE still answers so clock probes and ps_top work)
+        if kind == tv.REPLICA_STATE:
+            return tv.encode(tv.OK, worker, None, extra=self.replica_state())
+        return self._handle(kind, worker, tensors, extra)
+
+    def _handle(self, kind: int, worker: int, tensors, extra) -> bytes:
+        if kind == tv.HELLO:
+            return tv.encode(tv.OK, worker, None, extra={
+                "keys": self._key_order,
+                "version": self._client.version,
+                "num_workers": self._client.num_workers,
+                "shard": None,
+                "num_shards": None,
+                "epoch": self.epoch,
+                "role": self.role,
+                "table_epoch": self.table_epoch,
+            })
+        elif kind == tv.PULL:
+            return self._params_reply(worker, self._coalesced_pull())
+        elif kind == tv.PUSH:
+            tree = self._decode_member_push(tensors, extra)
+            r = self._agg_push(worker, tree, extra)
+            return tv.encode(tv.OK, worker, None,
+                             extra={"version": r["version"]})
+        elif kind == tv.PUSH_PULL:
+            tree = self._decode_member_push(tensors, extra)
+            r = self._agg_push(worker, tree, extra)
+            return self._params_reply(
+                worker, {"kv": r["kv"], "version": r["version"]})
+        elif kind == tv.BUCKET_PUSH:
+            return self._bucket_push(worker, tensors, extra)
+        elif kind == tv.BUCKET_PULL:
+            return self._bucket_pull(worker, extra)
+        elif kind == tv.STATS:
+            out = {
+                "version": self._client.version,
+                "rounds": self._rounds_done,
+                "group_size": self.group_size,
+                "metrics": self.transport.metrics_snapshot(),
+                "upstream": {
+                    "bytes_pushed": self._client.bytes_pushed,
+                    "bytes_pulled": self._client.bytes_pulled,
+                },
+            }
+            out.update(self.replica_state())
+            return tv.encode(tv.OK, worker, None, extra=out)
+        return tv.encode(tv.ERR, worker, None,
+                         extra={"error": f"bad kind {kind} (aggregators "
+                                         f"serve the data plane only)"})
+
+    def _decode_member_push(self, tensors, extra) -> Dict[str, np.ndarray]:
+        # no defensive copy: a serial frame's views stay valid for the
+        # whole round — the serve thread parks in _agg_push until the
+        # flush is done, and its request buffer is only released after
+        # the reply. _do_flush reads the views exactly once (the merged
+        # accumulator owns its memory) and drops them before the
+        # upstream push.
+        return decode_tree(dict(tensors), extra.get("enc"),
+                           stats=self.transport)
+
+    def _bucket_push(self, worker: int, tensors, extra) -> bytes:
+        """Member half of a multi-bucket push: incomplete epochs only
+        stage (plain ack); the completing bucket joins the round and
+        parks for the merged commit — the member observes exactly the
+        shard protocol's reply shapes."""
+        tree = self._stage_bucket_push(
+            worker, int(extra["bucket"]), int(extra["nbuckets"]),
+            int(extra["epoch"]), tensors["raw"], extra["slices"],
+            nonce=extra.get("nonce"),
+        )
+        if tree is None:
+            return tv.encode(tv.OK, worker, None,
+                             extra={"staged": int(extra["bucket"])})  # pslint: disable=PSL203 -- debug-visibility ack field, same contract as AsyncPSService._bucket_push: names the staged bucket for packet-level triage
+        tree = decode_tree(tree, extra.get("enc"), stats=self.transport)
+        r = self._agg_push(worker, tree, extra)
+        return tv.encode(tv.OK, worker, None, extra={
+            "version": r["version"], "committed": True,
+        })
+
+    def _bucket_pull(self, worker: int, extra) -> bytes:
+        """Bucketed pull over the coalesced snapshot: bucket 0 binds this
+        worker's epoch to the group snapshot (ONE upstream fetch however
+        many members ask); buckets 1..n-1 slice the cached copy."""
+        epoch, b = int(extra["epoch"]), int(extra["bucket"])
+        if b == 0:
+            bb = int(extra.get("bucket_bytes") or DEFAULT_BUCKET_BYTES)
+            snap = self._coalesced_pull()
+            plan = BucketPlan.from_arrays(snap["kv"], bb,
+                                          order=self._key_order)
+            with self._stage_lock:
+                if plan.nbuckets > 1:
+                    self._pull_cache[worker] = {
+                        "epoch": epoch, "host": snap["kv"], "plan": plan,
+                        "version": snap["version"],
+                        "left": set(range(1, plan.nbuckets)),
+                    }
+                else:
+                    self._pull_cache.pop(worker, None)
+            enc_fn = plan.bucket_encoder(self.writev)
+            return enc_fn(tv.OK, worker, snap["kv"], 0, extra={
+                "epoch": epoch, "version": snap["version"], "enc": [],
+            })
+        with self._stage_lock:
+            entry = self._pull_cache.get(worker)
+            if (entry is None or entry["epoch"] != epoch
+                    or b not in entry["left"]):
+                return tv.encode(tv.ERR, worker, None, extra={
+                    "error": f"no cached pull snapshot for worker "
+                             f"{worker} epoch {epoch} bucket {b}",
+                })
+            entry["left"].discard(b)
+            if not entry["left"]:
+                self._pull_cache.pop(worker, None)
+        enc_fn = entry["plan"].bucket_encoder(self.writev)
+        return enc_fn(tv.OK, worker, entry["host"], b,
+                      extra={"epoch": epoch, "version": entry["version"],
+                             "enc": []})
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def _set_draining(self) -> None:
+        with self._rcv:
+            self._draining = True
+            self._rcv.notify_all()  # barrier waiters wake into refusal
+
+    def stop(self, grace: float = 10.0) -> None:
+        super().stop(grace=grace)
+        with self._rcv:
+            self._stopped = True
+            self._rcv.notify_all()
+        self._flusher.join(timeout=5)
+        try:
+            self._client.close()
+        except Exception:
+            pass  # a dead upstream must not block the local teardown
+
+    def kill(self) -> None:
+        """SIGKILL-equivalent for the failure drills: sever the group's
+        connections NOW. In-flight rounds die unacked — exactly the
+        window the constituent-token ledger covers when members degrade
+        to the flat path and replay."""
+        super().kill()
+        with self._rcv:
+            self._stopped = True
+            self._draining = True
+            self._rcv.notify_all()
+        self._flusher.join(timeout=5)
+        try:
+            self._client.close()
+        except Exception:
+            pass
+
+
+def serve_aggregator(uri: Optional[str], params_like,
+                     group_size: Optional[int] = None,
+                     **kw) -> AggregatorService:
+    """Start a host group's aggregator (README "Two-tier aggregation").
+
+    The launcher-shaped entry: one per host, ``group_size`` = the host's
+    worker count (PS_AGG_GROUP_SIZE), ``uri`` = the shard fleet (or
+    ``coordinator=`` for elastic membership — the aggregator then
+    registers under this host's name and the group's workers discover it
+    from the table). Returns the running service (``.port``,
+    ``.stop()``)."""
+    return AggregatorService(uri, params_like, group_size=group_size, **kw)

@@ -8,7 +8,7 @@ break that parity.
 
 from __future__ import annotations
 
-import queue
+import heapq
 import threading
 import time
 from typing import Callable, Dict, List, Optional, Sequence, Tuple
@@ -112,6 +112,14 @@ class _OpScope:
 #: meta, syscalls) is noise, small enough that many buckets are in flight
 #: per tree and the pipeline has something to overlap.
 DEFAULT_BUCKET_BYTES = 4 << 20
+
+#: Worker-id floor for aggregator identities (ps_tpu/backends/aggregator):
+#: an aggregator pushes its group's MERGED gradient to the shards under a
+#: synthetic worker id — group index offset past this base — so its
+#: per-key dedup tokens and DC staleness bookkeeping never collide with a
+#: real worker's slot (real ids live in [0, num_workers); the engines'
+#: range check admits ids at or past this base explicitly).
+AGG_WORKER_BASE = 1 << 20
 
 #: Default drain_to deadline (checkpoint coordinators produce it on the
 #: wire; servers fall back to it for hand-rolled frames). One constant so
@@ -322,11 +330,21 @@ class ChannelPump:
 
     The background half of the pipelined transport: callers ``submit``
     encoded frames and immediately get a Future for the reply; the pump
-    thread drains the queue in FIFO order over its own
+    thread drains the pending queue over its own
     :class:`~ps_tpu.control.tensor_van.Channel` (one driving thread per
     channel, as the van requires). Striping a plan's buckets round-robin
     over a pool of pumps gives per-server send/recv parallelism — the
     native sends release the GIL, so pumps genuinely overlap.
+
+    The pending queue is a PRIORITY queue (ByteScheduler-style): each
+    submit carries a small integer priority — lower drains first — and
+    ties break on the enqueue sequence number, so equal-priority traffic
+    stays exactly FIFO and the drain order is fully deterministic. Bucket
+    senders pass the bucket index (front-of-model first, i.e. reverse of
+    backprop completion order), so when a backlog forms, the tail
+    layers' buckets stop serializing in front of the bytes the next
+    step's forward needs first. All-default submits reproduce the
+    legacy FIFO pump bit for bit.
     """
 
     def __init__(self, ch, on_io: Optional[Callable] = None):
@@ -334,32 +352,45 @@ class ChannelPump:
 
         self._ch = ch
         self._on_io = on_io  # (bytes_out, bytes_in, seconds) per request
-        self._q: "queue.SimpleQueue" = queue.SimpleQueue()
+        self._cv = threading.Condition()
+        self._heap: list = []   # (priority, seq, payload, fut)
+        self._seq = 0
         self._closed = False
         self._t = threading.Thread(target=self._loop, daemon=True)
         self._t.start()
 
-    def submit(self, payload):
+    def submit(self, payload, priority: int = 0):
         import concurrent.futures
 
         fut = concurrent.futures.Future()
-        if self._closed:
-            # fail fast instead of queueing behind a dead thread — a caller
-            # racing close() (e.g. a background cycle during reconnect)
-            # gets a connection-shaped error, never a forever-pending future
-            fut.set_exception(tv.VanError("pump closed"))
-            return fut
-        self._q.put((payload, fut))
+        with self._cv:
+            if self._closed:
+                # fail fast instead of queueing behind a dead thread — a
+                # caller racing close() (e.g. a background cycle during
+                # reconnect) gets a connection-shaped error, never a
+                # forever-pending future
+                fut.set_exception(tv.VanError("pump closed"))
+                return fut
+            self._seq += 1
+            # the seq tie-break also guarantees (payload, fut) are never
+            # compared by heapq
+            heapq.heappush(self._heap,
+                           (int(priority), self._seq, payload, fut))
+            self._cv.notify()
         return fut
 
     def _loop(self) -> None:
         import time
 
         while True:
-            item = self._q.get()
-            if item is None:
-                return
-            payload, fut = item
+            with self._cv:
+                while not self._heap and not self._closed:
+                    self._cv.wait()
+                if not self._heap:
+                    return  # closed AND drained — same contract as the
+                    # old stop sentinel: everything queued before close()
+                    # still goes out
+                _, _, payload, fut = heapq.heappop(self._heap)
             if not fut.set_running_or_notify_cancel():
                 continue
             t0 = time.perf_counter()
@@ -379,19 +410,17 @@ class ChannelPump:
             fut.set_result(reply)
 
     def close(self) -> None:
-        """Stop the thread (after the queue drains) and close the channel.
-        Requests that slipped in behind the stop sentinel are failed, never
-        left as forever-pending futures."""
-        self._closed = True
-        self._q.put(None)
+        """Stop the thread (after the pending queue drains) and close the
+        channel. Requests that slipped in behind the close are failed,
+        never left as forever-pending futures."""
+        with self._cv:
+            self._closed = True
+            self._cv.notify_all()
         self._t.join(timeout=10)
-        while True:
-            try:
-                item = self._q.get_nowait()
-            except queue.Empty:
-                break
-            if item is not None:
-                item[1].set_exception(tv.VanError("pump closed"))
+        with self._cv:
+            leftovers, self._heap = self._heap, []
+        for _, _, _, fut in leftovers:
+            fut.set_exception(tv.VanError("pump closed"))
         self._ch.close()
 
 
@@ -412,7 +441,8 @@ class BucketedTransportMixin:
                         pool_size: Optional[int],
                         compress=None, writev: Optional[bool] = None,
                         shm: Optional[bool] = None,
-                        shm_bytes: Optional[int] = None) -> None:
+                        shm_bytes: Optional[int] = None,
+                        bucket_priority: Optional[bool] = None) -> None:
         import os
         import uuid
 
@@ -431,6 +461,14 @@ class BucketedTransportMixin:
         self.writev = (env_flag("PS_WRITEV", True)
                        if writev is None else bool(writev))
         self.shm = env_flag("PS_SHM", False) if shm is None else bool(shm)
+        # priority bucket scheduling (ByteScheduler-style): bucket flushes
+        # carry their bucket index as the pump priority — front-of-model
+        # buckets drain a backlog first, so the tail layers' grads stop
+        # blocking the bytes the next step's forward needs. Off = every
+        # submit at priority 0 = the legacy FIFO drain, bit for bit.
+        self.bucket_priority = (env_flag("PS_BUCKET_PRIORITY", True)
+                                if bucket_priority is None
+                                else bool(bucket_priority))
         # validated service-level read (pslint PSL406): Config's >=64KiB
         # ring floor applies here too — an env value below it would
         # break the ring's wrap-sentinel framing math, not just be slow
@@ -508,6 +546,13 @@ class BucketedTransportMixin:
         out = dict(extra or {})
         out[obs.WIRE_KEY] = wire
         return out
+
+    def _bucket_submit_priority(self, b: int) -> int:
+        """The pump priority for bucket ``b`` of a plan: the bucket index
+        itself (front-of-model first — plans pack keys in sorted order)
+        when priority scheduling is on, else a constant 0 (pure FIFO, the
+        parity baseline the scheduling tests diff against)."""
+        return int(b) if self.bucket_priority else 0
 
     def _encode_push_tree(self, arrays: Dict[str, np.ndarray]
                           ) -> Tuple[Dict[str, np.ndarray], List[str]]:

@@ -126,7 +126,11 @@ class LocalServer(PeekMixin, AsyncStagingMixin, CheckpointMixin):
             return
         if set(grads_kv) != set(self._params):
             raise ValueError("gradient keys do not match registered keys")
-        if not (0 <= worker < self.num_workers):
+        from ps_tpu.backends.common import AGG_WORKER_BASE
+
+        # aggregator identities (merged host-group pushes) are legal
+        # pushers outside [0, num_workers) — see AsyncTpuServer._check_worker
+        if worker < AGG_WORKER_BASE and not (0 <= worker < self.num_workers):
             raise ValueError(f"worker {worker} out of range [0, {self.num_workers})")
         with self._lock:
             self._commit_tree(grads_kv, worker)

@@ -331,18 +331,6 @@ class AsyncPSService(VanService):
         apply_s = None
         with obs.tracer().child("server_apply", cat="server"), \
                 self._engine._lock:
-            fresh = grads
-            if pseq is not None:
-                fresh = self._dedup_fresh(worker, pnonce, int(pseq), grads)
-                if not fresh:
-                    # every key already carries this (nonce, seq): the
-                    # replay of a fully-applied push — ack, never touch
-                    # the engine
-                    self.transport.record_dedup_hit()
-                    return None, True
-            # under the lock: a migration cutover flips _key_order under
-            # this same lock, so the check and the apply see ONE table
-            self._check_push_keys(grads)
             while (self._paused and not self._draining
                    and not self._admit_while_paused(worker)):
                 self._pause_wait_begin()
@@ -352,6 +340,39 @@ class AsyncPSService(VanService):
                     self._pause_wait_end()
             if self._draining:
                 raise RuntimeError("server is draining; push refused")
+            # every validation below runs AFTER any pause park: the wait
+            # releases the engine lock, so dedup/ledger/key-range state
+            # may have moved while this push was parked (e.g. a degraded
+            # member's flat replay settling a constituent of a parked
+            # merged push) — a verdict computed before the park would be
+            # stale, which is exactly a double-apply window
+            fresh = grads
+            if pseq is not None:
+                fresh = self._dedup_fresh(worker, pnonce, int(pseq), grads)
+                if not fresh:
+                    # every key already carries this (nonce, seq): the
+                    # replay of a fully-applied push — ack, never touch
+                    # the engine
+                    self.transport.record_dedup_hit()
+                    return None, True
+            members = extra.get("members")
+            if members:
+                # merged push vs its constituents' own flat replays: a
+                # group that degraded mid-round races its dead
+                # aggregator's in-flight merged push. First writer wins
+                # per member: if every constituent's contribution is
+                # already covered by its own recorded token, the merged
+                # push is a pure replay (ack, never apply); a PARTIAL
+                # overlap cannot be subtracted from a summed tree, so it
+                # is refused loudly rather than silently double-applied.
+                verdict = self._check_members(members, fresh)
+                if verdict == "dedup":
+                    self.transport.record_dedup_hit()
+                    return None, True
+            # under the lock (and after the park): a migration cutover
+            # flips _key_order under this same lock, so the check and
+            # the apply see ONE table
+            self._check_push_keys(grads)
             if len(fresh) == len(grads):
                 self._engine.push_tree(fresh, worker=worker)
             else:
@@ -366,6 +387,16 @@ class AsyncPSService(VanService):
                 toks = self._applied_pseq.setdefault(worker, {})
                 for k in fresh:
                     toks[k] = (pnonce, int(pseq))
+            # hierarchical aggregation (backends/aggregator.py): a merged
+            # push carries its CONSTITUENT members' own dedup tokens next
+            # to the aggregator's derived one. Recording both keeps the
+            # ledger exactly-once across the handoff in either direction:
+            # a member that degrades to the flat path and replays a push
+            # its dead aggregator already forwarded dedups against its own
+            # recorded token, and an aggregator-side failover replay of
+            # the merged push dedups against the derived token — the two
+            # live under different worker ids, so neither evicts the other.
+            self._record_members(extra.get("members"), fresh)
             self._pause_cond.notify_all()  # a drain_to waiter may be watching
             with self._log_lock:
                 self.apply_log.append(worker)
@@ -384,10 +415,64 @@ class AsyncPSService(VanService):
             rseq = self._replicate(  # pslint: disable=PSL101 -- deliberate backpressure: a full ack window MUST stall commits under the apply lock (that IS the bounded-lag contract), and stall_timeout degrades a corpse instead of wedging
                 "push" if len(fresh) == len(self._key_order)
                 else "push_sub",
-                worker, fresh, {"pseq": pseq, "pnonce": pnonce})
+                worker, fresh, {"pseq": pseq, "pnonce": pnonce,
+                                "members": extra.get("members")})
         if apply_s is not None:
             self.transport.record_apply(apply_s)
         return rseq, False
+
+    @staticmethod
+    def _token_settled(cur, nonce, seq: int) -> bool:
+        """THE ledger predicate, shared by dedup classification
+        (:meth:`_dedup_fresh`, :meth:`_check_members`) and recording
+        (:meth:`_record_members`) so the exactly-once semantics cannot
+        drift between them: a recorded token at/past (nonce, seq) —
+        same-nonce comparison only, a new nonce is a new incarnation
+        whose seqs restart — means that push already carries this key."""
+        return cur is not None and cur[0] == nonce and int(seq) <= cur[1]
+
+    def _record_members(self, members, fresh) -> None:
+        """Record a merged push's constituent (worker, nonce, seq) tokens
+        for every key it applied (engine lock held). ``members`` is the
+        aggregator's ``{worker_str: [nonce, seq]}`` map, None/empty on
+        ordinary pushes. The ledger only ever advances: a member that
+        already applied a LATER flat push (it degraded and moved on)
+        must not have its token moved backward — that would re-open
+        dedup for a seq the engine already holds."""
+        for w_str, t in (members or {}).items():
+            toks = self._applied_pseq.setdefault(int(w_str), {})
+            for k in fresh:
+                if self._token_settled(toks.get(k), t[0], t[1]):
+                    continue
+                toks[k] = (t[0], int(t[1]))
+
+    def _check_members(self, members, fresh) -> str:
+        """Classify a merged push against its constituents' recorded
+        tokens (engine lock held): "apply" (no constituent applied —
+        the normal case), "dedup" (EVERY constituent's token is already
+        at/past its merged entry on every key — the whole merged push is
+        a replay of individually-settled state), or raise (a partial
+        overlap: some member's gradient is already in the engine via its
+        own flat replay, and a summed tree cannot be partially applied —
+        refusing loudly is the only exactly-once answer)."""
+        stale = total = 0
+        for w_str, t in members.items():
+            toks = self._applied_pseq.get(int(w_str)) or {}
+            for k in fresh:
+                total += 1
+                if self._token_settled(toks.get(k), t[0], t[1]):
+                    stale += 1
+        if stale == 0:
+            return "apply"
+        if stale == total:
+            return "dedup"
+        raise RuntimeError(
+            "merged push refused: some of its constituent pushes were "
+            "already applied individually (the group degraded mid-round "
+            "and replayed flat) — a summed tree cannot be partially "
+            "applied; the remaining members' flat replays settle the "
+            "round exactly-once"
+        )
 
     def _dedup_fresh(self, worker: int, pnonce, pseq: int,
                      grads: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
@@ -402,8 +487,7 @@ class AsyncPSService(VanService):
             return grads
         fresh = {}
         for k, v in grads.items():
-            t = toks.get(k)
-            if t is not None and t[0] == pnonce and pseq <= t[1]:
+            if self._token_settled(toks.get(k), pnonce, pseq):
                 continue
             fresh[k] = v
         return fresh
@@ -1123,6 +1207,10 @@ class AsyncPSService(VanService):
             toks = self._applied_pseq.setdefault(worker, {})
             for k in tree:
                 toks[k] = (extra.get("pnonce"), int(extra["pseq"]))
+        # merged pushes replicate their constituent tokens too, so a
+        # promoted backup suppresses a degraded member's replay exactly
+        # like its dead primary would have
+        self._record_members(extra.get("members"), tree)
         with self._log_lock:
             self.apply_log.append(worker)
             self.event_log.append([op, worker])
@@ -1169,7 +1257,8 @@ def connect_async(uri: Optional[str], worker: int, params_like,
                   shm: Optional[bool] = None,
                   shm_bytes: Optional[int] = None,
                   failover_timeout: Optional[float] = None,
-                  coordinator=None) -> "RemoteAsyncWorker":
+                  coordinator=None,
+                  aggregator: Optional[str] = None) -> "RemoteAsyncWorker":
     """Join a cross-process async job as worker ``worker``.
 
     ``uri`` is ``host:port`` of the :func:`serve_async` process, or a
@@ -1213,28 +1302,79 @@ def connect_async(uri: Optional[str], worker: int, params_like,
     coordinator (waiting until every server registered and the table
     covers this model's keys), dials the shards it names, and
     re-fetches + re-routes whenever a live rebalance moves keys under
-    it — no worker restart, no global pause."""
+    it — no worker restart, no global pause.
+
+    Hierarchical aggregation (README "Two-tier aggregation"): pass
+    ``aggregator="host:port"`` to route this worker's whole data plane
+    through its host group's :class:`~ps_tpu.backends.aggregator.
+    AggregatorService` — same-host pushes pre-reduce locally and cross
+    the host boundary ONCE per group, pulls coalesce to one wire fetch
+    per group per version. With a coordinator, the aggregator for this
+    worker's host is discovered from the membership table automatically
+    (aggregators register per host); if the aggregator later dies the
+    worker degrades to the flat worker→shard topology without a restart
+    and with its dedup identity intact."""
     table = None
+    discovered = False
     if coordinator is not None:
         from ps_tpu.elastic.member import fetch_table
 
         want, _ = keymod.flatten_with_keys(params_like)
-        table = fetch_table(coordinator, cover=want)
+        view: dict = {}
+        table = fetch_table(coordinator, cover=want, view_out=view)
         addrs, replica_sets = table.addrs(), table.replica_sets()
+        if aggregator is None:
+            import socket
+
+            # coordinator-assigned grouping: same-host workers share the
+            # aggregator registered under this host's name (none =
+            # flat); the map rode the fetch_table poll — no second
+            # coordinator round trip
+            aggregator = (view.get("aggregators") or {}).get(
+                socket.gethostname())
+            discovered = aggregator is not None
     elif uri is None:
         raise ValueError("connect_async needs a server uri or a "
                          "coordinator address")
     else:
         addrs, replica_sets = parse_replica_uri(uri)
-    return RemoteAsyncWorker.connect_many(addrs, worker, params_like,
-                                          bucket_bytes=bucket_bytes,
-                                          pool_size=pool_size,
-                                          compress=compress, writev=writev,
-                                          shm=shm, shm_bytes=shm_bytes,
-                                          replica_sets=replica_sets,
-                                          failover_timeout=failover_timeout,
-                                          coordinator=coordinator,
-                                          table=table)
+
+    def dial(agg):
+        return RemoteAsyncWorker.connect_many(
+            addrs, worker, params_like, bucket_bytes=bucket_bytes,
+            pool_size=pool_size, compress=compress, writev=writev,
+            shm=shm, shm_bytes=shm_bytes, replica_sets=replica_sets,
+            failover_timeout=failover_timeout, coordinator=coordinator,
+            table=table, aggregator=agg)
+
+    if discovered:
+        # the registry keeps a crashed aggregator's entry until a
+        # replacement registers (aggregators own no keys, so membership
+        # never reaps them) — a NEW worker on that host must join flat
+        # instead of failing its connect against a dead URI. The cheap
+        # probe (short retry budget, NOT Channel.connect's default ~15s
+        # patience) keeps a stale entry from stalling every join on the
+        # host; the except still covers an aggregator dying between the
+        # probe and the real dial.
+        ahost, aport = str(aggregator).rsplit(":", 1)
+        try:
+            probe = tv.Channel.connect(ahost, int(aport),
+                                       timeout_ms=1000, retries=2,
+                                       max_wait_s=0.2)
+            probe.close()
+        except (tv.VanError, OSError) as e:
+            logging.getLogger(__name__).warning(
+                "discovered aggregator %s is not answering (%s) — "
+                "joining flat", aggregator, e)
+            return dial(None)
+        try:
+            return dial(aggregator)
+        except (ServerFailureError, tv.VanError, OSError) as e:
+            logging.getLogger(__name__).warning(
+                "discovered aggregator %s is not serving (%s) — "
+                "joining flat", aggregator, e)
+            return dial(None)
+    return dial(aggregator)
 
 
 class CheckpointRoundError(RuntimeError):
@@ -1392,7 +1532,9 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                      shm_bytes: Optional[int] = None,
                      replica_sets=None,
                      failover_timeout: Optional[float] = None,
-                     coordinator=None, table=None
+                     coordinator=None, table=None,
+                     aggregator: Optional[str] = None,
+                     agg_role: bool = False
                      ) -> "RemoteAsyncWorker":
         self = cls.__new__(cls)
         self._init_multi(list(addrs), worker, params_like,
@@ -1400,7 +1542,8 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                          compress=compress, writev=writev, shm=shm,
                          shm_bytes=shm_bytes, replica_sets=replica_sets,
                          failover_timeout=failover_timeout,
-                         coordinator=coordinator, table=table)
+                         coordinator=coordinator, table=table,
+                         aggregator=aggregator, agg_role=agg_role)
         return self
 
     def _init_multi(self, addrs: List[Tuple[str, int]], worker: int,
@@ -1411,8 +1554,32 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                     shm_bytes: Optional[int] = None,
                     replica_sets=None,
                     failover_timeout: Optional[float] = None,
-                    coordinator=None, table=None) -> None:
+                    coordinator=None, table=None,
+                    aggregator: Optional[str] = None,
+                    agg_role: bool = False) -> None:
         self.worker = worker
+        # hierarchical two-level aggregation (backends/aggregator.py):
+        # with an aggregator URI this worker dials ONLY its host group's
+        # aggregator — a 1-shard topology advertising the whole tree —
+        # and remembers the flat shard topology so an aggregator death
+        # degrades the group back to flat worker→shard routing without a
+        # restart (and without a new dedup identity: the replayed push
+        # must still be recognized by shards that applied its merged
+        # form). agg_role marks the AGGREGATOR'S OWN upstream client,
+        # whose synthetic id lives outside [0, num_workers).
+        self._agg_fallback = None
+        self._agg_uri = aggregator
+        if aggregator is not None:
+            self._agg_fallback = {
+                "addrs": [tuple(a) for a in addrs],
+                "replica_sets": replica_sets,
+                "table": table,
+            }
+            ahost, aport = str(aggregator).rsplit(":", 1)
+            addrs = [(ahost, int(aport))]
+            replica_sets = None
+            table = None  # routing goes through the aggregator now
+        self._agg_role = bool(agg_role)
         # elastic membership (ps_tpu/elastic): with a coordinator, the
         # shard table drives addrs/replica-sets and a stale-table refusal
         # re-fetches it (_on_table_moved) instead of failing the job
@@ -1566,7 +1733,7 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         if missing:
             raise ValueError(f"no server owns keys {missing[:3]}"
                              f"{'...' if len(missing) > 3 else ''}")
-        if not (0 <= worker < self.num_workers):
+        if not self._agg_role and not (0 <= worker < self.num_workers):
             raise ValueError(
                 f"worker id {worker} out of range for a "
                 f"{self.num_workers}-worker job"
@@ -1672,6 +1839,60 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
             self.worker, table.epoch, len(table.shards),
         )
 
+    # -- hierarchical aggregation: degrade to the flat path -------------------
+
+    def _on_server_lost(self, err: ServerFailureError,
+                        deadline: float) -> None:
+        """A shard failed with no replica to cycle to. When that "shard"
+        is this host group's AGGREGATOR, the group degrades to the flat
+        worker→shard topology it remembers from connect time — the
+        PR 4/7 re-route shape: typed failure, rebuild, retry the op. The
+        retried push replays under its ORIGINAL (nonce, seq) token, and
+        shards that already applied its merged form recorded this
+        member's constituent token, so the replay is acked without
+        re-applying — no ledger violation in either direction."""
+        if getattr(self, "_agg_fallback", None) is None:
+            raise err
+        self._degrade_to_flat(err)
+
+    def _degrade_to_flat(self, cause: BaseException) -> None:
+        """Rebuild the whole transport against the remembered flat shard
+        topology, preserving transport identity — cumulative counters,
+        epoch streams, compressor residuals, and CRUCIALLY the dedup
+        nonce + push seq (a degrade is not a new incarnation: the op that
+        hit the failure replays with its original token right after
+        this)."""
+        fb = self._agg_fallback
+        obs.record_event("agg_degrade", worker=self.worker,
+                         shards=len(fb["addrs"]), cause=repr(cause))
+        self.transport.record_agg_degrade()
+        saved = self._saved_transport_state()
+        nonce, push_seq = self._transport_nonce, self._push_seq
+        self._close_transport()
+        for ch in self._chs:
+            ch.close()
+        if self._pool is not None:
+            self._pool.shutdown(wait=False)
+        try:
+            self._init_multi(
+                fb["addrs"], self.worker,
+                keymod.unflatten(self._treedef, self._kv_like,
+                                 self._key_order),
+                bucket_bytes=self.bucket_bytes, pool_size=self.pool_size,
+                compress=self.compress, writev=self.writev, shm=self.shm,
+                shm_bytes=self.shm_bytes,
+                replica_sets=fb["replica_sets"],
+                failover_timeout=self.failover_timeout,
+                coordinator=self._coord, table=fb["table"])
+        finally:
+            self._restore_transport_state(saved)
+            self._transport_nonce, self._push_seq = nonce, push_seq
+        logging.getLogger(__name__).warning(
+            "worker %d: aggregator lost (%s) — degraded to the flat "
+            "worker→shard path (%d shard(s))",
+            self.worker, cause, len(self._addrs),
+        )
+
     @property
     def version(self) -> int:
         """Total whole-subtree applies across all servers (single-server:
@@ -1772,7 +1993,7 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                     for i in self._active
                 })))
 
-    def push_all(self, grads) -> None:
+    def push_all(self, grads, members: Optional[dict] = None) -> None:
         """Push a gradient tree; each owner applies its subtree immediately
         with the DC-ASGD correction against this worker's last pull from it.
 
@@ -1780,9 +2001,11 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         ONCE per logical push, reused verbatim by any failover retry, so a
         shard that already applied it (directly, via its dead primary's
         replication stream, or via a migrated key range's transferred
-        tokens) acks without re-applying. The owner SPLIT happens inside
-        the retried closure: a table re-route between attempts re-splits
-        against the new assignment."""
+        tokens) acks without re-applying. ``members`` (aggregator use
+        only) attaches the merged push's constituent tokens so the shard
+        ledger also covers a degraded member's flat replay. The owner
+        SPLIT happens inside the retried closure: a table re-route
+        between attempts re-splits against the new assignment."""
         kv = self._host_grads(grads)
         pseq = self._next_push_seq()
         with self._op("push") as sp:
@@ -1791,13 +2014,14 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                 self.flush()
                 self._with_failover(
                     lambda: self._push_buckets_sync(self._split_kv(kv),
-                                                    pseq=pseq, tc=tc))
+                                                    pseq=pseq, tc=tc,
+                                                    members=members))
                 return
 
             def once():
                 msgs = self._fanout({
                     i: self._encode_serial_push(tv.PUSH, sub, pseq=pseq,
-                                                tc=tc)
+                                                tc=tc, members=members)
                     for i, sub in self._split_kv(kv).items()
                 })
                 for i, msg in msgs.items():
@@ -1808,12 +2032,13 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
 
             self._with_failover(once)
 
-    def push_pull(self, grads) -> Any:
+    def push_pull(self, grads, members: Optional[dict] = None) -> Any:
         """push_all + pull_all in ONE round trip per server (the async
         cycle), all servers in flight concurrently. Routed through the
         bucketed pipeline when the worker was connected with
         ``bucket_bytes`` (identical math — the server applies the same
-        whole tree and snapshots the same atomic pull)."""
+        whole tree and snapshots the same atomic pull). ``members`` as in
+        :meth:`push_all` (aggregator-forwarded merged pushes only)."""
         kv = self._host_grads(grads)
         pseq = self._next_push_seq()
         with self._op("push_pull") as sp:
@@ -1824,28 +2049,31 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
 
                 def once_bucketed():
                     self._push_buckets_sync(self._split_kv(kv), pseq=pseq,
-                                            tc=tc)
+                                            tc=tc, members=members)
                     return self._merge_host_params(self._pull_buckets(tc=tc))
 
                 return self._with_failover(once_bucketed)
             return self._with_failover(
                 lambda: self._merge_params(self._fanout({
                     i: self._encode_serial_push(tv.PUSH_PULL, sub,
-                                                pseq=pseq, tc=tc)
+                                                pseq=pseq, tc=tc,
+                                                members=members)
                     for i, sub in self._split_kv(kv).items()
                 })))
 
     # -- bucketed, pipelined transport (worker half) --------------------------
 
     def _encode_serial_push(self, kind: int, sub: Dict[str, np.ndarray],
-                            pseq: Optional[int] = None, tc=None):
+                            pseq: Optional[int] = None, tc=None,
+                            members: Optional[dict] = None):
         """One serial push frame, compressed per the policy (the packed-key
         list rides the frame's extra, as on the bucketed path) and tagged
         with the (nonce, seq) dedup token plus the op's trace context
-        (``tc``, when sampled). With ``writev`` on, the frame travels as
-        zero-copy parts — the grad tensors go to the kernel as iovecs
-        instead of through a staging bytearray (the measurable
-        serial-path win at BERT-size trees)."""
+        (``tc``, when sampled). ``members`` is the aggregator's
+        constituent-token map for a merged push (None otherwise). With
+        ``writev`` on, the frame travels as zero-copy parts — the grad
+        tensors go to the kernel as iovecs instead of through a staging
+        bytearray (the measurable serial-path win at BERT-size trees)."""
         sub, enc = self._encode_push_tree(sub)
         extra = {}
         if enc:
@@ -1853,6 +2081,8 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         if pseq is not None:
             extra["pseq"] = pseq
             extra["pnonce"] = self._transport_nonce
+        if members:
+            extra["members"] = members
         if tc is not None:
             extra[obs.WIRE_KEY] = tc
         extra = extra or None
@@ -1869,12 +2099,15 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
             )
 
     def _push_buckets_sync(self, by_owner: Dict[int, Dict[str, np.ndarray]],
-                           pseq: Optional[int] = None, tc=None) -> None:
+                           pseq: Optional[int] = None, tc=None,
+                           members: Optional[dict] = None) -> None:
         """Slice each owner's subtree into fusion buckets, stripe them over
         the connection pool, wait for every ack, and adopt the committed
         versions. The engine sees ONE whole-tree apply per server, exactly
         like a serial PUSH; ``pseq`` is the logical push's dedup token
-        (same on every bucket — the completing bucket's apply checks it)."""
+        (same on every bucket — the completing bucket's apply checks it),
+        ``members`` the aggregator's constituent-token map when the push
+        is a merged one."""
         self._push_epoch += 1
         epoch = self._push_epoch
         futs: List[Tuple[int, Any]] = []
@@ -1899,11 +2132,14 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                          "pseq": pseq,
                          "pnonce": self._transport_nonce,
                          "enc": enc}
+                if members:
+                    extra["members"] = members
                 if tc is not None:
                     extra[obs.WIRE_KEY] = tc
                 payload = enc_bucket(tv.BUCKET_PUSH, self.worker, sub, b,
                                      extra=extra)
-                futs.append((i, pumps[b % len(pumps)].submit(payload)))
+                futs.append((i, pumps[b % len(pumps)].submit(
+                    payload, priority=self._bucket_submit_priority(b))))
         for i, fut in futs:
             reply = self._bucket_reply(i, fut)
             kind, _, _, extra = tv.decode(reply)
@@ -1960,7 +2196,8 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
             for b in range(1, n):
                 payload = tv.encode(tv.BUCKET_PULL, self.worker, None,
                                     extra=_extra(b))
-                rest.append((i, pumps[b % len(pumps)].submit(payload)))
+                rest.append((i, pumps[b % len(pumps)].submit(
+                    payload, priority=self._bucket_submit_priority(b))))
         for i, fut in rest:
             reply = self._bucket_reply(i, fut)
             kind, _, tensors, extra = tv.decode(reply)
@@ -2133,9 +2370,14 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
             ch.close()  # dead or stale; no SHUTDOWN owed
         if self._pool is not None:
             self._pool.shutdown(wait=False)
+        # a plain re-dial of an AGGREGATED worker re-dials the aggregator
+        # (with the remembered flat fallback intact); explicit addresses
+        # always mean the flat topology — a restarted fleet
+        fb = self._agg_fallback if addrs is None else None
         try:
             self._init_multi(
-                list(addrs) if addrs is not None else self._addrs,
+                list(addrs) if addrs is not None
+                else (fb["addrs"] if fb is not None else self._addrs),
                 self.worker, keymod.unflatten(
                     self._treedef, self._kv_like, self._key_order),
                 bucket_bytes=self.bucket_bytes, pool_size=self.pool_size,
@@ -2144,11 +2386,14 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                 # explicit new addresses invalidate the old replica sets
                 # (restarted servers come back elsewhere); a plain re-dial
                 # keeps them
-                replica_sets=None if addrs is not None
-                else self._replica_sets,
+                replica_sets=(None if addrs is not None
+                              else fb["replica_sets"] if fb is not None
+                              else self._replica_sets),
                 failover_timeout=self.failover_timeout,
                 coordinator=self._coord,
-                table=None if addrs is not None else self._table)
+                table=(None if addrs is not None
+                       else fb["table"] if fb is not None else self._table),
+                aggregator=None if addrs is not None else self._agg_uri)
         finally:
             # restores the compressor too: topk error-feedback residuals
             # are unsent gradient mass and must survive the re-dial

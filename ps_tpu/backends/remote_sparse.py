@@ -1159,7 +1159,8 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                     extra[obs.WIRE_KEY] = tc
                 payload = enc_bucket(tv.ROW_BUCKET_PUSH, self.worker, t, b,
                                      extra=extra)
-                futs.append((i, pumps[b % len(pumps)].submit(payload)))
+                futs.append((i, pumps[b % len(pumps)].submit(
+                    payload, priority=self._bucket_submit_priority(b))))
         for i, fut in futs:
             reply = self._bucket_reply(i, fut)
             try:

@@ -133,6 +133,14 @@ class AsyncTpuServer(PeekMixin, AsyncStagingMixin, CheckpointMixin):
         return list(self._params)
 
     def _check_worker(self, worker: int) -> None:
+        from ps_tpu.backends.common import AGG_WORKER_BASE
+
+        # ids at/past AGG_WORKER_BASE are aggregator identities (a host
+        # group's merged pushes — backends/aggregator.py): legal pushers
+        # with their own staleness/dedup slots, deliberately outside the
+        # data-sharding denominator num_workers counts
+        if worker >= AGG_WORKER_BASE:
+            return
         if not (0 <= worker < self.num_workers):
             raise ValueError(f"worker {worker} out of range [0, {self.num_workers})")
 

@@ -230,6 +230,11 @@ class VanService:
                        if writev is None else bool(writev))
         self._shm_accept = (env_flag("PS_SHM", True)
                             if shm is None else bool(shm))
+        # priority bucket scheduling, server half: bucket replies carry
+        # their bucket index into the native loop's priority writev drain
+        # (front-of-model bytes flush before tail layers' when several
+        # conns back up). Off = every reply at priority 0 = FIFO drain.
+        self._bucket_priority = env_flag("PS_BUCKET_PRIORITY", True)
         self._listener = tv.Listener(port=port, bind=bind)
         self._stop = threading.Event()
         self._chan_lock = threading.Lock()
@@ -922,6 +927,13 @@ class VanService:
     #: outbound migration runs for the whole move) — always punted.
     _PUNT_KINDS = frozenset({tv.CHECKPOINT, tv.MIGRATE_OUT,
                              tv.COORD_REBALANCE})
+    #: subclass hook: kinds whose handlers can PARK waiting for ANOTHER
+    #: member's future request of this same service (the aggregator's
+    #: group barrier: a push waits for its host group's other pushes) —
+    #: always punted to a FRESH thread, never the pool: at fan-in >
+    #: pool-size, the round-completing push queued behind parked pool
+    #: workers would deadlock the barrier it is supposed to release.
+    _BARRIER_KINDS: frozenset = frozenset()
 
     def _loop_pump(self) -> None:
         """The ONE Python thread of the native-loop serve path: drain
@@ -1027,7 +1039,8 @@ class VanService:
         if kind == tv.SHM_SETUP:
             self._loop_shm_upgrade(cid, worker, extra, ptr)
             return
-        if kind in self._PUNT_KINDS or (
+        barrier = kind in self._BARRIER_KINDS
+        if kind in self._PUNT_KINDS or barrier or (
                 kind in self._COMMIT_KINDS
                 and (getattr(self, "_paused", False)
                      or self._loop_blockers > 0
@@ -1049,7 +1062,7 @@ class VanService:
                 if blocker:
                     self._loop_blockers += 1
             try:
-                if blocker or getattr(self, "_paused", False) \
+                if blocker or barrier or getattr(self, "_paused", False) \
                         or self._loop_blockers > 0:
                     # fresh threads whenever parking is on the table:
                     # blockers (a resume must never queue behind pool
@@ -1111,10 +1124,27 @@ class VanService:
             return tv.encode(tv.ERR, worker, None,
                              extra={"error": repr(e)})
 
+    def _reply_priority(self, kind: int, extra) -> int:
+        """Native-loop writev priority of this request's reply: bucket
+        frames drain front-of-model first (their bucket index), every
+        other kind at 0 — PS_BUCKET_PRIORITY=0 restores the pure FIFO
+        drain. Priorities only reorder tails across CONNECTIONS awaiting
+        EPOLLOUT; per-connection reply order is untouched, so the framed
+        request/reply contract cannot tear."""
+        if not self._bucket_priority:
+            return 0
+        if kind in (tv.BUCKET_PULL, tv.BUCKET_PUSH, tv.ROW_BUCKET_PUSH):
+            try:
+                return int((extra or {}).get("bucket") or 0)
+            except (TypeError, ValueError):
+                return 0
+        return 0
+
     def _loop_dispatch_reply(self, cid: int, kind: int, worker: int,
                              tensors, extra, ptr: int,
                              punted: bool, blocker: bool = False) -> None:
         nloop = self._nloop
+        prio = self._reply_priority(kind, extra)
         # mark this thread as serving a LOOP request for the dispatch's
         # duration, so a pause park inside the handler is counted toward
         # the native drain's claimed-body discount (reset in the finally:
@@ -1125,7 +1155,7 @@ class VanService:
             reply = self._dispatch_reply_payload(kind, worker, tensors,
                                                  extra)
             try:
-                nloop.reply(cid, reply)  # False = worker vanished
+                nloop.reply(cid, reply, priority=prio)  # False = gone
             finally:
                 # ONLY now is the request frame provably dead (the reply
                 # may alias zero-copy views of it)

@@ -27,6 +27,17 @@ Environment variables honored by :meth:`Config.from_env`:
 - ``PS_BUCKET_BYTES``       — bucketed van transport: fusion-bucket size in
   bytes (0/unset = serial one-frame-per-cycle transport)
 - ``PS_TRANSPORT_POOL``     — connections per server for bucket striping
+- ``PS_BUCKET_PRIORITY``    — '0' disables priority bucket scheduling
+  (ByteScheduler-style: bucket flushes drain front-of-model first when a
+  backlog forms, instead of FIFO) — default on; the drain order is
+  deterministic either way and never changes the math
+- ``PS_AGG_GROUP_SIZE``     — hierarchical two-level aggregation: how many
+  same-host workers share one aggregator (the local fan-in cross-host
+  bytes shrink by); 1 (default) = no aggregation, flat worker→shard
+- ``PS_AGG_FLUSH_TIMEOUT_MS`` — aggregator side: how long an incomplete
+  round waits for its remaining group members before flushing the
+  partial merge upstream (default 2000 — a dead member degrades its
+  group's latency, never wedges it)
 - ``PS_COMPRESS``           — gradient codec for the van wire: 'none'
   (default), 'cast16', 'int8', or 'topk' (ps_tpu/compress)
 - ``PS_COMPRESS_TOPK``      — kept fraction for the topk codec (default 0.01)
@@ -226,6 +237,23 @@ class Config:
       bucket_bytes / transport_pool: bucketed van transport — fusion-bucket
         size (None = serial one-frame-per-cycle) and striped connections
         per server.
+      bucket_priority: priority bucket scheduling (README "Two-tier
+        aggregation & priority scheduling"): bucket flushes carry their
+        bucket index as a priority — front-of-model buckets drain a
+        backlog first (reverse of backprop completion order), so the
+        tail layers' grads stop serializing in front of the bytes the
+        next step's forward needs. Deterministic tie-break (enqueue
+        order), numerics identical to FIFO by construction; off restores
+        the pure FIFO drain for A/B comparison.
+      agg_group_size: hierarchical two-level aggregation — how many
+        same-host workers share one :class:`~ps_tpu.backends.aggregator.
+        AggregatorService` (the local fan-in cross-host bytes/step shrink
+        by). 1 (default) keeps the flat worker→shard topology; launchers
+        start one aggregator per host when > 1.
+      agg_flush_timeout_ms: aggregator side — how long an incomplete
+        round waits for its remaining group members before the partial
+        merge flushes upstream (a dead member costs its group latency
+        once per round, never a wedge).
       compress: gradient codec for the van wire ('cast16', 'int8', 'topk';
         None/'none' = raw float32). See ps_tpu/compress and the README's
         "Gradient compression" section.
@@ -368,6 +396,16 @@ class Config:
     # compute/comm overlap (push_pull_async / push_async + flush)
     bucket_bytes: Optional[int] = None
     transport_pool: int = 2
+    # priority bucket scheduling (ByteScheduler-style, README "Two-tier
+    # aggregation & priority scheduling"): pending bucket flushes drain
+    # front-of-model first instead of FIFO; deterministic, math-neutral
+    bucket_priority: bool = True
+    # hierarchical two-level aggregation (ps_tpu/backends/aggregator):
+    # same-host workers pre-reduce through one per-host aggregator and
+    # cross the host boundary once per group round (1 = flat topology),
+    # with a bounded wait for stragglers before a partial flush
+    agg_group_size: int = 1
+    agg_flush_timeout_ms: float = 2000.0
     # gradient compression on the van wire (ps_tpu/compress): codec name
     # (None/'none' = raw float32), topk kept-fraction, the size floor under
     # which tensors always travel raw, and whether bucketed pulls compress
@@ -502,6 +540,11 @@ class Config:
                              "serial transport)")
         if self.transport_pool < 1:
             raise ValueError("transport_pool must be >= 1")
+        if self.agg_group_size < 1:
+            raise ValueError("agg_group_size must be >= 1 (1 = no "
+                             "aggregation, flat worker→shard)")
+        if self.agg_flush_timeout_ms < 1:
+            raise ValueError("agg_flush_timeout_ms must be >= 1")
         if self.compress not in (None, "none", "cast16", "int8", "topk"):
             raise ValueError(
                 f"unknown compress codec {self.compress!r}; use 'none', "
@@ -643,6 +686,15 @@ class Config:
             kwargs["bucket_bytes"] = bb if bb > 0 else None
         if "PS_TRANSPORT_POOL" in env:
             kwargs["transport_pool"] = int(env["PS_TRANSPORT_POOL"])
+        if "PS_BUCKET_PRIORITY" in env:
+            kwargs["bucket_priority"] = env_flag("PS_BUCKET_PRIORITY", True)
+        if "PS_AGG_GROUP_SIZE" in env:
+            kwargs["agg_group_size"] = int(env["PS_AGG_GROUP_SIZE"])
+        if "PS_AGG_FLUSH_TIMEOUT_MS" in env:
+            # float, matching the service-level env_float read — the two
+            # parsers of one knob must accept the same values
+            kwargs["agg_flush_timeout_ms"] = float(
+                env["PS_AGG_FLUSH_TIMEOUT_MS"])
         if "PS_COMPRESS" in env:
             # "" / "none" explicitly selects the raw wire
             kwargs["compress"] = env["PS_COMPRESS"] or None

@@ -64,6 +64,7 @@ def _lib():
     lib.nl_reply_vec.argtypes = [
         ctypes.c_void_p, ctypes.c_uint64, ctypes.POINTER(ctypes.c_void_p),
         ctypes.POINTER(ctypes.c_uint64), ctypes.c_int, ctypes.c_int,
+        ctypes.c_int,
     ]
     lib.nl_body_free.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
     lib.nl_detach.restype = ctypes.c_int
@@ -156,12 +157,15 @@ class NativeEventLoop:
                 out.append((int(self._ids[i]), view, int(ptr)))
         return out
 
-    def reply(self, conn_id: int, payload, close_after: bool = False
-              ) -> bool:
+    def reply(self, conn_id: int, payload, close_after: bool = False,
+              priority: int = 0) -> bool:
         """Send one reply frame — a contiguous bytes/bytearray or the
         zero-copy ``(header, chunks)`` parts form. The buffers are used
         only for the duration of the call (an unsent tail is copied
-        native-side). False = the connection is gone."""
+        native-side). ``priority`` tags any staged tail for the loop's
+        priority writev drain (lower flushes first; bucket replies pass
+        their bucket index so front-of-model bytes leave before the tail
+        layers'). False = the connection is gone."""
         if isinstance(payload, tuple):
             header, chunks = payload
             views = [np.frombuffer(header, np.uint8)]
@@ -175,7 +179,8 @@ class NativeEventLoop:
             return False
         try:
             ok = self._lib.nl_reply_vec(self._h, conn_id, ptrs, lens, n,
-                                        1 if close_after else 0)
+                                        1 if close_after else 0,
+                                        int(priority))
         finally:
             self._unpin()
         del views  # pinned the sources for exactly the call's duration

@@ -119,6 +119,13 @@ class Coordinator(VanService):
         self._tlock = threading.Lock()
         self._table = ShardTable(0, [], {})
         self._members: List[_Member] = []   # index == shard index
+        # hierarchical aggregation (backends/aggregator.py): one
+        # aggregator URI per HOST — the coordinator-assigned grouping.
+        # Same-host workers resolve their host's entry from the table
+        # reply and dial it instead of the shards; hosts with no entry
+        # stay flat. Strictly off the shard table: aggregators own no
+        # keys and never participate in rebalances.
+        self._aggregators: Dict[str, str] = {}
         self._next_node = 1
         self._rebalancing: Optional[dict] = None  # live move progress
         self._draining = False
@@ -208,10 +215,14 @@ class Coordinator(VanService):
         elif kind == tv.COORD_TABLE:
             if (extra or {}).get("lean"):
                 # table only — the hot worker-poll shape (join, re-route)
+                # — plus the per-host aggregator map (the grouping rides
+                # the same poll the join already makes)
                 with self._tlock:
                     wire = self._table.to_wire()
+                    aggs = dict(self._aggregators)
                 return tv.encode(tv.OK, worker, None,
-                                 extra={"table": wire})
+                                 extra={"table": wire,
+                                        "aggregators": aggs})
             return tv.encode(tv.OK, worker, None, extra=self._table_reply())
         elif kind == tv.COORD_REPORT:
             return self._report(worker, extra)
@@ -260,6 +271,21 @@ class Coordinator(VanService):
 
     def _hello(self, worker: int, extra: dict) -> bytes:
         role = str(extra.get("role", "worker"))
+        if role == "aggregator":
+            # a host group's aggregator joins the membership view: the
+            # LAST registration per host wins (a restarted aggregator
+            # comes back on a new port and simply replaces its entry)
+            host = str(extra.get("host") or "")
+            uri = str(extra.get("uri") or "")
+            if not host or not uri:
+                return tv.encode(tv.ERR, worker, None, extra={
+                    "error": "aggregator registration needs host and uri"})
+            with self._tlock:
+                self._aggregators[host] = uri
+            obs.record_event("coord_aggregator", host=host, uri=uri)
+            logging.getLogger(__name__).info(
+                "aggregator for host %s registered at %s", host, uri)
+            return tv.encode(tv.OK, worker, None, extra=self._table_reply())
         if role != "server":
             # workers just fetch the table; no registration needed
             return tv.encode(tv.OK, worker, None, extra=self._table_reply())
@@ -421,12 +447,14 @@ class Coordinator(VanService):
         with self._tlock:
             mig = dict(self._rebalancing) if self._rebalancing else None
             table = self._table
+            aggs = dict(self._aggregators)
         # members render OUTSIDE _tlock: _members_view re-acquires it
         # (and polls the heartbeat monitor — no reason to do that under
         # the table lock anyway)
         return {"table": table.to_wire(),
                 "members": self._members_view(),
                 "migration": mig,
+                "aggregators": aggs,
                 "hints": self.hints()}
 
     # -- fleet telemetry -------------------------------------------------------

@@ -36,8 +36,8 @@ from ps_tpu.control import tensor_van as tv
 from ps_tpu.elastic.table import ShardTable
 
 __all__ = ["CoordinatorMember", "TelemetryReporter", "fetch_table",
-           "fetch_view", "fetch_telemetry", "request_rebalance",
-           "parse_coord"]
+           "fetch_view", "fetch_telemetry", "fetch_aggregators",
+           "request_rebalance", "parse_coord"]
 
 
 def parse_coord(addr: Union[str, Tuple[str, int]]) -> Tuple[str, int]:
@@ -70,12 +70,27 @@ def fetch_view(addr, timeout_ms: int = 5000) -> dict:
     return _coord_request(addr, tv.COORD_TABLE, timeout_ms=timeout_ms)
 
 
+def fetch_aggregators(addr, timeout_ms: int = 5000) -> dict:
+    """The coordinator-assigned aggregation grouping: ``{host: uri}`` of
+    every registered per-host aggregator (README "Two-tier aggregation").
+    A worker looks up its own hostname — a hit means its host group
+    pre-reduces through that aggregator; a miss means flat routing.
+    Rides the same lean COORD_TABLE poll joins already make."""
+    extra = _coord_request(addr, tv.COORD_TABLE, extra={"lean": True},
+                           timeout_ms=timeout_ms)
+    return dict(extra.get("aggregators") or {})
+
+
 def fetch_table(addr, cover=None, min_epoch: Optional[int] = None,
-                timeout: float = 30.0) -> ShardTable:
+                timeout: float = 30.0,
+                view_out: Optional[dict] = None) -> ShardTable:
     """Fetch the current shard table, polling until it covers ``cover``
     (a key iterable — joining workers wait for every server to register)
     and/or its epoch exceeds ``min_epoch`` (re-routing workers wait for
-    the move they were refused over to actually commit)."""
+    the move they were refused over to actually commit). ``view_out``
+    (when a dict) receives the final lean reply's other fields — e.g.
+    the per-host ``aggregators`` map — so callers that need them don't
+    pay a second COORD_TABLE round trip."""
     deadline = time.monotonic() + timeout
     want = set(cover) if cover is not None else None
     last = None
@@ -86,6 +101,9 @@ def fetch_table(addr, cover=None, min_epoch: Optional[int] = None,
         # not this one's
         extra = {"lean": True}
         view = _coord_request(addr, tv.COORD_TABLE, extra=extra)
+        if view_out is not None:
+            view_out.clear()
+            view_out.update(view)
         table = ShardTable.from_wire(view["table"])
         ok = want is None or table.covers(want)
         if ok and (min_epoch is None or table.epoch > min_epoch):

@@ -35,6 +35,7 @@
 #include <sys/uio.h>
 #include <unistd.h>
 
+#include <algorithm>
 #include <atomic>
 #include <chrono>
 #include <condition_variable>
@@ -650,6 +651,10 @@ struct NlConn {
   // serializes accepts/destroys/other replies behind the global table
   bool want_write = false;   // EPOLLOUT armed
   bool close_after = false;  // goodbye: destroy once the tail drains
+  int prio = 0;  // drain priority of the staged tail (guarded by wmu):
+  // lowest flushes first when several conns await EPOLLOUT service in
+  // one epoll batch — bucket replies carry their bucket index, so the
+  // front-of-model bytes a worker's next step needs leave first
 };
 
 struct NlReq {
@@ -852,6 +857,7 @@ void nl_accept(NlLoop* l, NlThread& t0) {
 void nl_thread_run(NlLoop* l, int ti) {
   NlThread& t = l->threads[ti];
   epoll_event evs[64];
+  std::vector<std::pair<int, NlConn*>> writable;  // (prio, conn) per batch
   while (!l->stop.load(std::memory_order_relaxed)) {
     int n = epoll_wait(t.epfd, evs, 64, 100);
     l->iters.fetch_add(1, std::memory_order_relaxed);
@@ -887,8 +893,34 @@ void nl_thread_run(NlLoop* l, int ti) {
       }
       if (evs[i].events & EPOLLIN) nl_read(l, t, c);
       if (!c->dead && (evs[i].events & EPOLLOUT)) {
+        // defer the tail flush: writable conns in THIS batch drain in
+        // priority order below, not epoll arrival order — the
+        // ByteScheduler-style writev scheduler (a conn may appear once
+        // per batch; epoll never duplicates an fd within one wait)
+        writable.emplace_back(0, c);
+      }
+    }
+    if (!writable.empty()) {
+      // snapshot each conn's priority ONCE under its write mutex (never
+      // inside the comparator — a sort must not take locks per compare),
+      // then drain lowest-priority-number first; conn id breaks ties so
+      // the order is reproducible across batches
+      for (auto& w : writable) {
+        std::lock_guard<std::mutex> lw(w.second->wmu);
+        w.first = w.second->prio;
+      }
+      std::sort(writable.begin(), writable.end(),
+                [](const std::pair<int, NlConn*>& a,
+                   const std::pair<int, NlConn*>& b) {
+                  return a.first != b.first ? a.first < b.first
+                                            : a.second->id < b.second->id;
+                });
+      for (auto& w : writable) {
+        NlConn* c = w.second;
+        if (c->dead) continue;
         if (!nl_flush(t, c)) nl_destroy(l, t, c);
       }
+      writable.clear();
     }
     for (auto* g : t.graveyard) delete g;
     t.graveyard.clear();
@@ -983,10 +1015,14 @@ int nl_poll(void* h, uint64_t* conn_ids, void** bodies, uint64_t* lens,
 // would not take NOW is copied to the connection's tail buffer and flushed
 // by the owner loop thread on EPOLLOUT (the caller's buffers are NEVER
 // referenced after this returns). `close_after` severs the connection once
-// the reply is fully on the wire (SHUTDOWN goodbyes). Returns 1, or 0 when
-// the connection is already gone (the worker vanished mid-reply).
+// the reply is fully on the wire (SHUTDOWN goodbyes). `prio` tags any
+// staged tail for the priority writev drain: when several conns await
+// EPOLLOUT service in one epoll batch, lower-priority-number tails flush
+// first (bucket replies pass their bucket index — front-of-model bytes
+// leave before tail-layer bytes). Returns 1, or 0 when the connection is
+// already gone (the worker vanished mid-reply).
 int nl_reply_vec(void* h, uint64_t conn_id, const void** bufs,
-                 const uint64_t* lens, int n, int close_after) {
+                 const uint64_t* lens, int n, int close_after, int prio) {
   auto* l = static_cast<NlLoop*>(h);
   NlConn* c;
   {
@@ -1002,6 +1038,12 @@ int nl_reply_vec(void* h, uint64_t conn_id, const void** bufs,
   }
   std::unique_lock<std::mutex> wlock(c->wmu);
   if (c->outstanding) --c->outstanding;
+  // a staged tail drains as one FIFO string: its priority is its most
+  // urgent frame's (min), never simply the LAST reply's — a tiny
+  // low-urgency ack appended behind a front-of-model tail must not
+  // demote it (or promote a tail-layer payload it rides behind). A
+  // fresh (empty-tail) reply starts the conn's priority over.
+  c->prio = c->wbuf.empty() ? prio : std::min(c->prio, prio);
   uint64_t total = 0;
   for (int i = 0; i < n; ++i) total += lens[i];
   uint64_t len_le = total;

@@ -45,6 +45,12 @@ PHASES: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
     ("wire_round", ("ps_bucket_seconds",)),
     ("server_apply", ("ps_server_apply_seconds",)),
     ("ack_wait", ("ps_replica_ack_wait_seconds",)),
+    # two-tier aggregation (backends/aggregator.py): how long member
+    # pushes sat at their host aggregator before the merged upstream
+    # flush committed. Reported as its own row (share of the step total)
+    # but NOT folded into the derived client/wire math — the worker's
+    # wire round already contains it, like server_apply.
+    ("agg_hold", ("ps_agg_hold_seconds",)),
 )
 
 

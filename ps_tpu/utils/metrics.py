@@ -87,6 +87,13 @@ class TransportStats:
         # batches under fan-in are the GIL amortization working)
         ("upcall_batch", "ps_van_upcall_batch",
          "requests handed to Python per native-loop upcall"),
+        # hierarchical aggregation (backends/aggregator.py): how long a
+        # member's push waits at its host aggregator before the merged
+        # upstream flush commits — the two-tier hop's latency price,
+        # surfaced as its own per-step breakdown phase
+        ("agg_hold_s", "ps_agg_hold_seconds",
+         "member pushes held at the aggregator until the merged "
+         "upstream flush commits"),
     )
 
     def __init__(self, window: int = 256):
@@ -157,6 +164,13 @@ class TransportStats:
         # because the remedy (and the health signal) differ: a re-route
         # is a planned rebalance doing its job, a failover is a death.
         self.table_reroutes = 0
+        # hierarchical aggregation (backends/aggregator.py): merged
+        # upstream flushes, constituent pushes merged into them (their
+        # ratio is the realized local fan-in), and worker-side
+        # aggregator-loss degrades to the flat topology
+        self.agg_rounds = 0
+        self.agg_members = 0
+        self.agg_degrades = 0
         # native event-loop serve path (ps_tpu/control/native_loop.py):
         # cumulative epoll iterations and frames read by the loop threads
         # (absolute values synced from the native counters on each pump
@@ -268,6 +282,25 @@ class TransportStats:
             self.failovers += 1
             self.failover_s += float(seconds)
 
+    def record_agg_round(self, members: int) -> None:
+        """One merged upstream flush at an aggregator (``members``
+        constituent pushes pre-reduced into it — the local fan-in that
+        cross-host bytes shrink by)."""
+        with self._lock:
+            self.agg_rounds += 1
+            self.agg_members += int(members)
+
+    def record_agg_hold(self, seconds: float) -> None:
+        """Time one member's push was held at the aggregator — from its
+        arrival to the merged upstream commit (the two-tier hop's price,
+        a per-step breakdown phase: ps_agg_hold_seconds)."""
+        self.hist["agg_hold_s"].record(seconds)
+
+    def record_agg_degrade(self) -> None:
+        """One worker-side aggregator loss → flat-topology degrade."""
+        with self._lock:
+            self.agg_degrades += 1
+
     def lane(self) -> str:
         """Which data-plane lane this endpoint's traffic used: "shm"
         (rings only), "shm+tcp" (a negotiated shm lane whose oversize
@@ -351,7 +384,8 @@ class TransportStats:
                     self.repl_entries, self.repl_bytes,
                     self.repl_ack_wait_s, self.dedup_hits,
                     self.failovers, self.failover_s,
-                    self.table_reroutes)
+                    self.table_reroutes,
+                    self.agg_rounds, self.agg_members, self.agg_degrades)
 
     def summary(self, since: Optional[tuple] = None) -> Dict[str, float]:
         now = self.snapshot()
@@ -412,6 +446,13 @@ class TransportStats:
             out["failover_s"] = round(d[25], 4)
         if d[26] > 0:
             out["table_reroutes"] = int(d[26])
+        if d[27] > 0:
+            # two-tier aggregation: rounds, and the realized local fan-in
+            # (constituents per merged flush) cross-host bytes shrink by
+            out["agg_rounds"] = int(d[27])
+            out["agg_fan_in"] = round(d[28] / d[27], 3)
+        if d[29] > 0:
+            out["agg_degrades"] = int(d[29])
         # latency DISTRIBUTIONS (ps_tpu/obs): quantiles of everything the
         # histograms saw — lifetime, not interval (a p99 over an interval
         # delta of log buckets is computable but the lifetime tail is

@@ -64,6 +64,37 @@ assert det["telemetry_overhead_pct"] < 20.0, \
     f"telemetry overhead way over budget: {det['telemetry_overhead_pct']}%"
 print(f"  telemetry overhead: {det['telemetry_overhead_pct']}% "
       f"({det['telemetry_off_gbps']} -> {det['telemetry_on_gbps']} GB/s)")
+# two-tier aggregation drill (2-host-emulated, process-grouped): fan_in
+# workers pre-reduce through one aggregator over an emulated shared
+# uplink. The headline claim is MEASURED: cross-host bytes/step must be
+# the flat group's bytes divided by the fan-in (+ per-bucket header
+# overhead), and the ByteScheduler-side effects must point the right
+# way — overlap efficiency up, flush-wait share down — vs the flat
+# group under the identical uplink.
+ag = det["agg"]
+F = ag["fan_in"]
+assert F >= 2, f"aggregation drill ran with fan_in {F} < 2"
+header_allowance = 256 * 1024  # json meta per bucket + members tokens
+assert ag["cross_host_bytes_per_step"] <= \
+    ag["flat_bytes_per_step"] / F + header_allowance, \
+    (f"cross-host bytes/step {ag['cross_host_bytes_per_step']} not cut "
+     f"by the fan-in (flat {ag['flat_bytes_per_step']} / F={F})")
+assert ag["reduction_ratio"] and ag["reduction_ratio"] > 1.8, \
+    f"cross-host byte reduction {ag['reduction_ratio']}x < 1.8x"
+assert ag["realized_fan_in"] == F, \
+    f"rounds merged {ag['realized_fan_in']} members, expected {F}"
+assert ag["overlap_efficiency"] > ag["flat_overlap_efficiency"], \
+    (f"overlap efficiency did not improve: agg "
+     f"{ag['overlap_efficiency']} vs flat {ag['flat_overlap_efficiency']}")
+assert ag["flush_wait_share"] < ag["flat_flush_wait_share"], \
+    (f"flush-wait share did not shrink: agg {ag['flush_wait_share']} vs "
+     f"flat {ag['flat_flush_wait_share']}")
+print(f"  agg drill: bytes/step {ag['flat_bytes_per_step']} -> "
+      f"{ag['cross_host_bytes_per_step']} ({ag['reduction_ratio']}x, "
+      f"fan-in {F}); overlap {ag['flat_overlap_efficiency']} -> "
+      f"{ag['overlap_efficiency']}; flush-wait share "
+      f"{ag['flat_flush_wait_share']} -> {ag['flush_wait_share']}; "
+      f"wall {ag['flat_wall_s']}s -> {ag['wall_s']}s")
 print("transport smoke OK")
 EOF
 

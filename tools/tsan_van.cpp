@@ -53,7 +53,7 @@ void* nl_start(void* listener, int nthreads);
 int nl_poll(void* h, uint64_t* conn_ids, void** bodies, uint64_t* lens,
             int cap, int timeout_ms);
 int nl_reply_vec(void* h, uint64_t conn_id, const void** bufs,
-                 const uint64_t* lens, int n, int close_after);
+                 const uint64_t* lens, int n, int close_after, int prio);
 void nl_body_free(void* h, void* body);
 int nl_detach(void* h, uint64_t conn_id);
 void nl_stop_accept(void* h);
@@ -418,7 +418,9 @@ int main() {
           }
           const void* bufs[1] = {bodies[i]};  // reply ALIASES the request
           uint64_t ls[1] = {lens[i]};
-          nl_reply_vec(loop, ids[i], bufs, ls, 1, 0);
+          // alternate priorities so the driver exercises the priority
+          // writev drain's sort under TSan, not just the default path
+          nl_reply_vec(loop, ids[i], bufs, ls, 1, 0, (int)(i % 3));
           nl_body_free(loop, bodies[i]);
           served.fetch_add(1);
         }
