@@ -1035,6 +1035,231 @@ def bench_transport(args, retried: bool):
 # -- failover -----------------------------------------------------------------
 
 
+def bench_serve(args, retried: bool):
+    """The high-QPS read path (README "Read path"): N concurrent readers
+    against one shard, layered serving vs primary-only.
+
+    Two capacity measurements at each reader count, raw READ clients
+    (request/reply channels — reader-side Python kept minimal so the
+    SERVER path is what saturates):
+
+    - ``primary_only``: every reader hammers the primary's pump path
+      (native read cache disabled) — each read is a Python decode +
+      engine snapshot + encode on the one pump thread, the pre-read-path
+      serving cost;
+    - ``layered``: native read cache on, readers spread across the
+      primary + backup replica set — repeat reads are answered inside
+      the epoll loops with zero upcalls, invalidated by the background
+      pusher's applies and republished on the next miss.
+
+    A background pusher commits on a fixed cadence throughout BOTH modes
+    (version churn: the native-hit rate includes invalidation misses), a
+    ``RemoteAsyncWorker.read_all`` loop measures the end-to-end read p99
+    the serving caller feels, and a stale-replica drill pins the
+    bounded-staleness contract (a backup beyond the bound serves zero
+    reads — every one falls back to the primary). Headline:
+    ``read_scaling`` = layered aggregate QPS over primary-only at the
+    largest reader count (quiet-hardware target >= 5x), native-hit rate
+    flat-or-rising as readers grow, read p99 < 10 ms."""
+    import threading
+
+    import numpy as np
+
+    from ps_tpu.backends.remote_async import AsyncPSService, connect_async
+    from ps_tpu.control import tensor_van as tv
+
+    reader_counts = [2, 4] if args.quick else [2, 4, 8]
+    window_s = 2.0 if args.quick else 4.0
+    # tree sized so the primary-only baseline pays a real per-read encode
+    # while the layered path stays under the loopback bandwidth ceiling
+    # (~2 GB/s TCP on this class of host — a bigger tree caps BOTH modes
+    # on wire bytes and the serving contrast disappears)
+    nkeys, rows = (8, 16) if args.quick else (8, 24)
+
+    ps.init(backend="tpu", mode="async", num_workers=2, dc_lambda=0.0)
+    params = {
+        f"layer{i:02d}/w": jnp.asarray(
+            np.random.default_rng(i).normal(0, 0.02, (rows, 64))
+            .astype(np.float32))
+        for i in range(nkeys)
+    }
+    tree_mb = sum(v.nbytes for v in params.values()) / 1e6
+    grads = {k: jnp.full_like(v, 1e-3) for k, v in params.items()}
+
+    def make_service(backup=False, cache=True):
+        st = ps.KVStore(optimizer="sgd", learning_rate=0.01, mode="async")
+        st.init(params)
+        old = os.environ.get("PS_NATIVE_READ_CACHE_BYTES")
+        if not cache:
+            os.environ["PS_NATIVE_READ_CACHE_BYTES"] = "0"
+        try:
+            return AsyncPSService(st, bind="127.0.0.1", backup=backup,
+                                  native_loop=True)
+        finally:
+            if not cache:
+                if old is None:
+                    os.environ.pop("PS_NATIVE_READ_CACHE_BYTES", None)
+                else:
+                    os.environ["PS_NATIVE_READ_CACHE_BYTES"] = old
+
+    def run_readers(members, n, seconds):
+        """n raw READ clients round-robined over ``members``; returns
+        total reads completed (errors surface — a refused read is a
+        bench bug, not noise)."""
+        payload = bytes(tv.encode(tv.READ, 0, None))
+        counts = [0] * n
+        stop = threading.Event()
+        errs = []
+
+        def reader(j):
+            try:
+                host, port = members[j % len(members)]
+                ch = tv.Channel.connect(host, port)
+                try:
+                    while not stop.is_set():
+                        reply = ch.request(payload)
+                        # kind byte only: this leg measures SERVING
+                        # capacity, so the reader must not serialize on a
+                        # full Python decode per reply (send/recv release
+                        # the GIL; the decode path's correctness is pinned
+                        # by the read_all latency leg below and the parity
+                        # tests)
+                        assert reply[0] == tv.OK
+                        counts[j] += 1
+                finally:
+                    ch.close()
+            except BaseException as e:  # re-raised below: a dead reader
+                errs.append(e)          # must fail the leg, not deflate it
+
+        threads = [threading.Thread(target=reader, args=(j,), daemon=True)
+                   for j in range(n)]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        time.sleep(seconds)
+        stop.set()
+        for t in threads:
+            t.join(timeout=10)
+        if errs:
+            # surface, never report a QPS produced by fewer readers than
+            # requested (the CI gate would misdiagnose it as regression)
+            raise errs[0]
+        return sum(counts), max(time.time() - t0, 1e-9)
+
+    def pusher_loop(worker, stop, interval=0.1):
+        while not stop.is_set():
+            worker.push_all(grads)
+            stop.wait(interval)
+
+    detail = {"retried": retried, "tree_mb": round(tree_mb, 3),
+              "reader_counts": reader_counts,
+              "window_s": window_s}
+
+    # -- leg A: primary-only pump path (cache off, no replica reads) ----------
+    base = make_service(cache=False)
+    base_uri = f"127.0.0.1:{base.port}"
+    pusher = connect_async(base_uri, 0, params)
+    stop = threading.Event()
+    pt = threading.Thread(target=pusher_loop, args=(pusher, stop),
+                          daemon=True)
+    pt.start()
+    primary_qps = {}
+    for n in reader_counts:
+        total, dt = run_readers([("127.0.0.1", base.port)], n, window_s)
+        primary_qps[n] = round(total / dt, 1)
+    stop.set()
+    pt.join(timeout=10)
+    pusher.close()
+    base.stop()
+    detail["primary_only_qps"] = primary_qps
+
+    # -- leg B: layered — native cache + replica reads ------------------------
+    prim = make_service()
+    back = make_service(backup=True)
+    prim.attach_backup("127.0.0.1", back.port, ack="sync")
+    uri = f"127.0.0.1:{prim.port}|127.0.0.1:{back.port}"
+    pusher = connect_async(uri, 0, params)
+    stop = threading.Event()
+    pt = threading.Thread(target=pusher_loop, args=(pusher, stop),
+                          daemon=True)
+    pt.start()
+    members = [("127.0.0.1", prim.port), ("127.0.0.1", back.port)]
+    layered_qps, hit_rate = {}, {}
+
+    def cache_totals():
+        a = prim._nloop.cache_stats()
+        b = back._nloop.cache_stats()
+        return (a["hits"] + b["hits"], a["misses"] + b["misses"])
+
+    for n in reader_counts:
+        h0, m0 = cache_totals()
+        total, dt = run_readers(members, n, window_s)
+        h1, m1 = cache_totals()
+        layered_qps[n] = round(total / dt, 1)
+        dh, dm = h1 - h0, m1 - m0
+        hit_rate[n] = round(dh / max(dh + dm, 1), 4)
+    detail["layered_qps"] = layered_qps
+    detail["native_hit_rate"] = hit_rate
+    # the primary's full native-cache counter dump (entries/bytes are
+    # live gauges; rejects count puts refused at the invalidation floor
+    # — the invalidation-on-apply race doing its job under churn)
+    cs = prim._nloop.cache_stats()
+    detail["native_cache"] = {
+        "entries": cs["entries"], "bytes": cs["bytes"],
+        "puts": cs["puts"], "rejects": cs["rejects"],
+        "invalidations": cs["invalidations"], "floor": cs["floor"],
+    }
+    nmax = reader_counts[-1]
+    detail["read_scaling"] = round(
+        layered_qps[nmax] / max(primary_qps[nmax], 1e-9), 2)
+
+    # end-to-end read latency the serving caller feels (worker path:
+    # decode + staleness check + tree rebuild included)
+    rw = connect_async(uri, 1, params, read_staleness=2)
+    t_end = time.time() + (1.0 if args.quick else 2.0)
+    while time.time() < t_end:
+        rw.read_all()
+    lat = rw.transport.hist["read_s"].summary() or {}
+    detail["read_p99_ms"] = (round(lat["p99"] * 1e3, 3)
+                             if lat.get("p99") is not None else None)
+    detail["read_count"] = int(lat.get("count", 0))
+    detail["replica_read_share"] = round(
+        rw.transport.reads_replica / max(rw.transport.read_wire, 1), 4)
+    rw.close()
+    stop.set()
+    pt.join(timeout=10)
+    pusher.close()
+
+    # -- staleness drill: a replica beyond the bound serves NOTHING -----------
+    # the unattached backup froze at version 0; the primary is versions
+    # ahead. A bound-2 worker must route every read to the primary
+    # (fallbacks counted), never observe the stale replica's state.
+    stale = make_service(backup=True)  # never attached: version 0 forever
+    drill_uri = f"127.0.0.1:{prim.port}|127.0.0.1:{stale.port}"
+    dw = connect_async(drill_uri, 1, params, read_staleness=2)
+    for _ in range(10):
+        dw.read_all()
+    detail["staleness_drill"] = {
+        "fallbacks": dw.transport.read_fallbacks,
+        "replica_reads": dw.transport.reads_replica,
+        "violations": dw.transport.reads_replica,  # stale replica served
+    }
+    assert dw.transport.reads_replica == 0, \
+        "bounded-staleness contract violated: a stale replica served reads"
+    dw.close()
+    stale.stop()
+    prim.stop()
+    back.stop()
+    ps.shutdown()
+    print(json.dumps({
+        "metric": "serve_read_qps",
+        "value": layered_qps[nmax],
+        "unit": "reads/s",
+        "vs_baseline": None,
+        "detail": detail,
+    }))
+
+
 def bench_failover(args, retried: bool):
     """Shard replication & live failover (ps_tpu/replica): steady-state
     replication overhead and kill-to-first-successful-push latency.
@@ -1536,7 +1761,7 @@ def main(argv=None, retried: bool = False):
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="resnet",
                     choices=["resnet", "bert", "widedeep", "transport",
-                             "failover", "rebalance"])
+                             "failover", "rebalance", "serve"])
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--transport-mb", type=float, default=96.0,
                     help="(transport) parameter-tree size for the van "
@@ -1584,7 +1809,8 @@ def main(argv=None, retried: bool = False):
     if args.per_chip_batch is None:
         args.per_chip_batch = {"resnet": 256, "bert": 128,
                                "widedeep": 4096, "transport": 0,
-                               "failover": 0, "rebalance": 0}[args.model]
+                               "failover": 0, "rebalance": 0,
+                               "serve": 0}[args.model]
 
     if ps.is_initialized():  # retry path: reset the runtime
         ps.shutdown()
@@ -1595,7 +1821,8 @@ def main(argv=None, retried: bool = False):
      "widedeep": bench_widedeep,
      "transport": bench_transport,
      "failover": bench_failover,
-     "rebalance": bench_rebalance}[args.model](args, retried)
+     "rebalance": bench_rebalance,
+     "serve": bench_serve}[args.model](args, retried)
 
 
 def _is_transport_error(e: BaseException) -> bool:

@@ -189,7 +189,7 @@ class AggregatorService(VanService):
     #: the round's other members) — on the native loop they must never
     #: run inline on the pump, and never queue behind parked pool workers
     _BARRIER_KINDS = frozenset({tv.PUSH, tv.PUSH_PULL, tv.BUCKET_PUSH,
-                                tv.PULL, tv.BUCKET_PULL})
+                                tv.PULL, tv.BUCKET_PULL, tv.READ})
 
     def _register(self, coordinator, uri: str) -> None:
         """Join the membership table as this host's aggregator (the
@@ -314,6 +314,10 @@ class AggregatorService(VanService):
             ordinal = self._rounds_done
             r["state"] = "done"
             self._rcv.notify_all()
+        if r["error"] is None:
+            # invalidation-on-apply, aggregator edition: the group's
+            # committed round supersedes every cached member READ reply
+            self._invalidate_reads()
         logging.getLogger(__name__).debug(
             "aggregator group %d flushed round %d (%d member(s), "
             "%.1fms)%s", self.group, ordinal, len(r["tokens"]),
@@ -409,6 +413,23 @@ class AggregatorService(VanService):
                 self._pcv.notify_all()
                 return self._pull_snap
 
+    def _read_payload(self) -> bytes:
+        """Member READs (README "Read path") serve the group's coalesced
+        snapshot — one upstream fetch per round however many members
+        read — and publish into the native read cache: the generation is
+        captured BEFORE the fetch, so a merged round committing mid-read
+        refuses the stale publish at the floor."""
+        gen = self._read_gen_snapshot()
+        snap = self._coalesced_pull()
+        reply = tv.encode(tv.OK, 0, snap["kv"],
+                          extra={"version": snap["version"]})
+        self._note_read_snapshot(gen, int(snap["version"]))
+        self.transport.record_read_served()
+        return reply
+
+    def _read_version(self):
+        return self._client.version
+
     def _params_reply(self, worker: int, snap: dict) -> bytes:
         if self.writev:
             return tv.encode_parts(tv.OK, worker, snap["kv"],
@@ -440,6 +461,8 @@ class AggregatorService(VanService):
             })
         elif kind == tv.PULL:
             return self._params_reply(worker, self._coalesced_pull())
+        elif kind == tv.READ:
+            return self._read_payload()
         elif kind == tv.PUSH:
             tree = self._decode_member_push(tensors, extra)
             r = self._agg_push(worker, tree, extra)

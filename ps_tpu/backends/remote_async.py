@@ -299,6 +299,31 @@ class AsyncPSService(VanService):
                                    extra={"version": version})
         return tv.encode(tv.OK, worker, host, extra={"version": version})
 
+    def _read_payload(self) -> bytes:
+        """Serve one READ (README "Read path"): a side-effect-free,
+        version-stamped snapshot of this shard's whole subtree. Unlike
+        PULL there is NO event-log record, NO replication entry, and NO
+        per-worker DC stale snapshot — a read is an observation, not a
+        training-protocol step — which is exactly what makes the reply a
+        pure function of committed state: byte-identical requests get
+        byte-identical replies (fixed worker id 0, contiguous encode),
+        so the native loop can answer repeats from its read cache with
+        zero upcalls. The publish generation is captured UNDER the engine
+        lock with the snapshot; an apply racing the publish refuses it at
+        the native floor (invalidation-on-apply)."""
+        with self._engine._lock:
+            kv = {k: self._engine._params[k] for k in self._key_order}
+            version = self._engine.version
+            gen = self._read_gen_snapshot()
+        host = {k: np.asarray(v) for k, v in kv.items()}
+        reply = tv.encode(tv.OK, 0, host, extra={"version": version})
+        self._note_read_snapshot(gen, version)
+        self.transport.record_read_served()
+        return reply
+
+    def _read_version(self):
+        return self._engine.version
+
     def _apply_push(self, worker: int, grads: Dict[str, np.ndarray],
                     copy: bool = True,
                     extra: Optional[dict] = None) -> Tuple[Optional[int], bool]:
@@ -381,6 +406,10 @@ class AsyncPSService(VanService):
                 # keys' sub-update is still owed. Apply exactly those.
                 self.transport.record_dedup_hit()
                 self._engine.push_subtree(fresh, worker=worker)
+            # invalidation-on-apply (README "Read path"): cached READ
+            # replies now describe a superseded version — drop them and
+            # refuse any in-flight publish of the pre-apply snapshot
+            self._invalidate_reads()
             apply_s = time.perf_counter() - t_apply
             self._applied[worker] = self._applied.get(worker, 0) + 1
             if pseq is not None:
@@ -660,6 +689,8 @@ class AsyncPSService(VanService):
             })
         elif kind == tv.PULL:
             return self._params_payload(worker)
+        elif kind == tv.READ:
+            return self._read_payload()
         elif kind == tv.PUSH:
             rseq, dedup = self._apply_push(
                 worker, self._decode_push(tensors, extra), extra=extra)
@@ -944,6 +975,8 @@ class AsyncPSService(VanService):
                     "applied": applied, "keys": keys,
                 })
                 engine.evict_keys(keys)
+                self._invalidate_reads()  # the moved range left this shard:
+                # a cached whole-subtree reply would still include it
                 # only NOW does this shard refuse the moved range
                 # retryably: an aborted move must leave a static
                 # deployment's hard key-mismatch diagnosis untouched
@@ -1095,6 +1128,7 @@ class AsyncPSService(VanService):
                 w = int(w_str)
                 self._applied[w] = max(self._applied.get(w, 0), int(n))
             self.table_epoch = max(self.table_epoch, new_epoch)
+            self._invalidate_reads()  # the served subtree just grew
             # serving adopted keys means refusing their OLD routing
             # retryably from now on (and remembering the commit so a
             # re-asked MIGRATE_COMMIT acks instead of "aborting" it)
@@ -1125,6 +1159,7 @@ class AsyncPSService(VanService):
         with self._engine._lock:
             self._draining = True
             self._pause_cond.notify_all()  # paused pushes wake into refusal
+        self._invalidate_reads()  # no native hit may outlive the drain
 
     def stop(self, grace: float = 10.0) -> None:
         m = self._coord_member
@@ -1202,6 +1237,9 @@ class AsyncPSService(VanService):
             if sorted(tree) != sorted(self._key_order):
                 raise KeyError("replica push keys do not match the tree")
             self._engine.push_tree(tree, worker=worker)
+        # a backup serves replica READs: its cached replies go stale on
+        # every replicated apply exactly like a primary's on a commit
+        self._invalidate_reads()
         self._applied[worker] = self._applied.get(worker, 0) + 1
         if extra.get("pseq") is not None:
             toks = self._applied_pseq.setdefault(worker, {})
@@ -1258,7 +1296,9 @@ def connect_async(uri: Optional[str], worker: int, params_like,
                   shm_bytes: Optional[int] = None,
                   failover_timeout: Optional[float] = None,
                   coordinator=None,
-                  aggregator: Optional[str] = None) -> "RemoteAsyncWorker":
+                  aggregator: Optional[str] = None,
+                  read_staleness: Optional[int] = None,
+                  pull_cache: Optional[bool] = None) -> "RemoteAsyncWorker":
     """Join a cross-process async job as worker ``worker``.
 
     ``uri`` is ``host:port`` of the :func:`serve_async` process, or a
@@ -1345,7 +1385,8 @@ def connect_async(uri: Optional[str], worker: int, params_like,
             pool_size=pool_size, compress=compress, writev=writev,
             shm=shm, shm_bytes=shm_bytes, replica_sets=replica_sets,
             failover_timeout=failover_timeout, coordinator=coordinator,
-            table=table, aggregator=agg)
+            table=table, aggregator=agg, read_staleness=read_staleness,
+            pull_cache=pull_cache)
 
     if discovered:
         # the registry keeps a crashed aggregator's entry until a
@@ -1357,10 +1398,17 @@ def connect_async(uri: Optional[str], worker: int, params_like,
         # host; the except still covers an aggregator dying between the
         # probe and the real dial.
         ahost, aport = str(aggregator).rsplit(":", 1)
+        from ps_tpu.config import env_float
+
+        # validated service-level read (pslint PSL406): the probe's
+        # sleep budget — previously a hardcoded 0.2 s invisible to the
+        # operators who tune join-time failover
+        probe_wait = env_float("PS_AGG_PROBE_MAX_WAIT_MS", 200.0,
+                               lo=0.0) / 1e3
         try:
             probe = tv.Channel.connect(ahost, int(aport),
                                        timeout_ms=1000, retries=2,
-                                       max_wait_s=0.2)
+                                       max_wait_s=probe_wait)
             probe.close()
         except (tv.VanError, OSError) as e:
             logging.getLogger(__name__).warning(
@@ -1516,12 +1564,16 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                  shm: Optional[bool] = None,
                  shm_bytes: Optional[int] = None,
                  replica_sets=None,
-                 failover_timeout: Optional[float] = None):
+                 failover_timeout: Optional[float] = None,
+                 read_staleness: Optional[int] = None,
+                 pull_cache: Optional[bool] = None):
         self._init_multi([(host, int(port))], worker, params_like,
                          bucket_bytes=bucket_bytes, pool_size=pool_size,
                          compress=compress, writev=writev, shm=shm,
                          shm_bytes=shm_bytes, replica_sets=replica_sets,
-                         failover_timeout=failover_timeout)
+                         failover_timeout=failover_timeout,
+                         read_staleness=read_staleness,
+                         pull_cache=pull_cache)
 
     @classmethod
     def connect_many(cls, addrs: Sequence[Tuple[str, int]], worker: int,
@@ -1534,7 +1586,9 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                      failover_timeout: Optional[float] = None,
                      coordinator=None, table=None,
                      aggregator: Optional[str] = None,
-                     agg_role: bool = False
+                     agg_role: bool = False,
+                     read_staleness: Optional[int] = None,
+                     pull_cache: Optional[bool] = None
                      ) -> "RemoteAsyncWorker":
         self = cls.__new__(cls)
         self._init_multi(list(addrs), worker, params_like,
@@ -1543,7 +1597,9 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                          shm_bytes=shm_bytes, replica_sets=replica_sets,
                          failover_timeout=failover_timeout,
                          coordinator=coordinator, table=table,
-                         aggregator=aggregator, agg_role=agg_role)
+                         aggregator=aggregator, agg_role=agg_role,
+                         read_staleness=read_staleness,
+                         pull_cache=pull_cache)
         return self
 
     def _init_multi(self, addrs: List[Tuple[str, int]], worker: int,
@@ -1556,7 +1612,9 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                     failover_timeout: Optional[float] = None,
                     coordinator=None, table=None,
                     aggregator: Optional[str] = None,
-                    agg_role: bool = False) -> None:
+                    agg_role: bool = False,
+                    read_staleness: Optional[int] = None,
+                    pull_cache: Optional[bool] = None) -> None:
         self.worker = worker
         # hierarchical two-level aggregation (backends/aggregator.py):
         # with an aggregator URI this worker dials ONLY its host group's
@@ -1617,6 +1675,7 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         # replica sets per shard + the promotion-wait budget (no-op with
         # singleton sets — the legacy topology)
         self._init_failover(replica_sets, failover_timeout)
+        self._init_read_path(read_staleness, pull_cache)
         if self.compress and self.compress.get("pull") \
                 and self.compress.get("codec") == "topk":
             raise ValueError(
@@ -1830,7 +1889,9 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                 shm_bytes=self.shm_bytes,
                 replica_sets=table.replica_sets(),
                 failover_timeout=self.failover_timeout,
-                coordinator=self._coord, table=table)
+                coordinator=self._coord, table=table,
+                read_staleness=self.read_staleness,
+                pull_cache=self.pull_cache)
         finally:
             self._restore_transport_state(saved)
             self._transport_nonce, self._push_seq = nonce, push_seq
@@ -1883,7 +1944,9 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                 shm_bytes=self.shm_bytes,
                 replica_sets=fb["replica_sets"],
                 failover_timeout=self.failover_timeout,
-                coordinator=self._coord, table=fb["table"])
+                coordinator=self._coord, table=fb["table"],
+                read_staleness=self.read_staleness,
+                pull_cache=self.pull_cache)
         finally:
             self._restore_transport_state(saved)
             self._transport_nonce, self._push_seq = nonce, push_seq
@@ -1992,6 +2055,301 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                     i: tv.encode(tv.PULL, self.worker, None, extra=extra)
                     for i in self._active
                 })))
+
+    # -- high-QPS read path (README "Read path") ------------------------------
+
+    def _init_read_path(self, read_staleness, pull_cache) -> None:
+        """Worker half of the layered read path: dedicated read channels
+        spread over each shard's replica set (bounded staleness, primary
+        fallback), a local parameter cache invalidated by observed
+        version bumps, and coalescing of concurrent same-shard reads
+        into ONE wire fetch (the aggregator's ``_coalesced_pull``
+        discipline, generalized to every worker)."""
+        from ps_tpu.config import env_flag, env_int
+
+        self._close_read_path()  # reconnect() re-runs _init_multi
+        # bounded-staleness contract, measured in VERSIONS: a replica
+        # whose reply trails the worker's last-known primary version by
+        # more than this many versions is refused and the read falls
+        # back toward the primary. 0 (default) = replicas serve only
+        # what is provably current.
+        self.read_staleness = (env_int("PS_READ_STALENESS", 0, lo=0)
+                               if read_staleness is None
+                               else max(int(read_staleness), 0))
+        # worker-side parameter cache: repeat reads at an unchanged
+        # version cost no wire round trip; version bumps ride every
+        # reply this worker already decodes (push acks, pulls, stats)
+        # plus the REPLICA_STATE probe on the heartbeat cadence.
+        self.pull_cache = (env_flag("PS_PULL_CACHE", False)
+                           if pull_cache is None else bool(pull_cache))
+        self._read_cv = threading.Condition()
+        # in-flight fetch records, one per shard: waiters hold the RECORD
+        # and read the result out of it, so sharing needs no global
+        # retention — with the cache off, a snapshot dies with its last
+        # reader instead of pinning a second model copy per shard
+        import itertools
+
+        self._read_fetching: Dict[int, dict] = {}
+        self._read_snaps: Dict[int, dict] = {}  # pull_cache=True only
+        # dead-member cooldown: an address whose dial/request just failed
+        # is skipped by the rotation for a beat instead of costing every
+        # read its full connect budget (the primary is never skipped —
+        # it is the fallback of last resort)
+        self._read_bad: Dict[tuple, float] = {}
+        self._read_pool = None  # lazy fan-out executor (multi-shard)
+        # GIL-atomic rotation counter: read_all is documented for
+        # concurrent callers, and a bare int read-modify-write would
+        # lose increments and skew the replica-set rotation
+        self._read_rr = itertools.count()
+        self._read_chs: Dict[tuple, tv.Channel] = {}
+        self._watch_chs: Dict[int, tv.Channel] = {}
+        self._read_watch = None
+        self._read_watch_stop = threading.Event()
+
+    def _close_read_path(self) -> None:
+        stop = getattr(self, "_read_watch_stop", None)
+        if stop is not None:
+            stop.set()
+        pool = getattr(self, "_read_pool", None)
+        if pool is not None:
+            pool.shutdown(wait=False)
+            self._read_pool = None
+        watch = getattr(self, "_read_watch", None)
+        if watch is not None:
+            # join BEFORE closing the watch channels: a watcher
+            # mid-iteration could otherwise dial and store a fresh
+            # channel after the close swept the dict — a leaked live
+            # connection (the watcher owns ITS dict, so even a stuck
+            # join cannot make it write into a successor's)
+            watch.join(timeout=5)
+        for ch in list(getattr(self, "_read_chs", {}).values()):
+            ch.close()
+        for ch in list(getattr(self, "_watch_chs", {}).values()):
+            ch.close()
+        self._read_chs = {}
+        self._watch_chs = {}
+        self._read_watch = None
+
+    def read_all(self) -> Any:
+        """Side-effect-free read of the current params — the SERVING
+        pull. Unlike :meth:`pull_all` it records no pull event at the
+        server (no DC stale snapshot, no replication entry), may be
+        answered by a backup replica within ``read_staleness`` versions
+        of the primary, is served from the native read cache with zero
+        upcalls on repeat, and coalesces with concurrent callers: while
+        one thread's wire fetch for a shard is in flight, other readers
+        wait on THAT fetch instead of fanning identical requests. Does
+        not touch the training-path params (:meth:`pull_all`'s snapshot
+        is unaffected)."""
+        import jax.numpy as jnp
+
+        with self._op("read"):
+            kv: Dict[str, Any] = {}
+            if len(self._active) > 1:
+                # fan the per-shard reads out concurrently, like
+                # pull_all's _fanout — a serving read must not pay K
+                # sequential round trips on a K-shard topology (the
+                # per-shard coalescing makes the duplicate work of
+                # concurrent callers collapse anyway)
+                import concurrent.futures
+
+                pool = self._read_executor()
+                futs = {i: pool.submit(self._read_shard, i)
+                        for i in self._active}
+                concurrent.futures.wait(futs.values())
+                for i, f in futs.items():
+                    kv.update(f.result()["kv"])
+            else:
+                for i in self._active:
+                    kv.update(self._read_shard(i)["kv"])
+            missing = [k for k in self._key_order if k not in kv]
+            if missing:
+                raise self._incomplete_pull(missing)
+            return keymod.unflatten(
+                self._treedef, {k: jnp.asarray(v) for k, v in kv.items()},
+                self._key_order)
+
+    def _read_executor(self):
+        if self._read_pool is None:
+            import concurrent.futures
+
+            self._read_pool = concurrent.futures.ThreadPoolExecutor(
+                max_workers=len(self._active),
+                thread_name_prefix="ps-read")
+        return self._read_pool
+
+    def _read_fresh_enough(self, version: int, i: int) -> bool:
+        return self.versions[i] - int(version) <= self.read_staleness
+
+    def _read_shard(self, i: int) -> dict:
+        """One shard's read snapshot: local cache when its version is
+        within the staleness bound of the last-known server version,
+        else ONE coalesced wire fetch. A waiter sharing another caller's
+        fetch applies the SAME freshness predicate as the cache hit — an
+        apply ack observed while the fetch was in flight means its
+        pre-apply snapshot is stale for this reader, who loops and
+        refetches instead of violating the bound."""
+        self._ensure_version_watch()
+        while True:
+            with self._read_cv:
+                snap = self._read_snaps.get(i)
+                if (snap is not None and self.pull_cache
+                        and self._read_fresh_enough(snap["version"], i)):
+                    self.transport.record_read_cache(True)
+                    return snap
+                rec = self._read_fetching.get(i)
+                if rec is not None:
+                    self._read_cv.wait(0.05)
+                    got = rec.get("snap") if rec.get("done") else None
+                    if got is not None \
+                            and self._read_fresh_enough(got["version"], i):
+                        # coalesced: share the fetch this caller waited
+                        # out instead of issuing another
+                        self.transport.record_read_coalesced()
+                        return got
+                    continue
+                rec = {"done": False, "snap": None}
+                self._read_fetching[i] = rec
+                break
+        try:
+            snap = self._read_fetch(i)
+            with self._read_cv:
+                rec["snap"] = snap
+                if self.pull_cache:
+                    self._read_snaps[i] = snap
+            return snap
+        finally:
+            with self._read_cv:
+                rec["done"] = True
+                self._read_fetching.pop(i, None)
+                self._read_cv.notify_all()
+
+    def _read_fetch(self, i: int) -> dict:
+        """One wire READ for shard ``i``, spread across its replica set:
+        members are tried in rotating order; a non-primary whose version
+        exceeds the staleness bound is refused (counted as a fallback)
+        and the rotation continues — the primary always qualifies, so a
+        healthy shard can never fail the bound."""
+        self.transport.record_read_cache(False)
+        payload = tv.encode(tv.READ, 0, None)
+        members = self._replica_sets[i]
+        primary = tuple(self._addrs[i])
+        start = next(self._read_rr)
+        now = time.monotonic()
+        order = [tuple(members[(start + j) % len(members)])
+                 for j in range(len(members))]
+        # skip members in their failure cooldown (a blackholed replica
+        # must not cost its rotation share a connect budget per read);
+        # the primary is always tried
+        order = [a for a in order
+                 if a == primary or self._read_bad.get(a, 0.0) <= now]
+        last: Optional[BaseException] = None
+        for addr in order:
+            try:
+                ch = self._read_channel(i, addr)
+                reply = ch.request(payload)
+                kind, _, tensors, extra = tv.decode(reply)
+            except (tv.VanError, OSError) as e:
+                self._drop_read_channel(i, addr)
+                self._read_bad[addr] = time.monotonic() + 2.0
+                last = e
+                continue
+            self._read_bad.pop(addr, None)
+            if kind != tv.OK:
+                last = RuntimeError(str(extra.get("error")))
+                continue
+            version = int(extra["version"])
+            if addr != primary and not self._read_fresh_enough(version, i):
+                # replica too far behind the bound: fall back toward the
+                # primary (it is later in — or next around — the rotation)
+                self.transport.record_read_fallback()
+                last = RuntimeError(
+                    f"replica {addr} at version {version} exceeds the "
+                    f"staleness bound ({self.versions[i]} known, "
+                    f"{self.read_staleness} allowed)")
+                continue
+            # own-memory copies: the reply frame dies with this scope
+            kv = {k: np.array(v) for k, v in tensors.items()}
+            if version > self.versions[i]:
+                self.versions[i] = version
+            self.transport.record_read_route(replica=addr != primary)
+            return {"version": version, "kv": kv}
+        raise ServerFailureError(
+            f"read failed at every member of {self._failure_noun} {i}'s "
+            f"replica set {members}: {last}", server=i)
+
+    def _read_channel(self, i: int, addr) -> tv.Channel:
+        ch = self._read_chs.get((i, addr))
+        if ch is None:
+            # short budget: a dead replica must cost this read
+            # milliseconds, not Channel.connect's boot patience
+            ch = tv.Channel.connect(addr[0], addr[1], timeout_ms=2000,
+                                    retries=2, max_wait_s=0.5)
+            ch.stats = self.transport
+            self._read_chs[(i, addr)] = ch
+        return ch
+
+    def _drop_read_channel(self, i: int, addr) -> None:
+        ch = self._read_chs.pop((i, addr), None)
+        if ch is not None:
+            ch.close()
+
+    def _ensure_version_watch(self) -> None:
+        """Start the version watcher once, lazily, and only when the
+        parameter cache is on: it polls each shard's REPLICA_STATE —
+        the cheapest round trip every role answers — on the heartbeat
+        cadence, so a pure reader learns of version bumps (and its
+        cache invalidates) without ever issuing a full pull."""
+        if not self.pull_cache or self._read_watch is not None:
+            return
+        with self._read_cv:
+            if self._read_watch is not None:
+                return
+            # the watcher binds ITS OWN stop event and channel dict: a
+            # reconnect's _init_read_path installs fresh ones, so a
+            # lingering old watcher can never store into the successor's
+            t = threading.Thread(
+                target=self._version_watch,
+                args=(self._read_watch_stop, self._watch_chs),
+                daemon=True, name="ps-read-watch")
+            self._read_watch = t
+        t.start()
+
+    def _version_watch(self, stop, chs) -> None:
+        from ps_tpu.config import env_int
+
+        # the existing heartbeat cadence IS the watch cadence: version
+        # bumps piggyback on the same rhythm the failure detector beats at
+        interval = env_int("PS_HEARTBEAT_INTERVAL_MS", 100, lo=1) / 1e3
+        payload = tv.encode(tv.REPLICA_STATE, 0, None)
+        bad: Dict[int, float] = {}  # re-dial cooldown per shard: one
+        # dead shard must not stall the healthy shards' version probes
+        # behind its connect timeout every cycle
+        while not stop.wait(interval):
+            for i in list(self._active):
+                if stop.is_set():
+                    return
+                ch = chs.get(i)
+                if ch is None and bad.get(i, 0.0) > time.monotonic():
+                    continue
+                try:
+                    if ch is None:
+                        host, port = self._addrs[i]
+                        ch = tv.Channel.connect(host, port,
+                                                timeout_ms=2000, retries=1,
+                                                max_wait_s=0.2)
+                        chs[i] = ch
+                    kind, _, _, extra = tv.decode(ch.request(payload))
+                    v = extra.get("version")
+                    if kind == tv.OK and v is not None \
+                            and int(v) > self.versions[i]:
+                        self.versions[i] = int(v)
+                    bad.pop(i, None)
+                except (tv.VanError, OSError, IndexError):
+                    if ch is not None:
+                        ch.close()
+                    chs.pop(i, None)
+                    bad[i] = time.monotonic() + 2.0
 
     def push_all(self, grads, members: Optional[dict] = None) -> None:
         """Push a gradient tree; each owner applies its subtree immediately
@@ -2393,7 +2751,9 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                 coordinator=self._coord,
                 table=(None if addrs is not None
                        else fb["table"] if fb is not None else self._table),
-                aggregator=None if addrs is not None else self._agg_uri)
+                aggregator=None if addrs is not None else self._agg_uri,
+                read_staleness=self.read_staleness,
+                pull_cache=self.pull_cache)
         finally:
             # restores the compressor too: topk error-feedback residuals
             # are unsent gradient mass and must survive the re-dial
@@ -2440,6 +2800,7 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         return run
 
     def close(self) -> None:
+        self._close_read_path()
         if self._tel_reporter is not None:
             self._tel_reporter.close()
             self._tel_reporter = None

@@ -348,6 +348,9 @@ class SparsePSService(VanService):
                 self._tables[name].push(ids, grads)
                 self.versions[name] += 1
                 self.rows_applied[name] += int(ids.size)
+            # invalidation-on-apply (README "Read path"): any cached
+            # hot-id-set reply may include rows this push just rewrote
+            self._invalidate_reads()
             apply_s = _ptime.perf_counter() - t_apply
             if pseq is not None:
                 self._applied_pseq[worker] = (pnonce, int(pseq),
@@ -389,9 +392,45 @@ class SparsePSService(VanService):
                                    extra={"versions": versions})
         return tv.encode(tv.OK, worker, out, extra={"versions": versions})
 
+    def _read_rows_payload(self, per_table) -> bytes:
+        """Serve one READ (README "Read path"): side-effect-free row
+        fetch, byte-deterministic for byte-identical requests (fixed
+        worker id 0) — a hot id-set's reply is therefore shareable from
+        the native read cache until any row apply invalidates it. The
+        publish generation is captured under the table lock with the
+        rows, closing the publish-vs-apply race at the native floor."""
+        out = {}
+        with self._lock:
+            for name, t in per_table.items():
+                ids = self._localize(name, t["ids"])
+                out[f"{name}/rows"] = np.asarray(self._tables[name].pull(ids))
+            versions = dict(self.versions)
+            gen = self._read_gen_snapshot()
+        reply = tv.encode(tv.OK, 0, out, extra={"versions": versions,
+                                                "version": self._vsum(versions)})
+        self._note_read_snapshot(gen, self._vsum(versions))
+        self.transport.record_read_served()
+        return reply
+
+    @staticmethod
+    def _vsum(versions) -> int:
+        return int(sum(int(v) for v in versions.values()))
+
+    def _read_version(self):
+        # deliberately LOCK-FREE: this runs on the native loop's one pump
+        # thread (REPLICA_STATE replies, the gauge tick) and must never
+        # queue behind a long apply or a checkpoint save holding _lock.
+        # The table set is fixed after construction (values rebind, keys
+        # never change) and versions only grow, so an unlocked sum is a
+        # monotone-bounded freshness probe — exactly what the staleness
+        # contract needs, never a torn structure.
+        return self._vsum(self.versions)
+
     def _handle(self, kind: int, worker: int, tensors, extra) -> bytes:
         if kind == tv.HELLO:
             return tv.encode(tv.OK, worker, None, extra=self._hello_extra())
+        elif kind == tv.READ:
+            return self._read_rows_payload(self._split(tensors))
         elif kind == tv.ROW_PULL:
             return self._rows_payload(worker, self._split(tensors))
         elif kind == tv.ROW_PUSH:
@@ -571,6 +610,7 @@ class SparsePSService(VanService):
         with self._lock:
             self._draining = True
             self._pause_cond.notify_all()  # paused pushes wake into refusal
+        self._invalidate_reads()  # no native hit may outlive the drain
 
     # -- shard replication hooks (ps_tpu/replica) -----------------------------
 
@@ -619,6 +659,7 @@ class SparsePSService(VanService):
             self._tables[name].push(ids, grads)
             self.versions[name] += 1
             self.rows_applied[name] += int(ids.size)
+        self._invalidate_reads()  # replica reads go stale per applied entry
         if extra.get("pseq") is not None:
             self._applied_pseq[worker] = (extra.get("pnonce"),
                                           int(extra["pseq"]),
@@ -1029,6 +1070,24 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                 msgs = self._fanout({
                     i: tv.encode(tv.ROW_PULL, self.worker, t, extra=extra)
                     for i, t in reqs.items()
+                })
+                return self._merge_rows(requests, routes, msgs)
+
+            return self._with_failover(once)
+
+    def read_rows(self, requests: Dict[str, Any]) -> Dict[str, np.ndarray]:
+        """Side-effect-free row read (README "Read path"): like
+        :meth:`pull` but over READ frames — no pull event at the server,
+        a FIXED worker id 0 and deterministic extra, so byte-identical
+        hot id-sets are answered from the server's native read cache
+        with zero upcalls on repeat (and by backup replicas, version-
+        stamped for the staleness contract). Does not flush in-flight
+        cycles: a read observes whatever is committed when it lands."""
+        reqs, routes = self._build_pull(requests)
+        with self._op("read"):
+            def once():
+                msgs = self._fanout({
+                    i: tv.encode(tv.READ, 0, t) for i, t in reqs.items()
                 })
                 return self._merge_rows(requests, routes, msgs)
 

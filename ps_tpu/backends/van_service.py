@@ -354,6 +354,29 @@ class VanService:
                     logging.getLogger(__name__).warning(
                         "native event loop failed to start (%s); falling "
                         "back to thread-per-connection serving", e)
+        # high-QPS read path (README "Read path"): generation counter for
+        # native read-cache invalidation. Every committed state change a
+        # cached READ reply could observe bumps it (_invalidate_reads);
+        # READ handlers capture it UNDER their apply lock with the
+        # snapshot (_read_gen_snapshot) and the pump publishes the reply
+        # at that generation — a put superseded by an apply is refused at
+        # the native floor, so a stale reply can never park in the cache.
+        self._read_gen = 0
+        self._read_gen_lock = threading.Lock()
+        self._read_pub = threading.local()
+        self._read_pub_version = 0  # version of the last published snapshot
+        self._native_read_cache = False
+        if self._nloop is not None:
+            from ps_tpu.config import env_int as _env_int
+
+            # validated service-level read (pslint PSL406): the native
+            # read-cache byte budget; 0 disables hot-key serving and
+            # every READ takes the pump path
+            cache_bytes = _env_int("PS_NATIVE_READ_CACHE_BYTES", 64 << 20,
+                                   lo=0)
+            if cache_bytes:
+                self._nloop.cache_config(tv.READ, cache_bytes)
+                self._native_read_cache = True
         if self._nloop is not None:
             self._loop_conn_gauge = obs.default_registry().gauge(
                 "ps_van_live_connections",
@@ -364,6 +387,17 @@ class VanService:
             self._loop_req_gauge = obs.default_registry().gauge(
                 "ps_van_loop_requests_total",
                 "cumulative frames read by the native loop")
+            self._read_hits_gauge = obs.default_registry().gauge(
+                "ps_pull_native_hits_total",
+                "READ frames answered by the native read cache with "
+                "zero upcalls")
+            self._read_miss_gauge = obs.default_registry().gauge(
+                "ps_pull_native_misses_total",
+                "cacheable READ frames that fell through to the pump")
+            self._read_lag_gauge = obs.default_registry().gauge(
+                "ps_pull_cache_version_lag",
+                "engine versions the cached READ snapshot trails by "
+                "(0 = fresh or empty)")
             self._pump_thread = threading.Thread(
                 target=self._loop_pump, daemon=True
             )
@@ -445,6 +479,15 @@ class VanService:
         if kind in self._REPLICA_KINDS:
             return self._handle_replica(kind, worker, tensors, extra)
         if self.role != "primary" and kind != tv.STATS:
+            if kind == tv.READ and self.role == "backup":
+                # replica reads (README "Read path"): a BACKUP answers
+                # side-effect-free READs from its replicated state — the
+                # reply's version stamp is what lets workers enforce the
+                # bounded-staleness contract (PS_READ_STALENESS) and fall
+                # back to the primary when the bound is exceeded. Fenced
+                # zombies stay refused: their version stream is dead, and
+                # routing reads at them would only burn a fallback.
+                return self._handle(kind, worker, tensors, extra)
             return tv.encode(tv.ERR, worker, None, extra={
                 "error": (f"shard backup is not serving worker traffic "
                           f"(role={self.role}, epoch {self.epoch}) — "
@@ -510,6 +553,44 @@ class VanService:
             self._replica_applied_seq = seq
         return tv.encode(tv.OK, worker, None, extra={"applied_seq": seq})
 
+    # -- high-QPS read path (README "Read path") ------------------------------
+
+    def _read_version(self):
+        """Subclass hook: the engine version a READ reply is stamped with
+        (dense: engine.version; sparse: summed table versions). None =
+        this service serves no READ kind."""
+        return None
+
+    def _read_gen_snapshot(self) -> int:
+        """The current read-cache publish generation. READ handlers call
+        this UNDER their apply lock, atomically with the snapshot they
+        serialize, and hand the pair to :meth:`_note_read_snapshot` — the
+        ordering that makes invalidation-on-apply airtight."""
+        with self._read_gen_lock:
+            return self._read_gen
+
+    def _invalidate_reads(self) -> None:
+        """Invalidation-on-apply: call after ANY committed state change a
+        cached READ reply could observe (engine applies, replica-stream
+        applies, migration cutovers, promotion, drain). Cheap no-op when
+        the native cache is off."""
+        if not self._native_read_cache:
+            return
+        with self._read_gen_lock:
+            self._read_gen += 1
+            gen = self._read_gen
+        nloop = self._nloop
+        if nloop is not None:
+            nloop.cache_invalidate(gen)
+
+    def _note_read_snapshot(self, gen: int, version: int) -> None:
+        """READ handlers record the (generation, version) their reply
+        serializes; the pump publishes the encoded frame into the native
+        cache under exactly that generation. Thread-local: handlers run
+        on the pump or punted threads."""
+        self._read_pub.gen = gen
+        self._read_pub.version = int(version)
+
     def promote(self, reason: str = "request") -> int:
         """The backup→primary transition (idempotent): under the apply
         lock — so no replica append is mid-apply and no worker push is
@@ -527,6 +608,10 @@ class VanService:
             self.role = "primary"
             self.epoch = self._primary_epoch + 1
             self.promote_reason = reason
+        # role flipped: a cached reply published as a backup must not
+        # outlive the promotion (its bytes are still correct state, but
+        # freshness semantics changed — republish as primary)
+        self._invalidate_reads()
         self.promotion_s = _time.perf_counter() - t0
         obs.record_event("promotion", reason=reason, epoch=self.epoch,
                          promotion_s=round(self.promotion_s, 6))
@@ -584,6 +669,8 @@ class VanService:
             if self.role != "primary":
                 return
             self.role = "fenced"
+        # a zombie's cached reads die with its serving rights
+        self._invalidate_reads()
         obs.record_event("self_fence", peer_epoch=int(peer_epoch),
                          epoch=self.epoch)
         logging.getLogger(__name__).error(
@@ -653,6 +740,22 @@ class VanService:
             out["promote_reason"] = self.promote_reason
             out["promotion_s"] = self.promotion_s
         out["dedup_hits"] = self.transport.dedup_hits
+        v = self._read_version()
+        if v is not None and "version" not in out:
+            # the cheap per-role version probe the worker-side parameter
+            # cache rides (REPLICA_STATE on the heartbeat cadence):
+            # version bumps invalidate cached reads without a full pull
+            out["version"] = v
+        if self.transport.reads_served or self.transport.read_native_hits:
+            # serve-path visibility (ps_top's read columns): READs this
+            # endpoint answered in Python vs natively, and the native
+            # cache's live footprint
+            out["read"] = {
+                "served": self.transport.reads_served,
+                "native_hits": self.transport.read_native_hits,
+                "native_misses": self.transport.read_native_misses,
+                "entries": self.transport.read_cache_entries,
+            }
         if self._nloop is not None:
             # native event-loop serve path: live connections + frames
             # read — the cell ps_top renders per shard (iterations and
@@ -966,6 +1069,19 @@ class VanService:
                 self._loop_conn_gauge.set(st["conns"])
                 self._loop_iter_gauge.set(st["iters"])
                 self._loop_req_gauge.set(st["requests"])
+                if self._native_read_cache:
+                    cs = nloop.cache_stats()
+                    self.transport.set_read_cache_stats(
+                        cs["hits"], cs["misses"], cs["entries"],
+                        cs["bytes"])
+                    self._read_hits_gauge.set(cs["hits"])
+                    self._read_miss_gauge.set(cs["misses"])
+                    v = self._read_version()
+                    # versions the cached snapshot trails the engine by
+                    # (0 when empty — nothing stale is being served)
+                    self._read_lag_gauge.set(
+                        max(0, int(v) - self._read_pub_version)
+                        if v is not None and cs["entries"] else 0)
             if batch is None:
                 return
             if not batch:
@@ -1027,6 +1143,16 @@ class VanService:
             self._loop_close_conn(cid)
             return
         self._req_counter.inc()
+        # a READ reaching the pump IS a native-cache miss: remember its
+        # exact request bytes so the reply can be published into the
+        # native cache (the next identical READ is answered inside the
+        # loop with zero upcalls). The copy is tiny — READ requests are
+        # a header + (sparse) an id list. The publish rides whichever
+        # dispatch path the kind takes (inline here, or punted — the
+        # aggregator barriers READs off-pump because its coalesced fetch
+        # does upstream I/O).
+        raw = (bytes(msg) if kind == tv.READ and self._native_read_cache
+               else None)
         if kind == tv.SHUTDOWN:
             nloop.reply(cid, tv.encode(tv.OK, worker, None),
                         close_after=True)
@@ -1074,7 +1200,7 @@ class VanService:
                     threading.Thread(
                         target=self._loop_dispatch_reply,
                         args=(cid, kind, worker, tensors, extra, ptr,
-                              True, blocker),
+                              True, blocker, raw),
                         daemon=True,
                     ).start()
                 else:
@@ -1086,7 +1212,7 @@ class VanService:
                     # lock (no parking condition is live on this branch).
                     self._punt_pool().submit(
                         self._loop_dispatch_reply, cid, kind, worker,
-                        tensors, extra, ptr, True, False)
+                        tensors, extra, ptr, True, False, raw)
             except Exception as e:  # thread exhaustion: refuse, don't die
                 with self._inflight_cond:
                     self._inflight -= 1
@@ -1099,7 +1225,7 @@ class VanService:
                 nloop.free(ptr)
             return
         self._loop_dispatch_reply(cid, kind, worker, tensors, extra, ptr,
-                                  False)
+                                  False, raw=raw)
 
     def _dispatch_reply_payload(self, kind: int, worker: int, tensors,
                                 extra):
@@ -1142,7 +1268,8 @@ class VanService:
 
     def _loop_dispatch_reply(self, cid: int, kind: int, worker: int,
                              tensors, extra, ptr: int,
-                             punted: bool, blocker: bool = False) -> None:
+                             punted: bool, blocker: bool = False,
+                             raw=None) -> None:
         nloop = self._nloop
         prio = self._reply_priority(kind, extra)
         # mark this thread as serving a LOOP request for the dispatch's
@@ -1152,8 +1279,22 @@ class VanService:
         this = threading.current_thread()
         this._ps_loop_req = True
         try:
+            if raw is not None:
+                self._read_pub.gen = None  # pool/pump threads are reused:
+                # never publish under a PREVIOUS request's generation
             reply = self._dispatch_reply_payload(kind, worker, tensors,
                                                  extra)
+            if raw is not None and isinstance(reply, (bytes, bytearray)):
+                gen = getattr(self._read_pub, "gen", None)
+                if gen is not None:
+                    # publish-on-miss: the reply the pump is about to send
+                    # becomes the native cache's entry for these request
+                    # bytes — hit replies are bitwise identical to this
+                    # pump reply BY CONSTRUCTION (the cache only echoes).
+                    # A put raced by an apply is refused at the floor.
+                    if nloop.cache_put(raw, reply, gen):
+                        self._read_pub_version = int(
+                            getattr(self._read_pub, "version", 0))
             try:
                 nloop.reply(cid, reply, priority=prio)  # False = gone
             finally:

@@ -58,6 +58,24 @@ Environment variables honored by :meth:`Config.from_env`:
   thread-per-connection, also the fallback on non-Linux platforms
 - ``PS_VAN_LOOP_THREADS``   — native event-loop thread-pool size
   (default 1; connections are assigned round-robin)
+- ``PS_NATIVE_READ_CACHE_BYTES`` — native read-cache budget for the
+  zero-upcall READ serving path (README "Read path"); entries are
+  published on READ misses and invalidated on every apply. 0 disables;
+  default 64 MiB. Only meaningful with PS_VAN_NATIVE_LOOP=1
+- ``PS_READ_STALENESS``     — worker side: how many VERSIONS a replica-
+  served READ may trail the last-known primary version before the read
+  falls back to the primary (default 0 = replicas serve only what is
+  provably current)
+- ``PS_PULL_CACHE``         — '1' turns on the worker-side parameter
+  cache: repeat reads at an unchanged version cost no wire round trip;
+  version bumps ride decoded replies plus a REPLICA_STATE probe on the
+  heartbeat cadence (default off)
+- ``PS_CONNECT_MAX_WAIT_MS`` — total sleep budget of one
+  ``Channel.connect`` dial's retry backoff (default 15000); read-path
+  failover tuning turns it down so a dead replica costs milliseconds
+- ``PS_AGG_PROBE_MAX_WAIT_MS`` — sleep budget of the stale-aggregator
+  liveness probe a discovering worker runs before dialing its host's
+  registered aggregator (default 200)
 - ``PS_CKPT_ROOT``          — server side: confine CHECKPOINT saves under
   this root (client paths relative-only, ``..`` refused)
 - ``PS_REPLICAS``           — replica-set size per shard (1 = no
@@ -287,6 +305,28 @@ class Config:
       van_loop_threads: native event-loop thread-pool size (default 1 —
         one loop thread saturates loopback; raise for many-NIC hosts).
         Connections are assigned round-robin at accept.
+      native_read_cache_bytes: byte budget of the native read cache
+        (README "Read path"): committed, version-stamped READ replies
+        published by Python and answered inside the epoll loop with
+        zero upcalls on byte-identical repeats; invalidated on every
+        apply. 0 disables (every READ takes the pump); only meaningful
+        with van_native_loop.
+      read_staleness: worker side — the bounded-staleness contract of
+        replica reads, in VERSIONS: a backup whose READ reply trails
+        the worker's last-known primary version by more than this is
+        refused and the read falls back toward the primary. 0 (default)
+        = replicas only serve what is provably current.
+      pull_cache: worker-side parameter cache for the read path: repeat
+        reads at an unchanged version are served locally with no wire
+        round trip; version bumps piggyback on every reply the worker
+        decodes plus a REPLICA_STATE probe on the heartbeat cadence.
+        Off by default (explicit opt-in, like shm).
+      connect_max_wait_ms: total sleep budget of one Channel.connect
+        dial's retry backoff (the boot patience). Read-path failover
+        tuning turns it down; 15 s default preserved.
+      agg_probe_max_wait_ms: sleep budget of the stale-aggregator
+        liveness probe run before dialing a discovered host aggregator
+        (a dead registry entry must cost a join milliseconds).
       replicas: replica-set size per shard (ps_tpu/replica): 1 = classic
         unreplicated servers; 2 = primary + warm backup with live
         failover. Launchers size the server fleet with it; workers learn
@@ -428,6 +468,16 @@ class Config:
     # the non-Linux fallback).
     van_native_loop: bool = False
     van_loop_threads: int = 1
+    # high-QPS read path (README "Read path"): the native zero-upcall
+    # read cache's byte budget (server), the replica-read staleness
+    # bound in versions and the worker parameter cache (worker side)
+    native_read_cache_bytes: int = 64 << 20
+    read_staleness: int = 0
+    pull_cache: bool = False
+    # dial budgets (previously hardcoded): Channel.connect's total
+    # retry-sleep budget and the discovered-aggregator liveness probe's
+    connect_max_wait_ms: int = 15_000
+    agg_probe_max_wait_ms: int = 200
     # server: confine CHECKPOINT saves under this root (client paths must
     # be relative, '..' escapes refused). None = legacy client-names-path.
     ckpt_root: Optional[str] = None
@@ -571,6 +621,15 @@ class Config:
                 f"van_loop_threads {self.van_loop_threads} outside [1, 64] "
                 f"(the native loop's thread-pool bound)"
             )
+        if self.native_read_cache_bytes < 0:
+            raise ValueError("native_read_cache_bytes must be >= 0 "
+                             "(0 disables the native read cache)")
+        if self.read_staleness < 0:
+            raise ValueError("read_staleness must be >= 0 versions")
+        if self.connect_max_wait_ms < 0:
+            raise ValueError("connect_max_wait_ms must be >= 0")
+        if self.agg_probe_max_wait_ms < 0:
+            raise ValueError("agg_probe_max_wait_ms must be >= 0")
         if self.replicas < 1:
             raise ValueError("replicas must be >= 1 (1 = no replication)")
         if self.replica_ack not in ("sync", "async"):
@@ -716,6 +775,19 @@ class Config:
             kwargs["van_native_loop"] = env_flag("PS_VAN_NATIVE_LOOP", False)
         if "PS_VAN_LOOP_THREADS" in env:
             kwargs["van_loop_threads"] = int(env["PS_VAN_LOOP_THREADS"])
+        if "PS_NATIVE_READ_CACHE_BYTES" in env:
+            # "0" explicitly disables the native read cache
+            kwargs["native_read_cache_bytes"] = int(
+                env["PS_NATIVE_READ_CACHE_BYTES"] or 0)
+        if "PS_READ_STALENESS" in env:
+            kwargs["read_staleness"] = int(env["PS_READ_STALENESS"])
+        if "PS_PULL_CACHE" in env:
+            kwargs["pull_cache"] = env_flag("PS_PULL_CACHE", False)
+        if "PS_CONNECT_MAX_WAIT_MS" in env:
+            kwargs["connect_max_wait_ms"] = int(env["PS_CONNECT_MAX_WAIT_MS"])
+        if "PS_AGG_PROBE_MAX_WAIT_MS" in env:
+            kwargs["agg_probe_max_wait_ms"] = int(
+                env["PS_AGG_PROBE_MAX_WAIT_MS"])
         if "PS_CKPT_ROOT" in env:
             kwargs["ckpt_root"] = env["PS_CKPT_ROOT"] or None
         if "PS_REPLICAS" in env:

@@ -79,6 +79,16 @@ def _lib():
                              ctypes.POINTER(ctypes.c_uint64)]
     lib.nl_begin_stop.argtypes = [ctypes.c_void_p]
     lib.nl_stop.argtypes = [ctypes.c_void_p]
+    lib.nl_cache_config.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                    ctypes.c_uint64]
+    lib.nl_cache_put.restype = ctypes.c_int
+    lib.nl_cache_put.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
+    ]
+    lib.nl_cache_invalidate.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.nl_cache_stats.argtypes = [ctypes.c_void_p,
+                                   ctypes.POINTER(ctypes.c_uint64)]
     lib.tv_adopt_fd.restype = ctypes.c_void_p
     lib.tv_adopt_fd.argtypes = [ctypes.c_int]
     _configured = lib
@@ -124,6 +134,7 @@ class NativeEventLoop:
         self._ptrs = (ctypes.c_void_p * MAX_BATCH)()
         self._lens = (ctypes.c_uint64 * MAX_BATCH)()
         self._stats_out = (ctypes.c_uint64 * 6)()
+        self._cache_out = (ctypes.c_uint64 * 8)()
         # bodies currently claimed by Python (poll handed them out, free
         # not yet called): makes free() IDEMPOTENT — an error-path caller
         # can release unconditionally without risking a double free
@@ -220,6 +231,66 @@ class NativeEventLoop:
             return int(self._lib.nl_detach(self._h, conn_id))
         finally:
             self._unpin()
+
+    # -- native read cache (zero-upcall pull serving) -------------------------
+
+    def cache_config(self, kind: int, max_bytes: int) -> None:
+        """Enable the native read cache: frames whose first body byte is
+        ``kind`` (the wire kind — tv.READ) are answered inside the loop
+        threads on an exact-byte match, with ``max_bytes`` bounding
+        key+reply memory (0 disables)."""
+        with self._lock:
+            if not self._closed:
+                self._lib.nl_cache_config(self._h, int(kind),
+                                          int(max_bytes))
+
+    def cache_put(self, key: bytes, reply, gen: int) -> bool:
+        """Publish one reply frame for the request bytes ``key`` at
+        publish generation ``gen`` (captured under the engine lock with
+        the snapshot the reply serializes). False = refused: the cache is
+        off, the entry is over budget, or — the invalidation race — an
+        apply already raised the floor past ``gen``. Buffers are copied
+        native-side; never retained."""
+        kv = np.frombuffer(key, np.uint8)
+        rv = np.frombuffer(reply, np.uint8)
+        if not self._pin():
+            return False
+        try:
+            ok = self._lib.nl_cache_put(self._h, kv.ctypes.data, kv.nbytes,
+                                        rv.ctypes.data, rv.nbytes, int(gen))
+        finally:
+            self._unpin()
+        del kv, rv  # pinned the sources for exactly the call's duration
+        return bool(ok)
+
+    def cache_invalidate(self, gen: int) -> None:
+        """Invalidation-on-apply: raise the publish floor to ``gen`` and
+        drop every cached entry. Pin-based (not the driver lock): this
+        runs on the engine apply path and must never queue behind a
+        multi-MB reply."""
+        if not self._pin():
+            return
+        try:
+            self._lib.nl_cache_invalidate(self._h, int(gen))
+        finally:
+            self._unpin()
+
+    def cache_stats(self) -> dict:
+        """Cumulative cache counters: hits (zero-upcall replies), misses
+        (cacheable frames that took the pump path), puts, rejects,
+        invalidations, live entries, bytes held, the invalidation
+        floor."""
+        with self._lock:
+            if self._closed:
+                return {"hits": 0, "misses": 0, "puts": 0, "rejects": 0,
+                        "invalidations": 0, "entries": 0, "bytes": 0,
+                        "floor": 0}
+            self._lib.nl_cache_stats(self._h, self._cache_out)
+            o = self._cache_out
+            return {"hits": int(o[0]), "misses": int(o[1]),
+                    "puts": int(o[2]), "rejects": int(o[3]),
+                    "invalidations": int(o[4]), "entries": int(o[5]),
+                    "bytes": int(o[6]), "floor": int(o[7])}
 
     # -- lifecycle / introspection -------------------------------------------
 

@@ -106,6 +106,16 @@ MIGRATE_ABORT = 28    # donor -> recipient: discard the staged range
 # percentiles), the per-step critical-path breakdown, straggler suspects,
 # and SLO rule states (tools/ps_top.py --fleet, tools/ps_doctor.py)
 COORD_TELEMETRY = 29  # -> coordinator: fleet telemetry query/report
+# high-QPS read path (README "Read path"): a side-effect-free pull of
+# committed state — no event-log record, no replication, no DC stale
+# snapshot, and the request/reply carry a FIXED worker id 0, so
+# byte-identical requests get byte-identical replies. That determinism is
+# what makes READ frames servable from the native loop's read cache with
+# zero upcalls (nl_cache_* in van.cpp), shareable across readers, and
+# answerable by backup replicas under the bounded-staleness contract
+# (PS_READ_STALENESS) — the serving path of a read-dominated deployment.
+READ = 30       # dense: -> whole-subtree params + version;
+#                 sparse: {"<table>/ids"} -> {"<table>/rows"} + versions
 
 #: human names per kind — span labels (ps_tpu/obs/trace.py), ps_top, and
 #: flight-recorder events all resolve through here so a new kind gets a
@@ -124,6 +134,7 @@ KIND_NAMES = {
     MIGRATE_OUT: "migrate_out", MIGRATE_BEGIN: "migrate_begin",
     MIGRATE_ROW: "migrate_row", MIGRATE_COMMIT: "migrate_commit",
     MIGRATE_ABORT: "migrate_abort", COORD_TELEMETRY: "coord_telemetry",
+    READ: "read",
 }
 
 
@@ -399,7 +410,7 @@ class Channel:
     @classmethod
     def connect(cls, host: str, port: int, timeout_ms: int = 10_000,
                 retries: int = 50, retry_delay_s: float = 0.1,
-                max_wait_s: float = 15.0) -> "Channel":
+                max_wait_s: Optional[float] = None) -> "Channel":
         """Dial host:port, retrying while the server comes up.
 
         The hostname is re-resolved on EVERY attempt (a restarted server —
@@ -410,11 +421,22 @@ class Channel:
         instead of hammering the listener in lockstep. ``max_wait_s``
         bounds the TOTAL time spent sleeping between attempts, so capped
         backoff cannot turn ``retries`` into minutes against a
-        fast-refusing dead address."""
+        fast-refusing dead address. ``None`` resolves the default dial
+        budget from PS_CONNECT_MAX_WAIT_MS (15 s) — the knob read-path
+        failover tuning turns down so a dead replica costs milliseconds,
+        not the full patience meant for servers still booting."""
         import random
         import socket as pysocket
         import time
 
+        if max_wait_s is None:
+            from ps_tpu.config import env_float
+
+            # validated service-level read (pslint PSL406): the one
+            # default every dial site inherits — previously a hardcoded
+            # operator-invisible 15 s
+            max_wait_s = env_float("PS_CONNECT_MAX_WAIT_MS", 15_000.0,
+                                   lo=0.0) / 1e3
         lib = _lib()
         delay = max(float(retry_delay_s), 1e-3)
         slept = 0.0  # only SLEEP counts against max_wait_s: a peer that

@@ -672,6 +672,18 @@ struct NlThread {
   std::vector<NlConn*> graveyard;  // owner-thread only (and nl_stop)
 };
 
+// One native read-cache entry: a verbatim request body (exact-match key —
+// byte-identical READ frames share one entry, and a hash collision can
+// never serve the wrong reply) mapped to a ready-to-send reply buffer
+// (u64 length prefix already prepended). Entries are immutable after
+// construction and held by shared_ptr, so a hit can serve from one while
+// an invalidation drops the table's reference concurrently.
+struct NlCacheEntry {
+  std::string key;    // full request body bytes
+  std::string reply;  // [u64 le length][reply frame bytes]
+  uint64_t gen = 0;   // publish generation (see cache_floor)
+};
+
 struct NlLoop {
   Listener* listener = nullptr;  // borrowed: Python closes it after nl_stop
   std::atomic<bool> stop{false};
@@ -689,7 +701,64 @@ struct NlLoop {
   std::deque<NlReq> ready;
   std::atomic<uint64_t> iters{0}, accepted{0}, requests{0};
   std::atomic<uint64_t> popped{0}, freed{0};
+  // Native read cache (the zero-upcall pull path, README "Read path"):
+  // committed-state reply buffers published by Python (nl_cache_put on a
+  // READ miss), answered entirely inside the loop threads on a hit — no
+  // GIL, no upcall, no Python. cachemu is a LEAF lock: taken alone to
+  // look up / mutate the table, always released before the per-conn wmu
+  // write — never nested with tmu/qmu/wmu, so it adds no lock-order
+  // edges. cache_floor is the invalidation generation: Python bumps it
+  // on every committed apply (nl_cache_invalidate), and a put whose gen
+  // predates the floor is refused — the race where a snapshot taken
+  // before an apply is published after it can therefore never park a
+  // stale reply in the cache.
+  std::mutex cachemu;
+  std::map<uint64_t, std::vector<std::shared_ptr<NlCacheEntry>>> cache;
+  std::deque<std::shared_ptr<NlCacheEntry>> cache_fifo;  // eviction order
+  uint64_t cache_floor = 0;
+  uint64_t cache_bytes = 0;
+  uint64_t cache_limit = 0;  // 0 = cache disabled
+  // first body byte marking a cacheable frame; atomic so the read hot
+  // path can gate on it without touching cachemu for ordinary frames
+  std::atomic<int> cache_kind{-1};
+  std::atomic<uint64_t> cache_hits{0}, cache_miss{0}, cache_puts{0},
+      cache_rejects{0}, cache_invals{0};
 };
+
+uint64_t nl_cache_hash(const char* p, uint64_t n) {
+  uint64_t h = 1469598103934665603ull;  // FNV-1a 64
+  for (uint64_t i = 0; i < n; ++i) {
+    h ^= (uint8_t)p[i];
+    h *= 1099511628211ull;
+  }
+  return h;
+}
+
+// Drop one entry from the table + fifo (cachemu held). `e` is BY VALUE
+// on purpose: a caller may hand in a reference aliasing the very vector
+// slot erased below — the copy keeps the entry alive for the fifo scan
+// and the byte accounting after that slot is destroyed.
+void nl_cache_erase(NlLoop* l, std::shared_ptr<NlCacheEntry> e,
+                    uint64_t hv) {
+  auto it = l->cache.find(hv);
+  if (it != l->cache.end()) {
+    auto& v = it->second;
+    for (size_t i = 0; i < v.size(); ++i) {
+      if (v[i] == e) {
+        v.erase(v.begin() + i);
+        break;
+      }
+    }
+    if (v.empty()) l->cache.erase(it);
+  }
+  for (size_t i = 0; i < l->cache_fifo.size(); ++i) {
+    if (l->cache_fifo[i] == e) {
+      l->cache_fifo.erase(l->cache_fifo.begin() + i);
+      break;
+    }
+  }
+  l->cache_bytes -= e->key.size() + e->reply.size();
+}
 
 void nl_wake(NlThread& t) {
   uint64_t one = 1;
@@ -715,6 +784,90 @@ void nl_destroy(NlLoop* l, NlThread& t, NlConn* c) {
   c->dead = true;
   t.graveyard.push_back(c);  // freed at iteration end: events already
   // fetched in this batch may still point at the struct
+}
+
+// Owner thread: answer one cacheable frame from the native read cache.
+// Returns true when the frame was SERVED (reply written or staged — the
+// caller frees the body and moves on); false = miss, queue it to Python
+// as usual (the strict fallback: anything the cache cannot answer takes
+// the pump path, so replies are bitwise identical by construction — the
+// cache only ever echoes buffers Python published).
+bool nl_cache_serve(NlLoop* l, NlThread& t, NlConn* c) {
+  std::shared_ptr<NlCacheEntry> e;
+  {
+    std::lock_guard<std::mutex> lock(l->cachemu);
+    if (!l->cache_limit) return false;
+    uint64_t hv = nl_cache_hash(c->body, c->body_len);
+    auto it = l->cache.find(hv);
+    if (it != l->cache.end()) {
+      for (auto& cand : it->second) {
+        if (cand->key.size() == c->body_len &&
+            memcmp(cand->key.data(), c->body, c->body_len) == 0) {
+          e = cand;
+          break;
+        }
+      }
+    }
+    if (!e) {
+      l->cache_miss.fetch_add(1, std::memory_order_relaxed);
+      return false;
+    }
+  }
+  // write under the per-conn wmu only (cachemu already released — a
+  // multi-KB reply send must not serialize other lookups/puts), same
+  // ordering discipline as nl_reply_vec's staged-tail path
+  std::lock_guard<std::mutex> wl(c->wmu);
+  if (c->outstanding != 0) {
+    // a PIPELINING peer has earlier frames still queued at the pump:
+    // answering this one natively would reorder its replies. Punt it to
+    // the pump behind them — per-connection reply order is part of the
+    // framed request/reply contract. (In-tree clients are strict
+    // request/reply, so this branch costs real workloads nothing; the
+    // decrement in nl_reply_vec happens under this same wmu and writes
+    // under the same hold, so outstanding == 0 here proves every prior
+    // reply is fully written or staged ahead of us in wbuf.)
+    l->cache_miss.fetch_add(1, std::memory_order_relaxed);
+    return false;
+  }
+  l->cache_hits.fetch_add(1, std::memory_order_relaxed);
+  if (!c->wbuf.empty() && c->wbuf.size() - c->woff > kNlMaxWbufBacklog) {
+    // pipelining peer stopped reading: bound server memory (same
+    // protocol-abuse sever as nl_reply_vec)
+    shutdown(c->fd, SHUT_RDWR);
+    return true;
+  }
+  // a read reply is front-of-model-critical serving traffic: priority 0
+  // (the min rule matches nl_reply_vec — a staged tail keeps its most
+  // urgent frame's priority)
+  c->prio = c->wbuf.empty() ? 0 : std::min(c->prio, 0);
+  const char* data = e->reply.data();
+  size_t len = e->reply.size();
+  if (c->wbuf.empty()) {
+    size_t off = 0;
+    while (off < len) {
+      ssize_t r = send(c->fd, data + off, len - off,
+                       MSG_DONTWAIT | MSG_NOSIGNAL);
+      if (r < 0) {
+        if (errno == EINTR) continue;
+        if (errno == EAGAIN || errno == EWOULDBLOCK) break;
+        shutdown(c->fd, SHUT_RDWR);  // owner reaps on the EOF event
+        return true;
+      }
+      off += (size_t)r;
+    }
+    if (off < len) c->wbuf.append(data + off, len - off);
+  } else {
+    // a tail is already staged: whole frames append behind it in order
+    c->wbuf.append(data, len);
+  }
+  if (!c->wbuf.empty() && !c->want_write) {
+    c->want_write = true;
+    epoll_event ev{};
+    ev.events = EPOLLIN | EPOLLOUT;
+    ev.data.ptr = c;
+    epoll_ctl(t.epfd, EPOLL_CTL_MOD, c->fd, &ev);
+  }
+  return true;
 }
 
 // Owner thread: read everything available on c; queue complete frames.
@@ -751,6 +904,21 @@ void nl_read(NlLoop* l, NlThread& t, NlConn* c) {
         return;
       }
       c->body_off += (uint64_t)r;
+    }
+    {
+      int ck = l->cache_kind.load(std::memory_order_relaxed);
+      if (ck >= 0 && c->body_len >= 1 && (uint8_t)c->body[0] == (uint8_t)ck
+          && nl_cache_serve(l, t, c)) {
+        // answered (or severed) natively: the frame never queued, so it
+        // never counts as outstanding and Python never sees it.
+        // pslint: owns: body -- cache-hit frame answered on the owner
+        // thread BEFORE the queue push: still thread-private, no
+        // ownership ever transferred to Python
+        free(c->body);
+        c->body = nullptr;
+        c->body_len = c->body_off = 0;
+        continue;
+      }
     }
     uint32_t out;
     {
@@ -1324,6 +1492,113 @@ void nl_stop(void* h) {
     close(t.evfd);
   }
   delete l;
+}
+
+// ---------------------------------------------------------------------------
+// Native read cache ("hot-key serving"): Python publishes complete,
+// version-stamped reply frames; the loop answers byte-identical cacheable
+// requests without an upcall. See the NlLoop cache members for the
+// invalidation-generation contract.
+
+// Enable (or resize) the cache: frames whose FIRST body byte equals
+// `kind` are cacheable; `max_bytes` bounds key+reply memory (0 disables
+// and clears). Safe at any time; normally called once at service start.
+void nl_cache_config(void* h, int kind, uint64_t max_bytes) {
+  auto* l = static_cast<NlLoop*>(h);
+  std::lock_guard<std::mutex> lock(l->cachemu);
+  l->cache_kind.store(max_bytes ? kind : -1, std::memory_order_relaxed);
+  l->cache_limit = max_bytes;
+  if (!max_bytes) {
+    l->cache.clear();
+    l->cache_fifo.clear();
+    l->cache_bytes = 0;
+  }
+}
+
+// Publish one reply: `key`/`klen` are the request body bytes the entry
+// answers (exact match), `buf`/`len` the reply frame (the length prefix
+// is prepended here), `gen` the publish generation captured UNDER the
+// engine lock with the snapshot. Returns 1 stored, 0 refused — gen below
+// the invalidation floor (an apply superseded this snapshot), cache
+// disabled, or the entry alone over budget. Oldest entries evict first
+// when the budget would overflow. Caller's buffers are copied; never
+// retained.
+int nl_cache_put(void* h, const void* key, uint64_t klen, const void* buf,
+                 uint64_t len, uint64_t gen) {
+  auto* l = static_cast<NlLoop*>(h);
+  std::lock_guard<std::mutex> lock(l->cachemu);
+  uint64_t need = klen + len + 8;
+  if (!l->cache_limit || gen < l->cache_floor || need > l->cache_limit) {
+    l->cache_rejects.fetch_add(1, std::memory_order_relaxed);
+    return 0;
+  }
+  uint64_t hv = nl_cache_hash((const char*)key, klen);
+  // replace an existing entry for the same request (a republish after
+  // an invalidation cleared the table is the common case; same-key
+  // duplicates must not accumulate)
+  auto it = l->cache.find(hv);
+  if (it != l->cache.end()) {
+    std::shared_ptr<NlCacheEntry> old;
+    for (auto& cand : it->second) {
+      if (cand->key.size() == klen &&
+          memcmp(cand->key.data(), key, klen) == 0) {
+        old = cand;  // copy FIRST: cand aliases the slot erase destroys
+        break;
+      }
+    }
+    if (old) nl_cache_erase(l, old, hv);
+  }
+  while (l->cache_bytes + need > l->cache_limit && !l->cache_fifo.empty()) {
+    auto victim = l->cache_fifo.front();
+    nl_cache_erase(l, victim,
+                   nl_cache_hash(victim->key.data(), victim->key.size()));
+  }
+  auto e = std::make_shared<NlCacheEntry>();
+  e->key.assign((const char*)key, klen);
+  uint64_t len_le = len;
+  e->reply.reserve(len + 8);
+  e->reply.append((const char*)&len_le, sizeof(len_le));
+  e->reply.append((const char*)buf, len);
+  e->gen = gen;
+  l->cache[hv].push_back(e);
+  l->cache_fifo.push_back(e);
+  l->cache_bytes += klen + e->reply.size();
+  l->cache_puts.fetch_add(1, std::memory_order_relaxed);
+  return 1;
+}
+
+// Invalidation-on-apply: raise the publish floor to `gen` and drop every
+// cached entry. Called by the engine (under its apply lock) on every
+// committed state change a cached reply could observe — a put racing
+// this call either lands first (cleared here) or arrives after with a
+// pre-bump gen (refused at the floor). Entries mid-serve survive via
+// their shared_ptr; new lookups miss immediately.
+void nl_cache_invalidate(void* h, uint64_t gen) {
+  auto* l = static_cast<NlLoop*>(h);
+  std::lock_guard<std::mutex> lock(l->cachemu);
+  if (gen > l->cache_floor) l->cache_floor = gen;
+  if (!l->cache_fifo.empty()) {
+    l->cache.clear();
+    l->cache_fifo.clear();
+    l->cache_bytes = 0;
+  }
+  l->cache_invals.fetch_add(1, std::memory_order_relaxed);
+}
+
+// out[8]: hits, misses, puts, rejects, invalidations, entries, bytes,
+// floor. Hits are frames answered with zero upcalls; misses are
+// cacheable-kind frames that fell through to the pump.
+void nl_cache_stats(void* h, uint64_t* out) {
+  auto* l = static_cast<NlLoop*>(h);
+  out[0] = l->cache_hits.load(std::memory_order_relaxed);
+  out[1] = l->cache_miss.load(std::memory_order_relaxed);
+  out[2] = l->cache_puts.load(std::memory_order_relaxed);
+  out[3] = l->cache_rejects.load(std::memory_order_relaxed);
+  out[4] = l->cache_invals.load(std::memory_order_relaxed);
+  std::lock_guard<std::mutex> lock(l->cachemu);
+  out[5] = (uint64_t)l->cache_fifo.size();
+  out[6] = l->cache_bytes;
+  out[7] = l->cache_floor;
 }
 
 }  // extern "C"

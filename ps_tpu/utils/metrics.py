@@ -62,6 +62,11 @@ class TransportStats:
     HIST_NAMES = (
         ("push_s", "ps_push_seconds", "client push op latency"),
         ("pull_s", "ps_pull_seconds", "client pull op latency"),
+        # high-QPS read path (README "Read path"): the side-effect-free
+        # READ op end to end — worker cache hits included, so this is the
+        # latency a serving caller actually feels (the bench's p99 bar)
+        ("read_s", "ps_read_seconds",
+         "client read (side-effect-free pull) op latency"),
         ("push_pull_s", "ps_push_pull_seconds",
          "client push_pull cycle latency"),
         ("cycle_s", "ps_cycle_seconds",
@@ -181,6 +186,24 @@ class TransportStats:
         self.loop_requests = 0
         self.loop_conns = 0       # gauge, not cumulative
         self.loop_upcalls = 0
+        # high-QPS read path (README "Read path"). Server side:
+        # pump-served READs and the native cache's counters (absolute
+        # values synced from nl_cache_stats on the pump's gauge tick).
+        # Worker side: local parameter-cache hits vs wire fetches,
+        # coalesced waiters (concurrent same-shard reads sharing ONE
+        # wire fetch), replica- vs primary-served wire reads, and
+        # staleness-bound fallbacks (a replica's version trailed the
+        # bound and the read re-routed to the primary).
+        self.reads_served = 0
+        self.read_native_hits = 0     # synced absolute, native owns it
+        self.read_native_misses = 0   # synced absolute
+        self.read_cache_entries = 0   # gauge, not cumulative
+        self.read_cache_bytes = 0     # gauge, not cumulative
+        self.read_cache_hits = 0
+        self.read_wire = 0
+        self.read_coalesced = 0
+        self.reads_replica = 0
+        self.read_fallbacks = 0
 
     def record_vec_send(self, nbytes: int) -> None:
         """One vectored (scatter-gather) send: ``nbytes`` of tensor payload
@@ -268,6 +291,50 @@ class TransportStats:
             self.loop_iters = int(iters)
             self.loop_requests = int(requests)
             self.loop_conns = int(conns)
+
+    def record_read_served(self) -> None:
+        """Server side: one READ answered in Python (the pump path — a
+        native-cache miss, or the threaded serve path)."""
+        with self._lock:
+            self.reads_served += 1
+
+    def set_read_cache_stats(self, hits: int, misses: int, entries: int,
+                             nbytes: int) -> None:
+        """Sync the native read cache's counters (absolute values — the
+        native side owns the counting, like set_loop_stats)."""
+        with self._lock:
+            self.read_native_hits = int(hits)
+            self.read_native_misses = int(misses)
+            self.read_cache_entries = int(entries)
+            self.read_cache_bytes = int(nbytes)
+
+    def record_read_cache(self, hit: bool) -> None:
+        """Worker side: one read served from the local parameter cache
+        (``hit``) or one that needed a wire fetch."""
+        with self._lock:
+            if hit:
+                self.read_cache_hits += 1
+            else:
+                self.read_wire += 1
+
+    def record_read_coalesced(self) -> None:
+        """Worker side: one concurrent reader shared another caller's
+        in-flight wire fetch instead of issuing its own."""
+        with self._lock:
+            self.read_coalesced += 1
+
+    def record_read_route(self, replica: bool) -> None:
+        """Worker side: one wire read served by a replica (``replica``)
+        or the primary."""
+        with self._lock:
+            if replica:
+                self.reads_replica += 1
+
+    def record_read_fallback(self) -> None:
+        """Worker side: a replica's version exceeded the staleness bound
+        and the read fell back toward the primary."""
+        with self._lock:
+            self.read_fallbacks += 1
 
     def record_upcall(self, batch: int) -> None:
         """One nl_poll upcall that handed ``batch`` requests to Python."""
@@ -385,7 +452,10 @@ class TransportStats:
                     self.repl_ack_wait_s, self.dedup_hits,
                     self.failovers, self.failover_s,
                     self.table_reroutes,
-                    self.agg_rounds, self.agg_members, self.agg_degrades)
+                    self.agg_rounds, self.agg_members, self.agg_degrades,
+                    self.reads_served, self.read_cache_hits,
+                    self.read_wire, self.read_coalesced,
+                    self.reads_replica, self.read_fallbacks)
 
     def summary(self, since: Optional[tuple] = None) -> Dict[str, float]:
         now = self.snapshot()
@@ -453,6 +523,19 @@ class TransportStats:
             out["agg_fan_in"] = round(d[28] / d[27], 3)
         if d[29] > 0:
             out["agg_degrades"] = int(d[29])
+        # read path: only reported once reads happened in the interval
+        if d[30] > 0:
+            out["reads_served"] = int(d[30])
+        if d[31] + d[32] > 0:
+            out["reads"] = int(d[31] + d[32] + d[33])
+            out["read_cache_hit_rate"] = round(
+                d[31] / (d[31] + d[32] + d[33]), 4)
+            if d[33] > 0:
+                out["read_coalesced"] = int(d[33])
+            if d[32] > 0:
+                out["replica_read_share"] = round(d[34] / d[32], 4)
+            if d[35] > 0:
+                out["read_fallbacks"] = int(d[35])
         # latency DISTRIBUTIONS (ps_tpu/obs): quantiles of everything the
         # histograms saw — lifetime, not interval (a p99 over an interval
         # delta of log buckets is computable but the lifetime tail is

@@ -1,0 +1,495 @@
+"""The high-QPS read path (README "Read path").
+
+The layered serving contracts this file pins:
+
+1. **Bitwise parity**: a native-cache HIT reply is byte-identical to the
+   pump-path MISS reply it echoes (dense and sparse), and to what a
+   thread-per-connection service encodes for the same state — the cache
+   only ever republishes Python's own bytes.
+2. **Invalidation-on-apply**: no READ observes a version older than an
+   apply whose ack the reader already saw, under a concurrent
+   reader-vs-pusher race drill; the publish-generation floor refuses a
+   pre-apply snapshot published post-apply.
+3. **Bounded staleness**: a replica trailing the bound serves ZERO reads
+   (every one falls back to the primary); within the bound, replicas
+   serve and the worker spreads across the set.
+4. **Worker cache + coalescing**: repeat reads at an unchanged version
+   cost no wire round trip; concurrent same-shard reads share ONE wire
+   fetch; version bumps (from acks or the REPLICA_STATE watcher)
+   invalidate.
+"""
+
+import threading
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import ps_tpu as ps
+from ps_tpu.backends.remote_async import (
+    AsyncPSService,
+    connect_async,
+    serve_async,
+)
+from ps_tpu.control import tensor_van as tv
+
+
+def _params():
+    return {"a/w": jnp.zeros((16, 8), jnp.float32),
+            "b/w": jnp.ones((32,), jnp.float32)}
+
+
+def _grad(x: float):
+    return {"a/w": jnp.full((16, 8), x, jnp.float32),
+            "b/w": jnp.full((32,), x, jnp.float32)}
+
+
+def _store():
+    st = ps.KVStore(optimizer="sgd", learning_rate=0.5, mode="async")
+    st.init(_params())
+    return st
+
+
+def _svc(**kw):
+    return AsyncPSService(_store(), bind="127.0.0.1", **kw)
+
+
+def _raw_read(port, payload=None):
+    ch = tv.Channel.connect("127.0.0.1", port)
+    try:
+        return bytes(ch.request(payload or tv.encode(tv.READ, 0, None)))
+    finally:
+        ch.close()
+
+
+def _cache_settled(svc, pred, timeout=3.0):
+    """Wait out the pump's ~1 s gauge/cache-stats sync cadence."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        cs = svc._nloop.cache_stats()
+        if pred(cs):
+            return cs
+    return svc._nloop.cache_stats()
+
+
+# -- bitwise parity -----------------------------------------------------------
+
+
+def test_dense_native_hit_bitwise_equals_pump_miss():
+    ps.init(backend="tpu", mode="async", num_workers=1, dc_lambda=0.0)
+    svc = _svc(native_loop=True)
+    try:
+        miss = _raw_read(svc.port)   # pump path; publishes
+        hit = _raw_read(svc.port)    # native path; echoes the publish
+        assert hit == miss
+        cs = _cache_settled(svc, lambda c: c["hits"] >= 1)
+        assert cs["hits"] >= 1 and cs["puts"] >= 1, cs
+        # and the threaded serve path encodes the same bytes for the
+        # same state: parity is structural, not per-lane
+        twin = _svc(native_loop=False)
+        try:
+            assert _raw_read(twin.port) == miss
+        finally:
+            twin.stop()
+    finally:
+        svc.stop()
+        ps.shutdown()
+
+
+def test_sparse_native_hit_bitwise_equals_pump_miss():
+    import jax
+
+    from ps_tpu.backends.remote_sparse import SparsePSService, connect_sparse
+    from ps_tpu.kv.sparse import SparseEmbedding
+
+    ps.init(backend="tpu", mode="async", num_workers=1)
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:1]), ("data",))
+    emb = SparseEmbedding(64, 8, optimizer="sgd", learning_rate=0.5,
+                          mesh=mesh)
+    emb.init(np.random.default_rng(0)
+             .normal(0, 0.01, (64, 8)).astype(np.float32))
+    svc = SparsePSService({"deep": emb}, native_loop=True)
+    try:
+        ids = np.array([3, 9, 11], np.int32)
+        payload = tv.encode(tv.READ, 0, {"deep/ids": ids})
+        miss = _raw_read(svc.port, payload)
+        hit = _raw_read(svc.port, payload)
+        assert hit == miss
+        cs = _cache_settled(svc, lambda c: c["hits"] >= 1)
+        assert cs["hits"] >= 1, cs
+        # worker API: read_rows ≡ pull rows (and versions ride the reply)
+        w = connect_sparse(f"127.0.0.1:{svc.port}", 0, {"deep": (64, 8)})
+        try:
+            read = w.read_rows({"deep": ids})
+            pulled = w.pull({"deep": ids})
+            np.testing.assert_array_equal(np.asarray(read["deep"]),
+                                          np.asarray(pulled["deep"]))
+            w.push({"deep": (ids, np.full((3, 8), 0.5, np.float32))})
+            read2 = w.read_rows({"deep": ids})
+            assert not np.array_equal(np.asarray(read2["deep"]),
+                                      np.asarray(read["deep"]))
+        finally:
+            w.close()
+    finally:
+        svc.stop()
+        ps.shutdown()
+
+
+# -- invalidation-on-apply ----------------------------------------------------
+
+
+def test_invalidation_on_apply_race_drill():
+    """A reader hammering READs while a pusher commits: every read's
+    version is monotone, and after the pusher's LAST acked push, a fresh
+    READ must carry at least that version — a stale cached reply
+    surviving an apply would fail both."""
+    ps.init(backend="tpu", mode="async", num_workers=2, dc_lambda=0.0)
+    svc = _svc(native_loop=True)
+    pusher = connect_async(f"127.0.0.1:{svc.port}", 0, _params())
+    stop = threading.Event()
+    seen = []
+    errs = []
+
+    def reader():
+        ch = tv.Channel.connect("127.0.0.1", svc.port)
+        payload = tv.encode(tv.READ, 0, None)
+        try:
+            last = -1
+            while not stop.is_set():
+                kind, _, _, extra = tv.decode(ch.request(payload))
+                assert kind == tv.OK
+                v = int(extra["version"])
+                if v < last:
+                    errs.append(f"version went backward: {last} -> {v}")
+                    return
+                last = v
+                seen.append(v)
+        except tv.VanError:
+            pass
+        finally:
+            ch.close()
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    try:
+        for i in range(25):
+            pusher.push_all(_grad(0.01 * (i + 1)))
+        final = svc._engine.version
+        # the pusher's last ack landed: a FRESH read serves >= final
+        kind, _, _, extra = tv.decode(memoryview(_raw_read(svc.port)))
+        assert kind == tv.OK and int(extra["version"]) >= final
+    finally:
+        stop.set()
+        t.join(timeout=10)
+        pusher.close()
+        svc.stop()
+        ps.shutdown()
+    assert not errs, errs
+    assert seen and max(seen) >= 1  # the race actually raced
+
+
+def test_cache_disabled_budget_zero_still_serves(monkeypatch):
+    monkeypatch.setenv("PS_NATIVE_READ_CACHE_BYTES", "0")
+    ps.init(backend="tpu", mode="async", num_workers=1, dc_lambda=0.0)
+    svc = _svc(native_loop=True)
+    try:
+        assert not svc._native_read_cache
+        r1 = _raw_read(svc.port)
+        r2 = _raw_read(svc.port)
+        assert r1 == r2  # pump path both times, same bytes
+        assert svc._nloop.cache_stats()["puts"] == 0
+    finally:
+        svc.stop()
+        ps.shutdown()
+
+
+# -- replica reads + the staleness contract -----------------------------------
+
+
+def test_backup_serves_read_refuses_push():
+    ps.init(backend="tpu", mode="async", num_workers=1, dc_lambda=0.0)
+    back = _svc(backup=True)
+    try:
+        reply = _raw_read(back.port)
+        kind, _, tensors, extra = tv.decode(memoryview(reply))
+        assert kind == tv.OK and int(extra["version"]) == 0
+        assert sorted(tensors) == sorted(_params())
+        # worker traffic stays refused with the typed retry-able shape
+        ch = tv.Channel.connect("127.0.0.1", back.port)
+        try:
+            host = {k: np.asarray(v) for k, v in _grad(1.0).items()}
+            kind, _, _, extra = tv.decode(
+                ch.request(tv.encode(tv.PUSH, 0, host)))
+            assert kind == tv.ERR and extra.get("backup") is True
+        finally:
+            ch.close()
+    finally:
+        back.stop()
+        ps.shutdown()
+
+
+def test_replica_reads_spread_within_bound():
+    ps.init(backend="tpu", mode="async", num_workers=2, dc_lambda=0.0)
+    prim = _svc()
+    back = _svc(backup=True)
+    prim.attach_backup("127.0.0.1", back.port, ack="sync")
+    uri = f"127.0.0.1:{prim.port}|127.0.0.1:{back.port}"
+    w = connect_async(uri, 0, _params(), read_staleness=0)
+    try:
+        w.push_all(_grad(0.5))
+        for _ in range(6):
+            w.read_all()
+        # sync ack: the backup is never behind an acked push, so even
+        # bound 0 lets it serve — rotation must have used it
+        assert w.transport.reads_replica >= 2
+        assert w.transport.read_fallbacks == 0
+    finally:
+        w.close()
+        prim.stop()
+        back.stop()
+        ps.shutdown()
+
+
+def test_staleness_bound_falls_back_to_primary():
+    """A backup frozen at version 0 (never attached) vs a primary at
+    version N: a bound-1 worker must route EVERY read to the primary
+    (fallbacks fire, zero replica serves = zero violations); a huge
+    bound lets the stale replica serve its old-but-bounded state."""
+    ps.init(backend="tpu", mode="async", num_workers=2, dc_lambda=0.0)
+    prim = _svc()
+    stale = _svc(backup=True)  # frozen: no stream ever attaches
+    uri = f"127.0.0.1:{prim.port}|127.0.0.1:{stale.port}"
+    w = connect_async(uri, 0, _params(), read_staleness=1)
+    try:
+        for i in range(4):
+            w.push_all(_grad(0.25))
+        for _ in range(6):
+            tree = w.read_all()
+            # the served state is the primary's post-push state, never
+            # the replica's frozen zeros-init
+            assert float(np.asarray(tree["b/w"])[0]) != 1.0
+        assert w.transport.reads_replica == 0
+        assert w.transport.read_fallbacks >= 3
+    finally:
+        w.close()
+
+    w2 = connect_async(uri, 1, _params(), read_staleness=10_000)
+    try:
+        for _ in range(6):
+            w2.read_all()
+        assert w2.transport.reads_replica >= 2  # stale-but-bounded serves
+    finally:
+        w2.close()
+        prim.stop()
+        stale.stop()
+        ps.shutdown()
+
+
+# -- worker cache + coalescing ------------------------------------------------
+
+
+def test_worker_cache_hits_until_version_bump():
+    ps.init(backend="tpu", mode="async", num_workers=2, dc_lambda=0.0)
+    svc = _svc()
+    uri = f"127.0.0.1:{svc.port}"
+    w = connect_async(uri, 0, _params(), pull_cache=True)
+    try:
+        t1 = w.read_all()
+        t2 = w.read_all()
+        t3 = w.read_all()
+        assert w.transport.read_wire == 1
+        assert w.transport.read_cache_hits == 2
+        np.testing.assert_array_equal(np.asarray(t1["a/w"]),
+                                      np.asarray(t3["a/w"]))
+        # a push ack advances versions[i] -> the cache invalidates
+        w.push_all(_grad(1.0))
+        t4 = w.read_all()
+        assert w.transport.read_wire == 2
+        assert not np.array_equal(np.asarray(t4["b/w"]),
+                                  np.asarray(t1["b/w"]))
+    finally:
+        w.close()
+        svc.stop()
+        ps.shutdown()
+
+
+def test_version_watch_invalidates_pure_reader_cache():
+    """A pure reader (never pushes) still learns of version bumps: the
+    REPLICA_STATE watcher on the heartbeat cadence advances its known
+    version, so its cached read goes stale and the next read refetches."""
+    ps.init(backend="tpu", mode="async", num_workers=2, dc_lambda=0.0)
+    svc = _svc()
+    uri = f"127.0.0.1:{svc.port}"
+    pusher = connect_async(uri, 0, _params())
+    reader = connect_async(uri, 1, _params(), pull_cache=True)
+    try:
+        reader.read_all()
+        assert reader.transport.read_wire == 1
+        pusher.push_all(_grad(2.0))
+        # the watcher polls at PS_HEARTBEAT_INTERVAL_MS (default 100 ms)
+        deadline = time.monotonic() + 5.0
+        while reader.versions[0] < 1 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert reader.versions[0] >= 1, "watcher never observed the bump"
+        reader.read_all()
+        assert reader.transport.read_wire == 2  # cache invalidated
+        assert reader._read_snaps[0]["version"] >= 1  # fresh snapshot
+    finally:
+        pusher.close()
+        reader.close()
+        svc.stop()
+        ps.shutdown()
+
+
+def test_concurrent_reads_coalesce_into_one_fetch():
+    ps.init(backend="tpu", mode="async", num_workers=2, dc_lambda=0.0)
+    svc = _svc()
+    w = connect_async(f"127.0.0.1:{svc.port}", 0, _params())
+
+    # slow the server's read handler so the fetch window is wide enough
+    # for every thread to pile in behind it
+    orig = svc._read_payload
+
+    def slow_read():
+        time.sleep(0.3)
+        return orig()
+
+    svc._read_payload = slow_read
+    try:
+        barrier = threading.Barrier(6)
+        errs = []
+
+        def one():
+            try:
+                barrier.wait(timeout=10)
+                w.read_all()
+            except BaseException as e:
+                errs.append(e)
+
+        ts = [threading.Thread(target=one, daemon=True) for _ in range(6)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(timeout=30)
+        assert not errs, errs
+        # 6 concurrent readers, at most 2 wire fetches (a second fetch
+        # may start after the first resolves); the rest shared
+        assert w.transport.read_wire <= 2
+        assert w.transport.read_coalesced >= 4
+    finally:
+        svc._read_payload = orig
+        w.close()
+        svc.stop()
+        ps.shutdown()
+
+
+def test_coalesced_waiter_refuses_stale_shared_fetch():
+    """Review-pass regression: a waiter sharing an in-flight fetch must
+    apply the SAME staleness predicate as a cache hit. If an apply ack
+    advances the known version while the fetch is in flight, its
+    pre-apply snapshot is stale for the waiter — who must refetch, not
+    return the shared result."""
+    ps.init(backend="tpu", mode="async", num_workers=2, dc_lambda=0.0)
+    svc = _svc()
+    w = connect_async(f"127.0.0.1:{svc.port}", 0, _params(),
+                      read_staleness=0)
+    orig_fetch = w._read_fetch
+    release = threading.Event()
+    entered = threading.Event()
+    calls = []
+    stale_sentinel = {"version": 0, "kv": {}}
+
+    def slow_stale_fetch(i):
+        calls.append(i)
+        if len(calls) == 1:
+            entered.set()
+            release.wait(10)       # hold the coalesce window open
+            return stale_sentinel  # a pre-apply snapshot
+        return orig_fetch(i)
+
+    w._read_fetch = slow_stale_fetch
+    try:
+        results = {}
+        t1 = threading.Thread(target=lambda: results.update(
+            a=w._read_shard(0)), daemon=True)
+        t1.start()
+        assert entered.wait(10)
+        # an apply ack lands while the fetch is in flight
+        w.versions[0] = 5
+        t2 = threading.Thread(target=lambda: results.update(
+            b=w._read_shard(0)), daemon=True)
+        t2.start()
+        time.sleep(0.2)  # t2 is parked on the in-flight record
+        release.set()
+        t1.join(timeout=10)
+        t2.join(timeout=10)
+        assert results["a"] is stale_sentinel  # the fetcher's own result
+        # the waiter REFUSED the stale share and issued its own fetch
+        assert results["b"] is not stale_sentinel
+        assert len(calls) == 2
+    finally:
+        w._read_fetch = orig_fetch
+        w.close()
+        svc.stop()
+        ps.shutdown()
+
+
+# -- knobs --------------------------------------------------------------------
+
+
+def test_read_path_knobs_roundtrip(monkeypatch):
+    from ps_tpu.config import Config
+
+    monkeypatch.setenv("PS_READ_STALENESS", "3")
+    monkeypatch.setenv("PS_PULL_CACHE", "1")
+    monkeypatch.setenv("PS_NATIVE_READ_CACHE_BYTES", "1048576")
+    monkeypatch.setenv("PS_CONNECT_MAX_WAIT_MS", "1200")
+    monkeypatch.setenv("PS_AGG_PROBE_MAX_WAIT_MS", "50")
+    cfg = Config.from_env()
+    assert cfg.read_staleness == 3
+    assert cfg.pull_cache is True
+    assert cfg.native_read_cache_bytes == 1 << 20
+    assert cfg.connect_max_wait_ms == 1200
+    assert cfg.agg_probe_max_wait_ms == 50
+    with pytest.raises(ValueError):
+        Config(read_staleness=-1)
+    with pytest.raises(ValueError):
+        Config(native_read_cache_bytes=-1)
+    with pytest.raises(ValueError):
+        Config(connect_max_wait_ms=-1)
+
+
+def test_connect_budget_env_bounds_dead_dial(monkeypatch):
+    """PS_CONNECT_MAX_WAIT_MS caps the dial's total backoff sleep: a
+    dead fast-refusing address fails in ~the budget, not the 15 s
+    default patience."""
+    monkeypatch.setenv("PS_CONNECT_MAX_WAIT_MS", "200")
+    t0 = time.monotonic()
+    with pytest.raises(tv.VanError):
+        tv.Channel.connect("127.0.0.1", 1, timeout_ms=200, retries=50)
+    assert time.monotonic() - t0 < 5.0
+
+
+# -- aggregator members read through the coalesced snapshot -------------------
+
+
+def test_aggregator_serves_member_reads():
+    ps.init(backend="tpu", mode="async", num_workers=2, dc_lambda=0.0)
+    from ps_tpu.backends.aggregator import AggregatorService
+
+    store = ps.KVStore(optimizer="sgd", learning_rate=0.5, mode="async")
+    store.init(_params())
+    shard = serve_async(store, bind="127.0.0.1")
+    agg = AggregatorService(f"127.0.0.1:{shard.port}", _params(),
+                            group_size=2, bind="127.0.0.1")
+    try:
+        r1 = _raw_read(agg.port)
+        r2 = _raw_read(agg.port)
+        assert r1 == r2
+        kind, _, tensors, extra = tv.decode(memoryview(r1))
+        assert kind == tv.OK and sorted(tensors) == sorted(_params())
+    finally:
+        agg.stop()
+        shard.stop()
+        ps.shutdown()

@@ -55,7 +55,8 @@ COLS = [
     ("epoch", 5), ("version", 9),
     ("applies", 9), ("lag", 5), ("repl", 14), ("dedup", 6), ("stale", 6),
     ("moved", 8), ("gbps", 7), ("ack_p99_ms", 10), ("bkt_p99_ms", 10),
-    ("loop", 10),
+    ("loop", 10), ("reads", 8), ("nhit%", 6), ("chit%", 6),
+    ("rshare%", 7),
 ]
 
 COORD_COLS = [
@@ -87,15 +88,26 @@ def poll_endpoint(host: str, port: int, timeout_ms: int = 2000) -> dict:
 
 def poll_fleet(uri: str) -> list:
     """STATS for every member of every shard's replica set, flattened to
-    ``[{shard, addr, ...stats}]`` in URI order."""
+    ``[{shard, addr, ...stats}]`` in URI order. Each shard's rows are
+    annotated with the set-wide read-replica share (backup-role rows'
+    answered reads over the whole set's)."""
     _, sets = parse_replica_uri(uri)
     rows = []
     for shard, members in enumerate(sets):
+        shard_rows = []
         for host, port in members:
             st = poll_endpoint(host, port)
             st["shard"] = shard
             st["addr"] = f"{host}:{port}"
-            rows.append(st)
+            shard_rows.append(st)
+        totals = [(_reads_total(st), st.get("role")) for st in shard_rows]
+        total = sum(t for t, _ in totals if isinstance(t, int))
+        if total:
+            backup = sum(t for t, role in totals
+                         if isinstance(t, int) and role == "backup")
+            for st in shard_rows:
+                st["_rshare"] = round(100.0 * backup / total, 1)
+        rows.extend(shard_rows)
     return rows
 
 
@@ -120,7 +132,9 @@ def render_row(st: dict) -> dict:
                 "version": "-",
                 "applies": "-", "lag": "-", "repl": st["error"][:24],
                 "dedup": "-", "stale": "-", "moved": "-", "gbps": "-",
-                "ack_p99_ms": "-", "bkt_p99_ms": "-", "loop": "-"}
+                "ack_p99_ms": "-", "bkt_p99_ms": "-", "loop": "-",
+                "reads": "-", "nhit%": "-", "chit%": "-",
+                "rshare%": "-"}
     repl = st.get("repl") or {}
     # a live session renders "<ack mode>@<acked seq>" so an operator sees
     # the stream advancing between refreshes; degraded wins the cell
@@ -161,7 +175,49 @@ def render_row(st: dict) -> dict:
         "loop": (f"{st['loop'].get('conns', 0)}c/"
                  f"{st['loop'].get('requests', 0)}r"
                  if isinstance(st.get("loop"), dict) else "-"),
+        # serve-path read columns (README "Read path"): total READs this
+        # endpoint answered (native hits + Python-served) and the
+        # native-cache hit share. Backups answering reads show up as
+        # their own rows, so the read-replica share of a shard is its
+        # backup rows' reads over the set's total.
+        "reads": _reads_total(st),
+        "nhit%": _native_hit_pct(st),
+        "chit%": _cached_read_pct(st),
+        # computed across the shard's replica set by poll_fleet: the
+        # backup rows' reads over the whole set's (same value on every
+        # row of a shard — the read-replica share of its traffic)
+        "rshare%": _opt(st.get("_rshare")),
     }
+
+
+def _reads_total(st: dict):
+    rd = st.get("read")
+    if not isinstance(rd, dict):
+        return "-"
+    return int(rd.get("native_hits", 0)) + int(rd.get("served", 0))
+
+
+def _cached_read_pct(st: dict):
+    """Share of ALL answered reads that came from the native cache
+    (hits over hits + Python-served) — the zero-upcall fraction of the
+    endpoint's total read traffic."""
+    rd = st.get("read")
+    if not isinstance(rd, dict):
+        return "-"
+    hits = int(rd.get("native_hits", 0))
+    total = hits + int(rd.get("served", 0))
+    return round(100.0 * hits / total, 1) if total else "-"
+
+
+def _native_hit_pct(st: dict):
+    """Native-cache hit share over CACHEABLE frames (hits vs pump-path
+    misses) — the zero-upcall fraction of the endpoint's read serving."""
+    rd = st.get("read")
+    if not isinstance(rd, dict):
+        return "-"
+    hits = int(rd.get("native_hits", 0))
+    total = hits + int(rd.get("native_misses", 0))
+    return round(100.0 * hits / total, 1) if total else "-"
 
 
 def _opt(v):
