@@ -1213,6 +1213,63 @@ def bench_serve(args, retried: bool):
     detail["read_scaling"] = round(
         layered_qps[nmax] / max(primary_qps[nmax], 1e-9), 2)
 
+    # -- in-loop telemetry overhead (README "Native observability"): the
+    # stats must not tax the path they measure. Same members, same
+    # pusher, same reader count; ALTERNATE stats-off / stats-on windows
+    # (adjacent same-config windows on a 2-core sandboxed host differ by
+    # more than the real cost — two clock reads + a few relaxed atomics
+    # per frame) and take best-of per leg, the transport bench's
+    # telemetry-A/B discipline. Quiet-hardware bar < 2%.
+    n_ab = reader_counts[0]
+    off_qps, on_qps = [], []
+    for _ in range(2):
+        for s_ in (prim, back):
+            s_._nloop.telemetry_config(False, 0)
+        total, dt = run_readers(members, n_ab, window_s)
+        off_qps.append(total / dt)
+        for s_ in (prim, back):
+            s_._nloop.telemetry_config(True, int(250e6))
+        total, dt = run_readers(members, n_ab, window_s)
+        on_qps.append(total / dt)
+    detail["nl_stats_off_qps"] = round(max(off_qps), 1)
+    detail["nl_stats_on_qps"] = round(max(on_qps), 1)
+    detail["telemetry_overhead_pct"] = round(
+        100.0 * (1.0 - max(on_qps) / max(off_qps)), 2)
+
+    # -- the zero-upcall path is VISIBLE end to end: its latency lands in
+    # ps_nl_read_hit_seconds (native striped buckets), which the pump
+    # syncs into the registry — scrape this process's /metrics and report
+    # the registry-side p99 next to the raw native-state quantile
+    import urllib.request
+
+    from ps_tpu import obs as _obs
+    from ps_tpu.obs.metrics import Histogram as _Hist
+
+    st_nl = prim._nloop.hist_snapshots().get("nl_read_hit_s")
+    detail["native_hit_p99_us"] = (
+        round(_Hist.from_state("ps_nl_read_hit_seconds", st_nl)
+              .quantile(0.99) * 1e6, 2)
+        if st_nl and st_nl["n"] else None)
+    msrv = _obs.start_metrics_server(0)
+    nl_metrics = {"on_metrics": False, "count": 0, "p99_ms": None}
+    deadline = time.time() + 4.0  # the pump syncs ~1/s
+    while time.time() < deadline:
+        text = urllib.request.urlopen(
+            f"http://127.0.0.1:{msrv.port}/metrics",
+            timeout=5).read().decode()
+        cnt = [ln for ln in text.splitlines()
+               if ln.startswith("ps_nl_read_hit_seconds_count")]
+        if cnt and float(cnt[0].split()[-1]) > 0:
+            nl_metrics["on_metrics"] = True
+            nl_metrics["count"] = int(float(cnt[0].split()[-1]))
+            s_reg = (_obs.default_registry().snapshot()
+                     .get("ps_nl_read_hit_seconds") or {})
+            if s_reg.get("p99") is not None:
+                nl_metrics["p99_ms"] = round(s_reg["p99"] * 1e3, 4)
+            break
+        time.sleep(0.3)
+    detail["nl_read_hit_metrics"] = nl_metrics
+
     # end-to-end read latency the serving caller feels (worker path:
     # decode + staleness check + tree rebuild included)
     rw = connect_async(uri, 1, params, read_staleness=2)

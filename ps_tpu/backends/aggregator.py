@@ -56,6 +56,7 @@ from typing import Dict, List, Optional
 
 import numpy as np
 
+from ps_tpu import obs
 from ps_tpu.backends.common import (
     AGG_WORKER_BASE,
     DEFAULT_BUCKET_BYTES,
@@ -222,7 +223,8 @@ class AggregatorService(VanService):
             "state": "filling",          # -> flush -> flushing -> done
             "members": {},               # worker -> grad tree (host kv)
             "tokens": {},                # worker -> (pnonce, pseq)
-            "deadline": None,            # armed by the first stager
+            "tcs": {},                   # worker -> TraceContext (traced
+            "deadline": None,            # members only)
             "kv": None,                  # post-flush params snapshot
             "version": None,
             "error": None,
@@ -269,32 +271,57 @@ class AggregatorService(VanService):
     def _do_flush(self, r: dict) -> None:
         t0 = time.perf_counter()
         try:
-            order = sorted(r["members"])  # deterministic merge order
-            merged: Dict[str, np.ndarray] = {}
-            for w in order:
-                tree = r["members"][w]
-                if not merged:
-                    # own-memory accumulator (member trees may view
-                    # request frames that die at their reply)
-                    merged = {k: np.array(v) for k, v in tree.items()}
-                else:
-                    for k, v in tree.items():
-                        merged[k] += v
-            r["members"] = None  # release the members' frame views early
-            members = {str(w): [t[0], int(t[1])]
-                       for w, t in r["tokens"].items()
-                       if t is not None and t[1] is not None}
-            # ONE upstream round trip: apply the merged tree and bring
-            # the post-apply snapshot back — it answers the whole group's
-            # pulls for this round
-            with self._ulock:
-                params = self._client.push_pull(merged,
-                                                members=members or None)
-                version = self._client.version
-            kv, _ = keymod.flatten_with_keys(params)
-            r["kv"] = {k: np.ascontiguousarray(np.asarray(v))
-                       for k, v in kv.items()}
-            r["version"] = version
+            # trace the merge when any constituent was traced: the merge
+            # span parents to the FIRST traced member's serve span
+            # (deterministic — lowest worker id) and names the rest, and
+            # staying open across the upstream push_pull parents the
+            # upstream op span — and through it the shard's dispatch /
+            # server_apply / replica_append spans — into the member's
+            # trace: the worker→aggregator→shard chain is ONE trace.
+            tcs = r.get("tcs") or {}
+            if tcs:
+                mspan = obs.tracer().span("agg_merge", cat="aggregator",
+                                          parent=tcs[min(tcs)])
+            else:
+                mspan = obs.NOOP
+            with mspan as sp:
+                if sp:
+                    sp.set(group=self.group, members=sorted(r["tokens"]),
+                           member_traces={str(w): c.trace_id
+                                          for w, c in tcs.items()})
+                order = sorted(r["members"])  # deterministic merge order
+                merged: Dict[str, np.ndarray] = {}
+                for w in order:
+                    tree = r["members"][w]
+                    if not merged:
+                        # own-memory accumulator (member trees may view
+                        # request frames that die at their reply)
+                        merged = {k: np.array(v) for k, v in tree.items()}
+                    else:
+                        for k, v in tree.items():
+                            merged[k] += v
+                r["members"] = None  # release members' frame views early
+                members = {str(w): [t[0], int(t[1])]
+                           for w, t in r["tokens"].items()
+                           if t is not None and t[1] is not None}
+                # the merged push carries every constituent's trace
+                # context BESIDE its dedup token: the shard's apply span
+                # names the member traces it commits for, so any one
+                # member's trace finds the shared upstream commit
+                members_tc = {str(w): [c.trace_id, c.span_id]
+                              for w, c in tcs.items()}
+                # ONE upstream round trip: apply the merged tree and
+                # bring the post-apply snapshot back — it answers the
+                # whole group's pulls for this round
+                with self._ulock:
+                    params = self._client.push_pull(
+                        merged, members=members or None,
+                        members_tc=members_tc or None)
+                    version = self._client.version
+                kv, _ = keymod.flatten_with_keys(params)
+                r["kv"] = {k: np.ascontiguousarray(np.asarray(v))
+                           for k, v in kv.items()}
+                r["version"] = version
         except BaseException as e:  # surfaced at every parked member
             r["error"] = e
         if r["error"] is None:
@@ -332,6 +359,9 @@ class AggregatorService(VanService):
             raise KeyError("push keys do not match the registered tree")
         t0 = time.perf_counter()
         token = (extra.get("pnonce"), extra.get("pseq"))
+        # the serve span opened by _dispatch is current on THIS thread;
+        # its context is what the flusher's merge span parents to
+        ctx = obs.tracer().current()
         with self._rcv:
             while True:
                 if self._draining:
@@ -349,6 +379,8 @@ class AggregatorService(VanService):
                 self._rcv.wait(0.05)
             r["members"][worker] = tree
             r["tokens"][worker] = token
+            if ctx is not None:
+                r["tcs"][worker] = ctx
             if r["deadline"] is None:
                 r["deadline"] = time.monotonic() + self._flush_timeout
             if len(r["members"]) >= self.group_size:

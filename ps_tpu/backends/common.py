@@ -529,8 +529,17 @@ class BucketedTransportMixin:
                 ...  # sp.wire() propagates the context, None unsampled
 
         The span/histogram cover the op end to end, failover retries
-        included — the latency a training loop actually feels."""
-        sp = obs.tracer().span(name, cat="worker")
+        included — the latency a training loop actually feels.
+
+        A nested hop — an op issued while a traced request is being
+        SERVED on this thread (the aggregator's merged upstream flush,
+        its coalesced pull) — parents to the open span instead of
+        rooting a new trace: the worker→aggregator→shard chain stays ONE
+        trace, and the aggregator's client ops never mint phantom
+        \"steps\". Training threads have no open span, so ordinary
+        worker ops root exactly as before."""
+        parent = obs.tracer().current()
+        sp = obs.tracer().span(name, cat="worker", parent=parent)
         if sp:
             sp.set(worker=getattr(self, "worker", 0), **args)
         return _OpScope(self.transport, name, sp)

@@ -354,8 +354,13 @@ class AsyncPSService(VanService):
         # NOT applies and record nothing.
         t_apply = time.perf_counter()
         apply_s = None
-        with obs.tracer().child("server_apply", cat="server"), \
-                self._engine._lock:
+        apply_span = obs.tracer().child("server_apply", cat="server")
+        if extra.get("members_tc"):
+            # a merged push: the constituents' trace contexts ride beside
+            # their dedup tokens — naming them on the apply span lets any
+            # ONE member's trace find the shared upstream commit
+            apply_span.set(members_tc=extra["members_tc"])
+        with apply_span, self._engine._lock:
             while (self._paused and not self._draining
                    and not self._admit_while_paused(worker)):
                 self._pause_wait_begin()
@@ -2351,7 +2356,8 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                     chs.pop(i, None)
                     bad[i] = time.monotonic() + 2.0
 
-    def push_all(self, grads, members: Optional[dict] = None) -> None:
+    def push_all(self, grads, members: Optional[dict] = None,
+                 members_tc: Optional[dict] = None) -> None:
         """Push a gradient tree; each owner applies its subtree immediately
         with the DC-ASGD correction against this worker's last pull from it.
 
@@ -2373,13 +2379,15 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                 self._with_failover(
                     lambda: self._push_buckets_sync(self._split_kv(kv),
                                                     pseq=pseq, tc=tc,
-                                                    members=members))
+                                                    members=members,
+                                                    members_tc=members_tc))
                 return
 
             def once():
                 msgs = self._fanout({
                     i: self._encode_serial_push(tv.PUSH, sub, pseq=pseq,
-                                                tc=tc, members=members)
+                                                tc=tc, members=members,
+                                                members_tc=members_tc)
                     for i, sub in self._split_kv(kv).items()
                 })
                 for i, msg in msgs.items():
@@ -2390,7 +2398,8 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
 
             self._with_failover(once)
 
-    def push_pull(self, grads, members: Optional[dict] = None) -> Any:
+    def push_pull(self, grads, members: Optional[dict] = None,
+                  members_tc: Optional[dict] = None) -> Any:
         """push_all + pull_all in ONE round trip per server (the async
         cycle), all servers in flight concurrently. Routed through the
         bucketed pipeline when the worker was connected with
@@ -2407,7 +2416,8 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
 
                 def once_bucketed():
                     self._push_buckets_sync(self._split_kv(kv), pseq=pseq,
-                                            tc=tc, members=members)
+                                            tc=tc, members=members,
+                                            members_tc=members_tc)
                     return self._merge_host_params(self._pull_buckets(tc=tc))
 
                 return self._with_failover(once_bucketed)
@@ -2415,7 +2425,8 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                 lambda: self._merge_params(self._fanout({
                     i: self._encode_serial_push(tv.PUSH_PULL, sub,
                                                 pseq=pseq, tc=tc,
-                                                members=members)
+                                                members=members,
+                                                members_tc=members_tc)
                     for i, sub in self._split_kv(kv).items()
                 })))
 
@@ -2423,15 +2434,19 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
 
     def _encode_serial_push(self, kind: int, sub: Dict[str, np.ndarray],
                             pseq: Optional[int] = None, tc=None,
-                            members: Optional[dict] = None):
+                            members: Optional[dict] = None,
+                            members_tc: Optional[dict] = None):
         """One serial push frame, compressed per the policy (the packed-key
         list rides the frame's extra, as on the bucketed path) and tagged
         with the (nonce, seq) dedup token plus the op's trace context
         (``tc``, when sampled). ``members`` is the aggregator's
-        constituent-token map for a merged push (None otherwise). With
-        ``writev`` on, the frame travels as zero-copy parts — the grad
-        tensors go to the kernel as iovecs instead of through a staging
-        bytearray (the measurable serial-path win at BERT-size trees)."""
+        constituent-token map for a merged push (None otherwise), and
+        ``members_tc`` the constituents' trace contexts riding beside
+        those tokens — the shard's apply span names them so each member's
+        trace finds the shared upstream commit. With ``writev`` on, the
+        frame travels as zero-copy parts — the grad tensors go to the
+        kernel as iovecs instead of through a staging bytearray (the
+        measurable serial-path win at BERT-size trees)."""
         sub, enc = self._encode_push_tree(sub)
         extra = {}
         if enc:
@@ -2441,6 +2456,8 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
             extra["pnonce"] = self._transport_nonce
         if members:
             extra["members"] = members
+        if members_tc:
+            extra["members_tc"] = members_tc
         if tc is not None:
             extra[obs.WIRE_KEY] = tc
         extra = extra or None
@@ -2458,7 +2475,8 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
 
     def _push_buckets_sync(self, by_owner: Dict[int, Dict[str, np.ndarray]],
                            pseq: Optional[int] = None, tc=None,
-                           members: Optional[dict] = None) -> None:
+                           members: Optional[dict] = None,
+                           members_tc: Optional[dict] = None) -> None:
         """Slice each owner's subtree into fusion buckets, stripe them over
         the connection pool, wait for every ack, and adopt the committed
         versions. The engine sees ONE whole-tree apply per server, exactly
@@ -2492,6 +2510,8 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                          "enc": enc}
                 if members:
                     extra["members"] = members
+                if members_tc:
+                    extra["members_tc"] = members_tc
                 if tc is not None:
                     extra[obs.WIRE_KEY] = tc
                 payload = enc_bucket(tv.BUCKET_PUSH, self.worker, sub, b,

@@ -75,6 +75,35 @@ def row_range(shard: int, num_shards: int, total_rows: int) -> Tuple[int, int]:
     return lo, min(lo + per, total_rows)
 
 
+#: per-key read-cache invalidation (README "Native observability" /
+#: ROADMAP PR-12 follow-up): cached READ entries are tagged with one u64
+#: per (table, GLOBAL row id) they cover, and a sparse row apply
+#: invalidates only the intersecting entries — untouched hot id-sets keep
+#: serving natively. Over these caps the path degrades to the old
+#: conservative behavior (an untagged publish drops on any invalidation;
+#: an over-cap apply drops everything) rather than burning CPU on tag
+#: arithmetic for huge batches.
+READ_TAG_CAP = 128
+APPLY_TAG_CAP = 512
+
+
+def _table_hash(name: str) -> int:
+    """Stable 64-bit seed per table name (process-local use only — tags
+    never cross a process boundary)."""
+    import hashlib
+
+    return int.from_bytes(
+        hashlib.blake2b(name.encode(), digest_size=8).digest(), "little")
+
+
+def _row_tags(table_hash: int, ids: np.ndarray) -> set:
+    """One mix-hashed u64 tag per (table, global row id)."""
+    mask = (1 << 64) - 1
+    return {(table_hash ^ ((int(i) + 0x9E3779B97F4A7C15)
+                          * 0xBF58476D1CE4E5B9)) & mask
+            for i in np.asarray(ids).ravel().tolist()}
+
+
 def dedupe_rows_np(ids: np.ndarray, grads: np.ndarray
                    ) -> Tuple[np.ndarray, np.ndarray]:
     """Worker-side pre-push merge (SURVEY.md §4c: "dedupe/sum duplicate
@@ -348,9 +377,13 @@ class SparsePSService(VanService):
                 self._tables[name].push(ids, grads)
                 self.versions[name] += 1
                 self.rows_applied[name] += int(ids.size)
-            # invalidation-on-apply (README "Read path"): any cached
-            # hot-id-set reply may include rows this push just rewrote
-            self._invalidate_reads()
+            # invalidation-on-apply (README "Read path"), PER KEY: only
+            # cached id-sets intersecting the applied rows drop (their
+            # bytes changed); disjoint hot sets keep serving natively.
+            # The generation floor still rises for everyone, so an
+            # in-flight pre-apply publish is refused either way.
+            self._invalidate_reads(
+                tags=self._tags_for(per_table, APPLY_TAG_CAP))
             apply_s = _ptime.perf_counter() - t_apply
             if pseq is not None:
                 self._applied_pseq[worker] = (pnonce, int(pseq),
@@ -408,9 +441,39 @@ class SparsePSService(VanService):
             gen = self._read_gen_snapshot()
         reply = tv.encode(tv.OK, 0, out, extra={"versions": versions,
                                                 "version": self._vsum(versions)})
-        self._note_read_snapshot(gen, self._vsum(versions))
+        # tag the publish with the id-set it covers, so a disjoint row
+        # apply leaves the cached entry serving (per-key invalidation)
+        self._note_read_snapshot(gen, self._vsum(versions),
+                                 tags=self._tags_for(per_table,
+                                                     READ_TAG_CAP))
         self.transport.record_read_served()
         return reply
+
+    def _tbl_hash(self, name: str) -> int:
+        cache = getattr(self, "_table_hashes", None)
+        if cache is None:
+            cache = self._table_hashes = {}
+        h = cache.get(name)
+        if h is None:
+            h = cache[name] = _table_hash(name)
+        return h
+
+    def _tags_for(self, per_table, cap: int):
+        """Invalidation tags for one request/apply's GLOBAL id-sets, or
+        None past ``cap`` (degrade to the conservative untagged/full
+        behavior). The id COUNT gates before any hashing — a 100k-row
+        embedding push must cost zero tag arithmetic under the apply
+        lock, not build-then-discard a 100k-element set."""
+        if sum(int(np.asarray(t["ids"]).size)
+               for t in per_table.values()) > cap:
+            return None
+        tags: set = set()
+        for name, t in per_table.items():
+            tags |= _row_tags(self._tbl_hash(name), t["ids"])
+            if len(tags) > cap:
+                return None  # unreachable in practice (dedup only
+                # shrinks), kept as the hard bound
+        return sorted(tags) if tags else None
 
     @staticmethod
     def _vsum(versions) -> int:
@@ -653,13 +716,16 @@ class SparsePSService(VanService):
             raise ValueError(f"unknown replica op {op!r}")
         tree = decode_tree(dict(tensors), extra.get("enc"),
                            stats=self.transport)
-        for name, t in self._split(tree).items():
+        split = self._split(tree)
+        for name, t in split.items():
             ids = self._localize(name, np.array(t["ids"]))
             grads = np.array(t["grads"])  # own memory past the frame
             self._tables[name].push(ids, grads)
             self.versions[name] += 1
             self.rows_applied[name] += int(ids.size)
-        self._invalidate_reads()  # replica reads go stale per applied entry
+        # per-key, like the primary's apply: a backup's cached reads for
+        # disjoint id-sets stay valid across this replicated row apply
+        self._invalidate_reads(tags=self._tags_for(split, APPLY_TAG_CAP))
         if extra.get("pseq") is not None:
             self._applied_pseq[worker] = (extra.get("pnonce"),
                                           int(extra["pseq"]),

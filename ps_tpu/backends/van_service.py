@@ -377,6 +377,23 @@ class VanService:
             if cache_bytes:
                 self._nloop.cache_config(tv.READ, cache_bytes)
                 self._native_read_cache = True
+        # in-loop native telemetry (README "Native observability"):
+        # PS_NL_STATS arms the loop's own lock-free histograms (frame
+        # read, queue wait, native read-hit serve, tail flush — the
+        # ps_nl_* families) and PS_NL_SLOW_FRAME_MS the slow-frame
+        # watchdog; both validated service-level reads (pslint PSL406),
+        # strict=False — observability knobs must never take a service
+        # down with them
+        self._nl_stats = False
+        if self._nloop is not None:
+            from ps_tpu.config import env_float as _env_float
+
+            self._nl_stats = env_flag("PS_NL_STATS", True)
+            slow_ms = _env_float("PS_NL_SLOW_FRAME_MS", 250.0, lo=0.0,
+                                 strict=False)
+            self._nloop.telemetry_config(
+                self._nl_stats,
+                int(slow_ms * 1e6) if self._nl_stats else 0)
         if self._nloop is not None:
             self._loop_conn_gauge = obs.default_registry().gauge(
                 "ps_van_live_connections",
@@ -569,11 +586,17 @@ class VanService:
         with self._read_gen_lock:
             return self._read_gen
 
-    def _invalidate_reads(self) -> None:
+    def _invalidate_reads(self, tags=None) -> None:
         """Invalidation-on-apply: call after ANY committed state change a
         cached READ reply could observe (engine applies, replica-stream
-        applies, migration cutovers, promotion, drain). Cheap no-op when
-        the native cache is off."""
+        applies, migration cutovers, promotion, drain). ``tags``
+        optionally names the touched state slice (the sparse service's
+        per-(table, row) hashes): the publish floor still rises — an
+        in-flight pre-apply publish is refused either way — but only
+        cached entries whose tag set intersects are dropped, so hot
+        id-sets disjoint from the apply keep serving natively. None (the
+        dense services, and every structural change) drops everything.
+        Cheap no-op when the native cache is off."""
         if not self._native_read_cache:
             return
         with self._read_gen_lock:
@@ -581,15 +604,18 @@ class VanService:
             gen = self._read_gen
         nloop = self._nloop
         if nloop is not None:
-            nloop.cache_invalidate(gen)
+            nloop.cache_invalidate(gen, tags=tags)
 
-    def _note_read_snapshot(self, gen: int, version: int) -> None:
+    def _note_read_snapshot(self, gen: int, version: int,
+                            tags=None) -> None:
         """READ handlers record the (generation, version) their reply
-        serializes; the pump publishes the encoded frame into the native
-        cache under exactly that generation. Thread-local: handlers run
-        on the pump or punted threads."""
+        serializes — plus, optionally, the invalidation ``tags`` naming
+        the rows it covers; the pump publishes the encoded frame into the
+        native cache under exactly that generation (and those tags).
+        Thread-local: handlers run on the pump or punted threads."""
         self._read_pub.gen = gen
         self._read_pub.version = int(version)
+        self._read_pub.tags = tags
 
     def promote(self, reason: str = "request") -> int:
         """The backup→primary transition (idempotent): under the apply
@@ -760,9 +786,19 @@ class VanService:
             # native event-loop serve path: live connections + frames
             # read — the cell ps_top renders per shard (iterations and
             # upcall-batch distributions ride the /metrics gauges and
-            # the fleet-telemetry counters instead)
-            out["loop"] = {"conns": self.transport.loop_conns,
-                           "requests": self.transport.loop_requests}
+            # the fleet-telemetry counters instead) — plus the in-loop
+            # p99s ps_top's nlp99/qw99 columns and ps_doctor's native
+            # section render (µs: these are sub-ms surfaces)
+            loop = {"conns": self.transport.loop_conns,
+                    "requests": self.transport.loop_requests,
+                    "slow_frames": self.transport.nl_slow_frames}
+            s = self.transport.hist["nl_read_hit_s"].summary()
+            if s:
+                loop["nlp99_us"] = round(s["p99"] * 1e6, 1)
+            s = self.transport.hist["nl_queue_wait_s"].summary()
+            if s:
+                loop["qw99_us"] = round(s["p99"] * 1e6, 1)
+            out["loop"] = loop
         return out
 
     # -- bucketed-push staging -------------------------------------------------
@@ -1082,6 +1118,8 @@ class VanService:
                     self._read_lag_gauge.set(
                         max(0, int(v) - self._read_pub_version)
                         if v is not None and cs["entries"] else 0)
+                if self._nl_stats:
+                    self._sync_nl_telemetry(nloop)
             if batch is None:
                 return
             if not batch:
@@ -1107,6 +1145,44 @@ class VanService:
                     with self._inflight_cond:
                         self._inflight -= 1
                         self._inflight_cond.notify_all()
+
+    def _sync_nl_telemetry(self, nloop) -> None:
+        """Fold the loop's own telemetry into this service's stats (the
+        pump's ~1/s gauge tick): the in-loop histograms land ABSOLUTE in
+        the ps_nl_* TransportStats families — the native stripes own the
+        counting — so they ride /metrics, STATS frames, and the
+        delta-encoded fleet telemetry exactly like every Python-recorded
+        surface; and the slow-frame ring drains into ``slow_frame``
+        flight events, each with a reconstructed span when the frame
+        carried a trace context (the zero-upcall path cannot open spans
+        itself — this is where one hiccup on it becomes a traceable
+        incident instead of a p999 mystery)."""
+        self.transport.set_nl_hists(nloop.hist_snapshots())
+        ns = nloop.stats_snapshot()
+        self.transport.set_nl_stats(ns["slow_frames"],
+                                    ns["tail_backlog_bytes"])
+        for fr in nloop.slow_drain():
+            total_ns = fr["read_ns"] + fr["wait_ns"] + fr["serve_ns"]
+            obs.record_event(
+                "slow_frame", conn=fr["conn"],
+                wire_kind=tv.kind_name(fr["kind"]), size=fr["size"],
+                read_ms=round(fr["read_ns"] / 1e6, 3),
+                wait_ms=round(fr["wait_ns"] / 1e6, 3),
+                serve_ms=round(fr["serve_ns"] / 1e6, 3),
+                total_ms=round(total_ns / 1e6, 3),
+                trace_id=fr["trace_id"] or None)
+            if fr["trace_id"]:
+                obs.tracer().record_external(
+                    "slow_frame", "server", fr["trace_id"],
+                    fr["span_id"] or None,
+                    ts_us=time.time() * 1e6
+                    - (fr["age_ns"] + total_ns) / 1e3,
+                    dur_us=total_ns / 1e3,
+                    conn=fr["conn"], wire_kind=tv.kind_name(fr["kind"]),
+                    size=fr["size"],
+                    read_us=round(fr["read_ns"] / 1e3, 1),
+                    wait_us=round(fr["wait_ns"] / 1e3, 1),
+                    serve_us=round(fr["serve_ns"] / 1e3, 1))
 
     def _punt_pool(self) -> "_DaemonPool":
         """Lazily-built pool for non-blocker punted requests (threads
@@ -1282,6 +1358,7 @@ class VanService:
             if raw is not None:
                 self._read_pub.gen = None  # pool/pump threads are reused:
                 # never publish under a PREVIOUS request's generation
+                self._read_pub.tags = None  # (nor its row tags)
             reply = self._dispatch_reply_payload(kind, worker, tensors,
                                                  extra)
             if raw is not None and isinstance(reply, (bytes, bytearray)):
@@ -1292,7 +1369,9 @@ class VanService:
                     # bytes — hit replies are bitwise identical to this
                     # pump reply BY CONSTRUCTION (the cache only echoes).
                     # A put raced by an apply is refused at the floor.
-                    if nloop.cache_put(raw, reply, gen):
+                    if nloop.cache_put(raw, reply, gen,
+                                       tags=getattr(self._read_pub,
+                                                    "tags", None)):
                         self._read_pub_version = int(
                             getattr(self._read_pub, "version", 0))
             try:

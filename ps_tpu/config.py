@@ -62,6 +62,16 @@ Environment variables honored by :meth:`Config.from_env`:
   zero-upcall READ serving path (README "Read path"); entries are
   published on READ misses and invalidated on every apply. 0 disables;
   default 64 MiB. Only meaningful with PS_VAN_NATIVE_LOOP=1
+- ``PS_NL_STATS``             — '0' disarms the native event loop's own
+  in-loop telemetry (the lock-free striped ``ps_nl_*`` histograms: frame
+  read latency, ready-queue wait, native READ-hit serve time, tail-flush
+  latency — README "Native observability"); default on, measured < 2%
+  on the zero-upcall serve path it instruments
+- ``PS_NL_SLOW_FRAME_MS``     — slow-frame watchdog threshold: any frame
+  whose in-loop latency exceeds this records a bounded native ring entry
+  (kind, size, conn, per-stage timings, propagated trace id) that the
+  pump drains into a ``slow_frame`` flight event with a reconstructed
+  span (default 250; 0 disarms; needs PS_NL_STATS on)
 - ``PS_READ_STALENESS``     — worker side: how many VERSIONS a replica-
   served READ may trail the last-known primary version before the read
   falls back to the primary (default 0 = replicas serve only what is
@@ -311,6 +321,22 @@ class Config:
         zero upcalls on byte-identical repeats; invalidated on every
         apply. 0 disables (every READ takes the pump); only meaningful
         with van_native_loop.
+      nl_stats: the native event loop's own in-loop telemetry (README
+        "Native observability"): lock-free per-loop-thread striped
+        histograms — frame read latency, ready-queue wait, native
+        READ-hit service time, EPOLLOUT tail-flush latency — synced into
+        the ``ps_nl_*`` metric families on the pump's gauge tick, riding
+        /metrics, STATS and fleet telemetry like every other surface.
+        On by default; the off path is the pre-telemetry loop plus one
+        relaxed load per frame.
+      nl_slow_frame_ms: slow-frame watchdog threshold in milliseconds —
+        a frame whose in-loop latency (read + queue wait, or read +
+        native serve) exceeds it leaves a bounded native ring entry with
+        per-stage timings and the request's propagated trace id; the
+        pump turns each into a ``slow_frame`` flight event plus a
+        reconstructed span, so one hiccup on the zero-upcall path is a
+        traceable incident instead of a p999 mystery. 0 disarms the
+        watchdog; needs nl_stats.
       read_staleness: worker side — the bounded-staleness contract of
         replica reads, in VERSIONS: a backup whose READ reply trails
         the worker's last-known primary version by more than this is
@@ -474,6 +500,11 @@ class Config:
     native_read_cache_bytes: int = 64 << 20
     read_staleness: int = 0
     pull_cache: bool = False
+    # in-loop native telemetry (README "Native observability"): the
+    # epoll loop's own lock-free histograms + the slow-frame watchdog
+    # threshold (ms; 0 disarms)
+    nl_stats: bool = True
+    nl_slow_frame_ms: float = 250.0
     # dial budgets (previously hardcoded): Channel.connect's total
     # retry-sleep budget and the discovered-aggregator liveness probe's
     connect_max_wait_ms: int = 15_000
@@ -624,6 +655,9 @@ class Config:
         if self.native_read_cache_bytes < 0:
             raise ValueError("native_read_cache_bytes must be >= 0 "
                              "(0 disables the native read cache)")
+        if self.nl_slow_frame_ms < 0:
+            raise ValueError("nl_slow_frame_ms must be >= 0 "
+                             "(0 disarms the slow-frame watchdog)")
         if self.read_staleness < 0:
             raise ValueError("read_staleness must be >= 0 versions")
         if self.connect_max_wait_ms < 0:
@@ -781,6 +815,12 @@ class Config:
                 env["PS_NATIVE_READ_CACHE_BYTES"] or 0)
         if "PS_READ_STALENESS" in env:
             kwargs["read_staleness"] = int(env["PS_READ_STALENESS"])
+        if "PS_NL_STATS" in env:
+            kwargs["nl_stats"] = env_flag("PS_NL_STATS", True)
+        if "PS_NL_SLOW_FRAME_MS" in env:
+            # float, matching the service-level env_float read — the two
+            # parsers of one knob must accept the same values
+            kwargs["nl_slow_frame_ms"] = float(env["PS_NL_SLOW_FRAME_MS"])
         if "PS_PULL_CACHE" in env:
             kwargs["pull_cache"] = env_flag("PS_PULL_CACHE", False)
         if "PS_CONNECT_MAX_WAIT_MS" in env:

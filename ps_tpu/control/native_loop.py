@@ -41,6 +41,28 @@ from ps_tpu.native import load
 #: natural batch-size cap the ps_van_upcall_batch histogram observes)
 MAX_BATCH = 64
 
+#: in-loop histogram geometry — the EXACT mirror of
+#: ps_tpu/obs/metrics.Histogram's defaults (lo=1e-6 s, hi=3600 s, 4
+#: sub-buckets per octave), kept in lockstep with van.cpp's kNlHist*
+#: constants so a native snapshot's raw buckets merge losslessly into
+#: the registry and the coordinator's fleet quantiles
+NL_HIST_LO = 1e-6
+NL_HIST_HI = 3600.0
+NL_HIST_BUCKETS = 129  # kNlHistNb + underflow + overflow
+
+#: nl_hist_snapshot `which` index -> the TransportStats histogram key it
+#: feeds (position-coupled with van.cpp's kNlHist* indices)
+NL_HISTS = (
+    (0, "nl_read_frame_s"),   # first byte -> frame complete
+    (1, "nl_queue_wait_s"),   # frame complete -> claimed by the pump
+    (2, "nl_read_hit_s"),     # frame complete -> native cache reply written
+    (3, "nl_flush_s"),        # tail staged -> EPOLLOUT drain done
+)
+
+#: fixed per-entry layout of nl_slow_drain's out buffers
+_SLOW_VALS = 7   # conn, kind, size, read_ns, wait_ns, serve_ns, age_ns
+_SLOW_TID = 20   # NUL-terminated id slot (trace then span per entry)
+
 _configured = None
 
 
@@ -89,6 +111,30 @@ def _lib():
     lib.nl_cache_invalidate.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
     lib.nl_cache_stats.argtypes = [ctypes.c_void_p,
                                    ctypes.POINTER(ctypes.c_uint64)]
+    lib.nl_cache_put_tagged.restype = ctypes.c_int
+    lib.nl_cache_put_tagged.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,
+    ]
+    lib.nl_cache_invalidate_tags.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,
+    ]
+    lib.nl_telemetry_config.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                        ctypes.c_uint64]
+    lib.nl_hist_snapshot.restype = ctypes.c_int
+    lib.nl_hist_snapshot.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                     ctypes.POINTER(ctypes.c_uint64)]
+    lib.nl_stats_snapshot.argtypes = [ctypes.c_void_p,
+                                      ctypes.POINTER(ctypes.c_uint64)]
+    lib.nl_slow_drain.restype = ctypes.c_int
+    lib.nl_slow_drain.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_void_p,
+        ctypes.c_int,
+    ]
+    lib.nl_hist_record.argtypes = [ctypes.c_void_p, ctypes.c_int,
+                                   ctypes.c_uint64]
     lib.tv_adopt_fd.restype = ctypes.c_void_p
     lib.tv_adopt_fd.argtypes = [ctypes.c_int]
     _configured = lib
@@ -135,6 +181,11 @@ class NativeEventLoop:
         self._lens = (ctypes.c_uint64 * MAX_BATCH)()
         self._stats_out = (ctypes.c_uint64 * 6)()
         self._cache_out = (ctypes.c_uint64 * 8)()
+        self._hist_out = (ctypes.c_uint64 * (4 + NL_HIST_BUCKETS))()
+        self._nl_out = (ctypes.c_uint64 * 8)()
+        self._slow_vals = (ctypes.c_uint64 * (_SLOW_VALS * MAX_BATCH))()
+        self._slow_tids = ctypes.create_string_buffer(
+            2 * _SLOW_TID * MAX_BATCH)
         # bodies currently claimed by Python (poll handed them out, free
         # not yet called): makes free() IDEMPOTENT — an error-path caller
         # can release unconditionally without risking a double free
@@ -244,34 +295,53 @@ class NativeEventLoop:
                 self._lib.nl_cache_config(self._h, int(kind),
                                           int(max_bytes))
 
-    def cache_put(self, key: bytes, reply, gen: int) -> bool:
+    def cache_put(self, key: bytes, reply, gen: int,
+                  tags=None) -> bool:
         """Publish one reply frame for the request bytes ``key`` at
         publish generation ``gen`` (captured under the engine lock with
-        the snapshot the reply serializes). False = refused: the cache is
-        off, the entry is over budget, or — the invalidation race — an
-        apply already raised the floor past ``gen``. Buffers are copied
-        native-side; never retained."""
+        the snapshot the reply serializes). ``tags`` optionally names the
+        state slice the reply covers (u64s — the sparse service's
+        per-(table, row) hashes) so :meth:`cache_invalidate` with tags
+        can drop only intersecting entries; None publishes an untagged
+        entry that every invalidation drops (the conservative default).
+        False = refused: the cache is off, the entry is over budget, or —
+        the invalidation race — an apply already raised the floor past
+        ``gen``. Buffers are copied native-side; never retained."""
         kv = np.frombuffer(key, np.uint8)
         rv = np.frombuffer(reply, np.uint8)
         if not self._pin():
             return False
         try:
-            ok = self._lib.nl_cache_put(self._h, kv.ctypes.data, kv.nbytes,
-                                        rv.ctypes.data, rv.nbytes, int(gen))
+            if tags:
+                arr = (ctypes.c_uint64 * len(tags))(*[int(t) for t in tags])
+                ok = self._lib.nl_cache_put_tagged(
+                    self._h, kv.ctypes.data, kv.nbytes, rv.ctypes.data,
+                    rv.nbytes, int(gen), arr, len(tags))
+            else:
+                ok = self._lib.nl_cache_put(self._h, kv.ctypes.data,
+                                            kv.nbytes, rv.ctypes.data,
+                                            rv.nbytes, int(gen))
         finally:
             self._unpin()
         del kv, rv  # pinned the sources for exactly the call's duration
         return bool(ok)
 
-    def cache_invalidate(self, gen: int) -> None:
+    def cache_invalidate(self, gen: int, tags=None) -> None:
         """Invalidation-on-apply: raise the publish floor to ``gen`` and
-        drop every cached entry. Pin-based (not the driver lock): this
-        runs on the engine apply path and must never queue behind a
-        multi-MB reply."""
+        drop cached entries — every entry when ``tags`` is None, else
+        only entries whose tag set intersects ``tags`` (untagged entries
+        always drop: they claim nothing). Pin-based (not the driver
+        lock): this runs on the engine apply path and must never queue
+        behind a multi-MB reply."""
         if not self._pin():
             return
         try:
-            self._lib.nl_cache_invalidate(self._h, int(gen))
+            if tags:
+                arr = (ctypes.c_uint64 * len(tags))(*[int(t) for t in tags])
+                self._lib.nl_cache_invalidate_tags(self._h, int(gen), arr,
+                                                   len(tags))
+            else:
+                self._lib.nl_cache_invalidate(self._h, int(gen))
         finally:
             self._unpin()
 
@@ -291,6 +361,98 @@ class NativeEventLoop:
                     "puts": int(o[2]), "rejects": int(o[3]),
                     "invalidations": int(o[4]), "entries": int(o[5]),
                     "bytes": int(o[6]), "floor": int(o[7])}
+
+    # -- in-loop telemetry (README "Native observability") --------------------
+
+    def telemetry_config(self, stats_on: bool, slow_frame_ns: int) -> None:
+        """Arm/disarm the loop's own telemetry: ``stats_on`` gates every
+        histogram stamp (off = the pre-telemetry hot path plus one
+        relaxed load per frame), ``slow_frame_ns`` the slow-frame
+        watchdog threshold (0 = off)."""
+        with self._lock:
+            if not self._closed:
+                self._lib.nl_telemetry_config(
+                    self._h, 1 if stats_on else 0, int(slow_frame_ns))
+
+    def hist_snapshots(self) -> dict:
+        """The in-loop histograms as obs.metrics raw-state dicts (same
+        geometry as :class:`~ps_tpu.obs.metrics.Histogram`'s defaults, so
+        the states merge losslessly via ``state_add``), keyed by their
+        TransportStats histogram name (``nl_read_hit_s``, ...). Stripes
+        are aggregated native-side; sums/extrema convert ns -> s here."""
+        out = {}
+        with self._lock:
+            if self._closed:
+                return out
+            for which, key in NL_HISTS:
+                nb = self._lib.nl_hist_snapshot(self._h, which,
+                                                self._hist_out)
+                if nb != NL_HIST_BUCKETS:
+                    continue  # geometry drifted: skip rather than corrupt
+                o = self._hist_out
+                total = int(o[0])
+                out[key] = {
+                    "lo": NL_HIST_LO, "hi": NL_HIST_HI,
+                    "c": [int(o[4 + b]) for b in range(nb)],
+                    "n": total, "s": int(o[1]) / 1e9,
+                    "mx": int(o[3]) / 1e9,
+                    "mn": (int(o[2]) / 1e9 if total else None),
+                }
+        return out
+
+    def stats_snapshot(self) -> dict:
+        """The loop's non-histogram telemetry: staged-tail backlog/total
+        bytes, tail drains, slow-frame counters, and the armed config."""
+        with self._lock:
+            if self._closed:
+                return {"tail_backlog_bytes": 0, "tail_staged_bytes": 0,
+                        "tail_flushes": 0, "slow_frames": 0,
+                        "slow_dropped": 0, "stats_on": False,
+                        "slow_frame_ns": 0}
+            self._lib.nl_stats_snapshot(self._h, self._nl_out)
+            o = self._nl_out
+            return {"tail_backlog_bytes": int(o[0]),
+                    "tail_staged_bytes": int(o[1]),
+                    "tail_flushes": int(o[2]),
+                    "slow_frames": int(o[3]),
+                    "slow_dropped": int(o[4]),
+                    "stats_on": bool(o[5]),
+                    "slow_frame_ns": int(o[6])}
+
+    def slow_drain(self) -> list:
+        """Drain the slow-frame ring: one dict per over-threshold frame
+        (conn, wire kind byte, size, per-stage ns, age since record, and
+        the sniffed trace context — empty strings when untraced). The
+        pump folds these into ``slow_frame`` flight events."""
+        out = []
+        with self._lock:
+            if self._closed:
+                return out
+            n = self._lib.nl_slow_drain(self._h, self._slow_vals,
+                                        self._slow_tids, MAX_BATCH)
+            for i in range(n):
+                v = self._slow_vals[i * _SLOW_VALS:(i + 1) * _SLOW_VALS]
+                base = i * 2 * _SLOW_TID
+                raw = self._slow_tids.raw
+                trace = raw[base:base + _SLOW_TID].split(b"\0", 1)[0]
+                span = raw[base + _SLOW_TID:base + 2 * _SLOW_TID].split(
+                    b"\0", 1)[0]
+                out.append({
+                    "conn": int(v[0]), "kind": int(v[1]),
+                    "size": int(v[2]), "read_ns": int(v[3]),
+                    "wait_ns": int(v[4]), "serve_ns": int(v[5]),
+                    "age_ns": int(v[6]),
+                    "trace_id": trace.decode("ascii", "replace"),
+                    "span_id": span.decode("ascii", "replace"),
+                })
+        return out
+
+    def hist_record(self, which: int, ns: int) -> None:
+        """Test seam: push one KNOWN duration through the native bucket
+        math (the fleet-merge exactness test's ground truth injector)."""
+        with self._lock:
+            if not self._closed:
+                self._lib.nl_hist_record(self._h, int(which), int(ns))
 
     # -- lifecycle / introspection -------------------------------------------
 

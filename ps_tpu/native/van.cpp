@@ -38,6 +38,7 @@
 #include <algorithm>
 #include <atomic>
 #include <chrono>
+#include <cmath>
 #include <condition_variable>
 #include <cstdint>
 #include <cstdlib>
@@ -630,6 +631,133 @@ constexpr uint64_t kNlMaxWbufBacklog = 64ull << 20;  // staged-reply
 
 struct NlThread;
 
+// ---------------------------------------------------------------------------
+// In-loop telemetry: per-stripe log2-bucket histograms + counters. The hot
+// path (loop threads reading frames, the pump claiming them) only ever does
+// relaxed atomic increments into its OWN stripe — no locks, no allocation —
+// and nl_hist_snapshot aggregates across stripes on read. The bucket
+// geometry is an exact mirror of ps_tpu/obs/metrics.Histogram's defaults
+// (lo=1e-6 s, hi=3600 s, 4 sub-buckets per octave), so a snapshot's raw
+// buckets merge LOSSLESSLY into the Python registry and the coordinator's
+// pooled-sample fleet quantiles (state_add) with no re-bucketing.
+
+constexpr int kNlHistSub = 4;       // sub-buckets per octave (2^(1/4))
+constexpr int kNlHistNb = 127;      // ceil(log2(3600 / 1e-6) * kNlHistSub)
+constexpr int kNlHistBuckets = kNlHistNb + 2;  // + underflow + overflow
+constexpr double kNlHistLo = 1e-6;  // seconds (1 ns..1 µs = underflow bin)
+constexpr int kNlHistCount = 4;
+// nl_hist_snapshot `which` indices (ctypes mirrors these by position)
+constexpr int kNlHistReadFrame = 0;  // first byte -> frame complete
+constexpr int kNlHistQueueWait = 1;  // frame complete -> claimed by pump
+constexpr int kNlHistReadHit = 2;    // frame complete -> cache reply written
+constexpr int kNlHistFlush = 3;      // tail staged -> EPOLLOUT drain done
+
+struct NlHist {
+  std::atomic<uint64_t> counts[kNlHistBuckets]{};
+  std::atomic<uint64_t> total{0}, sum_ns{0};
+  std::atomic<uint64_t> min_ns{~0ull}, max_ns{0};
+};
+
+struct NlStripe {
+  NlHist hist[kNlHistCount];
+};
+
+// Slow-frame flight capture: a frame whose in-loop latency crossed the
+// configured threshold leaves a bounded ring entry (kind byte, size, conn,
+// per-stage timings, and the request's propagated trace context when the
+// frame's meta carries one) for the Python pump to drain into a
+// `slow_frame` flight event + a reconstructed span.
+constexpr size_t kNlSlowRing = 256;
+constexpr int kNlTidLen = 20;  // 16-hex id + NUL, padded to 8-byte multiple
+
+struct NlSlowFrame {
+  uint64_t conn = 0, size = 0;
+  uint32_t kind = 0;
+  uint64_t read_ns = 0, wait_ns = 0, serve_ns = 0;
+  uint64_t mono_ns = 0;  // steady-clock stamp at record time
+  char trace[kNlTidLen] = {0};
+  char span[kNlTidLen] = {0};
+};
+
+// pslint: hot-path
+uint64_t nl_now_ns() {
+  return (uint64_t)std::chrono::duration_cast<std::chrono::nanoseconds>(
+             Clock::now().time_since_epoch())
+      .count();
+}
+
+// One sample into one stripe's histogram: the same bucket formula as
+// obs.metrics.Histogram.record (floor(log2(v/lo) * SUB) + 1, edge bins at
+// 0 and nb+1), relaxed atomics only.
+// pslint: hot-path
+void nl_hist_add(NlHist& h, uint64_t ns) {
+  double v = (double)ns * 1e-9;
+  int k;
+  if (v < kNlHistLo) {
+    k = 0;
+  } else {
+    k = (int)(std::log2(v / kNlHistLo) * kNlHistSub) + 1;
+    if (k < 1) k = 1;
+    if (k > kNlHistNb) k = kNlHistNb + 1;
+  }
+  h.counts[k].fetch_add(1, std::memory_order_relaxed);
+  h.total.fetch_add(1, std::memory_order_relaxed);
+  h.sum_ns.fetch_add(ns, std::memory_order_relaxed);
+  uint64_t cur = h.max_ns.load(std::memory_order_relaxed);
+  while (ns > cur &&
+         !h.max_ns.compare_exchange_weak(cur, ns,
+                                         std::memory_order_relaxed)) {
+  }
+  cur = h.min_ns.load(std::memory_order_relaxed);
+  while (ns < cur &&
+         !h.min_ns.compare_exchange_weak(cur, ns,
+                                         std::memory_order_relaxed)) {
+  }
+}
+
+// Best-effort trace-context sniff over a complete frame body. The body
+// layout is [u8 kind][u32 worker][u64 meta_len][json meta][raw buffers]
+// (ps_tpu/control/tensor_van.py); a propagated context is the meta's
+// `"tc": ["<trace>", "<span>"]` entry (json.dumps spacing, but tolerant
+// of none). Scan is bounded to the meta region (capped at 4 KiB — in-tree
+// encoders put `tc` in the first few hundred bytes), copies at most 16 hex
+// chars per id, and never allocates — it only runs for frames ALREADY
+// classified slow, never on the ordinary path.
+void nl_extract_tc(const char* body, uint64_t len, char* trace, char* span) {
+  trace[0] = span[0] = 0;
+  if (body == nullptr || len < 13) return;
+  uint64_t mlen;
+  memcpy(&mlen, body + 5, 8);
+  if (mlen > len - 13) return;
+  uint64_t scan = mlen > 4096 ? 4096 : mlen;
+  const char* meta = body + 13;
+  static const char kKey[] = "\"tc\":";
+  const uint64_t klen = sizeof(kKey) - 1;
+  if (scan < klen) return;
+  uint64_t i = 0;
+  bool found = false;
+  for (; i + klen <= scan; ++i) {  // <=: the last valid start offset is
+    if (memcmp(meta + i, kKey, klen) == 0) {  // scan - klen inclusive
+      found = true;
+      break;
+    }
+  }
+  if (!found) return;
+  i += klen;
+  char* out[2] = {trace, span};
+  int which = 0;
+  for (; i < scan && which < 2; ++i) {
+    if (meta[i] != '"') continue;
+    ++i;  // inside the string
+    int n = 0;
+    while (i < scan && meta[i] != '"' && n < kNlTidLen - 1)
+      out[which][n++] = meta[i++];
+    out[which][n] = 0;
+    ++which;
+  }
+  if (which < 2) span[0] = 0;  // torn scan: never emit half a context
+}
+
 struct NlConn {
   int fd = -1;
   uint64_t id = 0;
@@ -639,6 +767,8 @@ struct NlConn {
   int lenoff = 0;
   char* body = nullptr;  // frame body mid-read
   uint64_t body_len = 0, body_off = 0;
+  uint64_t t_frame_ns = 0;  // first byte of the current frame (owner only)
+  uint64_t t_stall_ns = 0;  // tail staged, not yet drained (guarded by wmu)
   bool dead = false;  // removed from the table; freed at iteration end
   // write state: guarded by wmu (pump thread replies, owner flushes)
   std::mutex wmu;
@@ -661,11 +791,14 @@ struct NlReq {
   uint64_t conn_id;
   char* body;
   uint64_t len;
+  uint64_t read_ns;    // first byte -> frame complete (0 = stats off)
+  uint64_t ready_ns;   // frame-complete stamp for the queue-wait measure
 };
 
 struct NlThread {
   int epfd = -1;
   int evfd = -1;
+  int idx = 0;  // this thread's stripe index (set once at nl_start)
   std::thread th;
   std::mutex cmu;
   std::vector<std::function<void(NlThread&)>> cmds;
@@ -682,6 +815,12 @@ struct NlCacheEntry {
   std::string key;    // full request body bytes
   std::string reply;  // [u64 le length][reply frame bytes]
   uint64_t gen = 0;   // publish generation (see cache_floor)
+  // per-key invalidation tags (sorted): opaque u64s naming the state this
+  // reply covers (the sparse service hashes each (table, row id) of the
+  // cached id-set). An EMPTY set means "no claim" — such entries drop on
+  // every tagged invalidation, so dense whole-tree replies and over-cap
+  // id-sets stay exactly as conservative as before.
+  std::vector<uint64_t> tags;
 };
 
 struct NlLoop {
@@ -723,6 +862,24 @@ struct NlLoop {
   std::atomic<int> cache_kind{-1};
   std::atomic<uint64_t> cache_hits{0}, cache_miss{0}, cache_puts{0},
       cache_rejects{0}, cache_invals{0};
+  // in-loop telemetry (see the NlHist block above): one stripe per loop
+  // thread plus one shared by the pump/punted callers (index nthreads).
+  // stats_on/slow_ns are read per frame with relaxed loads — toggling
+  // costs the hot path one branch.
+  std::unique_ptr<NlStripe[]> stripes;
+  std::atomic<int> stats_on{1};
+  std::atomic<uint64_t> slow_ns{0};  // 0 = slow-frame watchdog off
+  // staged-reply tail accounting (updated under each conn's wmu; atomics
+  // so nl_stats_snapshot reads them without touching any conn lock)
+  std::atomic<uint64_t> tail_staged{0};   // cumulative bytes ever staged
+  std::atomic<uint64_t> tail_backlog{0};  // staged minus drained/dropped
+  std::atomic<uint64_t> tail_flushes{0};  // tails drained to empty
+  // slow-frame ring. slowmu is a LEAF lock (nothing else is ever taken
+  // under it) and is only touched for frames already past the threshold,
+  // so it is deliberately NOT a hot lock.
+  std::mutex slowmu;
+  std::deque<NlSlowFrame> slow_ring;
+  std::atomic<uint64_t> slow_total{0}, slow_dropped{0};
 };
 
 uint64_t nl_cache_hash(const char* p, uint64_t n) {
@@ -766,6 +923,40 @@ void nl_wake(NlThread& t) {
   (void)r;
 }
 
+// Record one over-threshold frame into the bounded slow ring. Only called
+// for frames already classified slow, so the leaf mutex + the bounded tc
+// scan cost nothing on the ordinary path. Oldest entries are overwritten
+// (counted) when the pump falls behind.
+void nl_slow_record(NlLoop* l, uint64_t conn, const char* body,
+                    uint64_t len, uint64_t read_ns, uint64_t wait_ns,
+                    uint64_t serve_ns) {
+  NlSlowFrame f;
+  f.conn = conn;
+  f.size = len;
+  f.kind = len ? (uint8_t)body[0] : 0;
+  f.read_ns = read_ns;
+  f.wait_ns = wait_ns;
+  f.serve_ns = serve_ns;
+  f.mono_ns = nl_now_ns();
+  nl_extract_tc(body, len, f.trace, f.span);
+  std::lock_guard<std::mutex> lock(l->slowmu);
+  if (l->slow_ring.size() >= kNlSlowRing) {
+    l->slow_ring.pop_front();
+    l->slow_dropped.fetch_add(1, std::memory_order_relaxed);
+  }
+  l->slow_ring.push_back(f);
+  l->slow_total.fetch_add(1, std::memory_order_relaxed);
+}
+
+// Tail staged into a conn's write buffer (wmu held by the caller): account
+// the backlog and stamp the stall start when the tail opens.
+void nl_tail_staged(NlLoop* l, NlConn* c, uint64_t nbytes,
+                    bool was_empty) {
+  l->tail_staged.fetch_add(nbytes, std::memory_order_relaxed);
+  l->tail_backlog.fetch_add(nbytes, std::memory_order_relaxed);
+  if (was_empty) c->t_stall_ns = nl_now_ns();
+}
+
 // Owner thread (or nl_stop after join): unlink + free one connection.
 // pslint: owns: body -- c->body here is a MID-READ frame that was never
 // queued (queued frames move their pointer into the ready queue and
@@ -776,6 +967,16 @@ void nl_destroy(NlLoop* l, NlThread& t, NlConn* c) {
     l->conns.erase(c->id);  // erased first: no NEW pin can be taken
     while (c->pins > 0) l->pin_cv.wait(lock);  // a replier mid-write
     // still holds live pointers into the struct and its fd
+  }
+  {
+    // pins are drained and the conn left the table, so the write state is
+    // quiescent: any unflushed tail dies with the conn — return it to the
+    // backlog gauge so the fleet view never reports ghost bytes
+    std::lock_guard<std::mutex> wl(c->wmu);
+    if (c->wbuf.size() > c->woff)
+      l->tail_backlog.fetch_sub(c->wbuf.size() - c->woff,
+                                std::memory_order_relaxed);
+    c->woff = c->wbuf.size();
   }
   epoll_ctl(t.epfd, EPOLL_CTL_DEL, c->fd, nullptr);
   close(c->fd);
@@ -855,9 +1056,13 @@ bool nl_cache_serve(NlLoop* l, NlThread& t, NlConn* c) {
       }
       off += (size_t)r;
     }
-    if (off < len) c->wbuf.append(data + off, len - off);
+    if (off < len) {
+      nl_tail_staged(l, c, len - off, true);
+      c->wbuf.append(data + off, len - off);
+    }
   } else {
     // a tail is already staged: whole frames append behind it in order
+    nl_tail_staged(l, c, len, false);
     c->wbuf.append(data, len);
   }
   if (!c->wbuf.empty() && !c->want_write) {
@@ -882,6 +1087,8 @@ void nl_read(NlLoop* l, NlThread& t, NlConn* c) {
         nl_destroy(l, t, c);
         return;
       }
+      if (c->lenoff == 0 && l->stats_on.load(std::memory_order_relaxed))
+        c->t_frame_ns = nl_now_ns();  // first byte of a new frame
       c->lenoff += (int)r;
       if (c->lenoff < 8) continue;
       uint64_t len;
@@ -905,12 +1112,35 @@ void nl_read(NlLoop* l, NlThread& t, NlConn* c) {
       }
       c->body_off += (uint64_t)r;
     }
+    // frame complete: the read-latency sample lands now; the completion
+    // stamp rides the queue entry so the pump measures its own wait
+    bool stats = l->stats_on.load(std::memory_order_relaxed) != 0;
+    uint64_t done_ns = 0, read_ns = 0;
+    if (stats) {
+      done_ns = nl_now_ns();
+      if (c->t_frame_ns) {
+        read_ns = done_ns - c->t_frame_ns;
+        nl_hist_add(l->stripes[t.idx].hist[kNlHistReadFrame], read_ns);
+      }  // no first-byte stamp (stats were off then): skip the sample
+    }
+    // cleared UNCONDITIONALLY: a stamp taken before a stats toggle must
+    // never survive into a later frame as a phantom multi-second sample
+    c->t_frame_ns = 0;
     {
       int ck = l->cache_kind.load(std::memory_order_relaxed);
       if (ck >= 0 && c->body_len >= 1 && (uint8_t)c->body[0] == (uint8_t)ck
           && nl_cache_serve(l, t, c)) {
         // answered (or severed) natively: the frame never queued, so it
-        // never counts as outstanding and Python never sees it.
+        // never counts as outstanding and Python never sees it. This is
+        // the zero-upcall path — its service time is only visible here.
+        if (stats) {
+          uint64_t serve_ns = nl_now_ns() - done_ns;
+          nl_hist_add(l->stripes[t.idx].hist[kNlHistReadHit], serve_ns);
+          uint64_t thr = l->slow_ns.load(std::memory_order_relaxed);
+          if (thr && read_ns + serve_ns > thr)
+            nl_slow_record(l, c->id, c->body, c->body_len, read_ns, 0,
+                           serve_ns);
+        }
         // pslint: owns: body -- cache-hit frame answered on the owner
         // thread BEFORE the queue push: still thread-private, no
         // ownership ever transferred to Python
@@ -938,7 +1168,7 @@ void nl_read(NlLoop* l, NlThread& t, NlConn* c) {
       // pslint: transfers: body -- from this push the body is Python's,
       // nl_poll hands it out and ONLY nl_body_free may release it; the
       // UAF gate: any new native free of a body needs an owns: claim
-      l->ready.push_back({c->id, c->body, c->body_len});
+      l->ready.push_back({c->id, c->body, c->body_len, read_ns, done_ns});
     }
     l->requests.fetch_add(1, std::memory_order_relaxed);
     l->qcv.notify_one();
@@ -949,7 +1179,7 @@ void nl_read(NlLoop* l, NlThread& t, NlConn* c) {
 
 // Owner thread: flush the buffered reply tail; returns false when the
 // connection must be destroyed (hard error, or goodbye fully flushed).
-bool nl_flush(NlThread& t, NlConn* c) {
+bool nl_flush(NlLoop* l, NlThread& t, NlConn* c) {
   std::lock_guard<std::mutex> lock(c->wmu);
   while (c->woff < c->wbuf.size()) {
     ssize_t r = send(c->fd, c->wbuf.data() + c->woff,
@@ -960,6 +1190,15 @@ bool nl_flush(NlThread& t, NlConn* c) {
       return false;
     }
     c->woff += (size_t)r;
+    l->tail_backlog.fetch_sub((uint64_t)r, std::memory_order_relaxed);
+  }
+  // tail fully drained: the EPOLLOUT stall this conn paid ends here
+  if (c->t_stall_ns) {
+    if (l->stats_on.load(std::memory_order_relaxed))
+      nl_hist_add(l->stripes[t.idx].hist[kNlHistFlush],
+                  nl_now_ns() - c->t_stall_ns);
+    l->tail_flushes.fetch_add(1, std::memory_order_relaxed);
+    c->t_stall_ns = 0;
   }
   if (c->wbuf.capacity() > (1u << 20)) {
     // release a large spill's capacity instead of pinning it for the
@@ -1054,7 +1293,7 @@ void nl_thread_run(NlLoop* l, int ti) {
       if (evs[i].events & (EPOLLHUP | EPOLLERR)) {
         // flush what we can first: a goodbye OK may still be in the
         // tail while the peer half-closed its side
-        if (!(evs[i].events & EPOLLOUT) || !nl_flush(t, c)) {
+        if (!(evs[i].events & EPOLLOUT) || !nl_flush(l, t, c)) {
           nl_destroy(l, t, c);
           continue;
         }
@@ -1086,7 +1325,7 @@ void nl_thread_run(NlLoop* l, int ti) {
       for (auto& w : writable) {
         NlConn* c = w.second;
         if (c->dead) continue;
-        if (!nl_flush(t, c)) nl_destroy(l, t, c);
+        if (!nl_flush(l, t, c)) nl_destroy(l, t, c);
       }
       writable.clear();
     }
@@ -1108,12 +1347,17 @@ void* nl_start(void* listener, int nthreads) {
   auto* l = new NlLoop();
   l->listener = lst;
   l->nthreads = nthreads;
+  // telemetry stripes: one per loop thread + one shared by the pump and
+  // punted repliers (index nthreads) — allocated once, before any thread
+  // can record, so the hot path never checks for them
+  l->stripes.reset(new NlStripe[(size_t)nthreads + 1]());
   int fl = fcntl(lst->fd, F_GETFL, 0);
   fcntl(lst->fd, F_SETFL, fl | O_NONBLOCK);
   bool ok = true;
   for (int i = 0; i < nthreads; ++i) {
     l->threads.emplace_back();
     NlThread& t = l->threads.back();
+    t.idx = i;
     t.epfd = epoll_create1(0);
     t.evfd = eventfd(0, EFD_NONBLOCK);
     if (t.epfd < 0 || t.evfd < 0) { ok = false; break; }
@@ -1148,6 +1392,12 @@ void* nl_start(void* listener, int nthreads) {
 int nl_poll(void* h, uint64_t* conn_ids, void** bodies, uint64_t* lens,
             int cap, int timeout_ms) {
   auto* l = static_cast<NlLoop*>(h);
+  // claimed entries' telemetry stamps: captured during the pop, recorded
+  // AFTER qmu is released (qmu is a hot lock — the histogram math and the
+  // slow-frame classification happen outside it). Reserved before the
+  // lock so the pop allocates nothing while holding it.
+  std::vector<std::pair<uint64_t, uint64_t>> tel;  // (read_ns, ready_ns)
+  tel.reserve((size_t)(cap > 0 ? cap : 0));
   std::unique_lock<std::mutex> lock(l->qmu);
   if (l->ready.empty()) {
     if (l->stop.load(std::memory_order_relaxed)) return -1;
@@ -1171,10 +1421,30 @@ int nl_poll(void* h, uint64_t* conn_ids, void** bodies, uint64_t* lens,
     conn_ids[n] = r.conn_id;
     bodies[n] = r.body;
     lens[n] = r.len;
+    tel.emplace_back(r.read_ns, r.ready_ns);
     ++n;
     l->ready.pop_front();
   }
   l->popped.fetch_add((uint64_t)n, std::memory_order_relaxed);
+  lock.unlock();
+  if (l->stats_on.load(std::memory_order_relaxed)) {
+    // ready-queue wait (frame complete -> claimed by THIS pump call),
+    // recorded into the pump's own stripe; the slow-frame check covers
+    // the whole in-loop life of a pump-bound frame (read + wait). The
+    // bodies are still native-owned until nl_body_free, so the trace
+    // sniff reads live memory.
+    uint64_t now = nl_now_ns();
+    uint64_t thr = l->slow_ns.load(std::memory_order_relaxed);
+    NlHist& qh = l->stripes[l->nthreads].hist[kNlHistQueueWait];
+    for (int i = 0; i < n; ++i) {
+      if (!tel[i].second) continue;  // frame read while stats were off
+      uint64_t wait = now > tel[i].second ? now - tel[i].second : 0;
+      nl_hist_add(qh, wait);
+      if (thr && tel[i].first + wait > thr)
+        nl_slow_record(l, conn_ids[i], (const char*)bodies[i], lens[i],
+                       tel[i].first, wait, 0);
+    }
+  }
   return n;
 }
 
@@ -1250,8 +1520,12 @@ int nl_reply_vec(void* h, uint64_t conn_id, const void** bufs,
       }
     }
     // stage only the unsent tail (zero bytes in the common case)
-    for (; idx < iov.size(); ++idx)
+    uint64_t staged = 0;
+    for (; idx < iov.size(); ++idx) {
       c->wbuf.append((const char*)iov[idx].iov_base, iov[idx].iov_len);
+      staged += iov[idx].iov_len;
+    }
+    if (staged) nl_tail_staged(l, c, staged, true);
   } else if (c->wbuf.size() - c->woff > kNlMaxWbufBacklog) {
     // the peer has stopped reading while pipelining more requests:
     // refusing to buffer further replies bounds server memory (the
@@ -1259,6 +1533,7 @@ int nl_reply_vec(void* h, uint64_t conn_id, const void** bufs,
     fail = true;
   } else {
     // a tail is already queued: append whole frames behind it in order
+    nl_tail_staged(l, c, sizeof(len_le) + total, false);
     c->wbuf.append((const char*)&len_le, sizeof(len_le));
     for (int i = 0; i < n; ++i)
       if (lens[i]) c->wbuf.append((const char*)bufs[i], (size_t)lens[i]);
@@ -1438,6 +1713,106 @@ void nl_stats(void* h, uint64_t* out) {
            - l->freed.load(std::memory_order_relaxed);
 }
 
+// Configure the in-loop telemetry: `stats_on` gates every histogram
+// stamp (off = the pre-telemetry hot path plus one relaxed load per
+// frame), `slow_frame_ns` arms the slow-frame watchdog (0 = off) — any
+// frame whose in-loop latency (read + queue wait, or read + native serve)
+// exceeds it records a bounded ring entry for nl_slow_drain. Safe at any
+// time; normally called once at service start from the PS_NL_STATS /
+// PS_NL_SLOW_FRAME_MS knobs.
+void nl_telemetry_config(void* h, int stats_on, uint64_t slow_frame_ns) {
+  auto* l = static_cast<NlLoop*>(h);
+  l->stats_on.store(stats_on ? 1 : 0, std::memory_order_relaxed);
+  l->slow_ns.store(slow_frame_ns, std::memory_order_relaxed);
+}
+
+// Aggregate one in-loop histogram across every stripe. `which`: 0 = frame
+// read latency, 1 = ready-queue wait, 2 = native READ-hit service time,
+// 3 = EPOLLOUT tail-flush latency. Fills out[0]=total, out[1]=sum_ns,
+// out[2]=min_ns (~0 when empty), out[3]=max_ns, out[4..4+nb) = raw bucket
+// counts in the exact geometry of ps_tpu/obs/metrics.Histogram's defaults
+// (lo=1e-6 s, hi=3600 s, 4 sub-buckets/octave — mergeable via state_add).
+// Returns the bucket count (the caller sizes `out` as 4 + that), or -1
+// for an unknown `which`.
+int nl_hist_snapshot(void* h, int which, uint64_t* out) {
+  auto* l = static_cast<NlLoop*>(h);
+  if (which < 0 || which >= kNlHistCount) return -1;
+  uint64_t total = 0, sum = 0, mn = ~0ull, mx = 0;
+  for (int b = 0; b < kNlHistBuckets; ++b) out[4 + b] = 0;
+  for (int s = 0; s <= l->nthreads; ++s) {
+    NlHist& hh = l->stripes[s].hist[which];
+    total += hh.total.load(std::memory_order_relaxed);
+    sum += hh.sum_ns.load(std::memory_order_relaxed);
+    uint64_t smn = hh.min_ns.load(std::memory_order_relaxed);
+    uint64_t smx = hh.max_ns.load(std::memory_order_relaxed);
+    if (smn < mn) mn = smn;
+    if (smx > mx) mx = smx;
+    for (int b = 0; b < kNlHistBuckets; ++b)
+      out[4 + b] += hh.counts[b].load(std::memory_order_relaxed);
+  }
+  out[0] = total;
+  out[1] = sum;
+  out[2] = mn;
+  out[3] = mx;
+  return kNlHistBuckets;
+}
+
+// out[8]: current staged-tail backlog bytes, cumulative bytes ever
+// staged, tails drained to empty, slow frames recorded, slow-ring
+// overwrites (pump fell behind), stats_on, the armed slow threshold (ns),
+// reserved 0.
+void nl_stats_snapshot(void* h, uint64_t* out) {
+  auto* l = static_cast<NlLoop*>(h);
+  out[0] = l->tail_backlog.load(std::memory_order_relaxed);
+  out[1] = l->tail_staged.load(std::memory_order_relaxed);
+  out[2] = l->tail_flushes.load(std::memory_order_relaxed);
+  out[3] = l->slow_total.load(std::memory_order_relaxed);
+  out[4] = l->slow_dropped.load(std::memory_order_relaxed);
+  out[5] = (uint64_t)l->stats_on.load(std::memory_order_relaxed);
+  out[6] = l->slow_ns.load(std::memory_order_relaxed);
+  out[7] = 0;
+}
+
+// Drain up to `cap` slow-frame ring entries (oldest first). `vals` holds
+// 7 u64 slots per entry: conn id, kind byte, body size, read_ns, wait_ns,
+// serve_ns, age_ns (record -> this drain). `tids` holds 2*20 bytes per
+// entry: the NUL-terminated trace id then the parent span id sniffed from
+// the frame's `tc` header (empty strings when the request was untraced).
+// Returns the entry count.
+int nl_slow_drain(void* h, uint64_t* vals, char* tids, int cap) {
+  auto* l = static_cast<NlLoop*>(h);
+  std::lock_guard<std::mutex> lock(l->slowmu);
+  uint64_t now = nl_now_ns();
+  int n = 0;
+  while (n < cap && !l->slow_ring.empty()) {
+    NlSlowFrame& f = l->slow_ring.front();
+    uint64_t* v = vals + (size_t)n * 7;
+    v[0] = f.conn;
+    v[1] = f.kind;
+    v[2] = f.size;
+    v[3] = f.read_ns;
+    v[4] = f.wait_ns;
+    v[5] = f.serve_ns;
+    v[6] = now > f.mono_ns ? now - f.mono_ns : 0;
+    char* t = tids + (size_t)n * (2 * kNlTidLen);
+    memcpy(t, f.trace, kNlTidLen);
+    memcpy(t + kNlTidLen, f.span, kNlTidLen);
+    l->slow_ring.pop_front();
+    ++n;
+  }
+  return n;
+}
+
+// Test seam: record one KNOWN duration into stripe 0 of histogram
+// `which` through the exact bucket math the loop's hot path uses — the
+// fleet-merge exactness test feeds controlled samples through the real
+// native bucketing and diffs the merged quantiles against numpy.
+void nl_hist_record(void* h, int which, uint64_t ns) {
+  auto* l = static_cast<NlLoop*>(h);
+  if (which < 0 || which >= kNlHistCount) return;
+  nl_hist_add(l->stripes[0].hist[which], ns);
+}
+
 // Begin shutdown WITHOUT freeing: loop threads exit, nl_poll drains the
 // remaining ready frames and then returns -1. The Python pump exits on
 // that -1; only then may nl_stop run.
@@ -1515,16 +1890,16 @@ void nl_cache_config(void* h, int kind, uint64_t max_bytes) {
   }
 }
 
-// Publish one reply: `key`/`klen` are the request body bytes the entry
-// answers (exact match), `buf`/`len` the reply frame (the length prefix
-// is prepended here), `gen` the publish generation captured UNDER the
-// engine lock with the snapshot. Returns 1 stored, 0 refused — gen below
-// the invalidation floor (an apply superseded this snapshot), cache
-// disabled, or the entry alone over budget. Oldest entries evict first
-// when the budget would overflow. Caller's buffers are copied; never
-// retained.
-int nl_cache_put(void* h, const void* key, uint64_t klen, const void* buf,
-                 uint64_t len, uint64_t gen) {
+// Publish one reply with per-key invalidation tags: `tags`/`ntags` name
+// the state slice the reply covers (the sparse service hashes each
+// (table, row id) of the cached id-set) so nl_cache_invalidate_tags can
+// drop ONLY intersecting entries. ntags == 0 publishes an untagged entry
+// — the pre-tag behavior: dropped by every invalidation, tagged or not.
+// Everything else is nl_cache_put's contract (floor refusal, budget,
+// FIFO eviction, buffers copied never retained).
+int nl_cache_put_tagged(void* h, const void* key, uint64_t klen,
+                        const void* buf, uint64_t len, uint64_t gen,
+                        const uint64_t* tags, int ntags) {
   auto* l = static_cast<NlLoop*>(h);
   std::lock_guard<std::mutex> lock(l->cachemu);
   uint64_t need = klen + len + 8;
@@ -1560,11 +1935,28 @@ int nl_cache_put(void* h, const void* key, uint64_t klen, const void* buf,
   e->reply.append((const char*)&len_le, sizeof(len_le));
   e->reply.append((const char*)buf, len);
   e->gen = gen;
+  if (ntags > 0 && tags != nullptr) {
+    e->tags.assign(tags, tags + ntags);
+    std::sort(e->tags.begin(), e->tags.end());
+  }
   l->cache[hv].push_back(e);
   l->cache_fifo.push_back(e);
   l->cache_bytes += klen + e->reply.size();
   l->cache_puts.fetch_add(1, std::memory_order_relaxed);
   return 1;
+}
+
+// Publish one reply: `key`/`klen` are the request body bytes the entry
+// answers (exact match), `buf`/`len` the reply frame (the length prefix
+// is prepended here), `gen` the publish generation captured UNDER the
+// engine lock with the snapshot. Returns 1 stored, 0 refused — gen below
+// the invalidation floor (an apply superseded this snapshot), cache
+// disabled, or the entry alone over budget. Oldest entries evict first
+// when the budget would overflow. Caller's buffers are copied; never
+// retained.
+int nl_cache_put(void* h, const void* key, uint64_t klen, const void* buf,
+                 uint64_t len, uint64_t gen) {
+  return nl_cache_put_tagged(h, key, klen, buf, len, gen, nullptr, 0);
 }
 
 // Invalidation-on-apply: raise the publish floor to `gen` and drop every
@@ -1582,6 +1974,67 @@ void nl_cache_invalidate(void* h, uint64_t gen) {
     l->cache_fifo.clear();
     l->cache_bytes = 0;
   }
+  l->cache_invals.fetch_add(1, std::memory_order_relaxed);
+}
+
+// Per-key invalidation (the sparse read path's ROADMAP follow-up): raise
+// the publish floor to `gen` — exactly nl_cache_invalidate's race
+// contract, so an in-flight pre-apply publish of ANY id-set is still
+// refused — but drop only the entries whose tag set intersects
+// `tags`/`ntags` (plus untagged entries, which claim nothing and must
+// stay conservative). Cached replies for id-sets disjoint from the
+// applied rows keep serving natively: their row bytes are untouched by
+// this apply — only their version STAMP now trails, which the bounded-
+// staleness contract already treats as grounds for fallback, never as a
+// correctness violation.
+void nl_cache_invalidate_tags(void* h, uint64_t gen, const uint64_t* tags,
+                              int ntags) {
+  auto* l = static_cast<NlLoop*>(h);
+  std::vector<uint64_t> want(tags, tags + (ntags > 0 ? ntags : 0));
+  std::sort(want.begin(), want.end());
+  std::lock_guard<std::mutex> lock(l->cachemu);
+  if (gen > l->cache_floor) l->cache_floor = gen;
+  // ONE partition pass over the fifo (survivors keep their eviction
+  // order), victims unlinked from their hash bucket directly — never
+  // nl_cache_erase's per-victim fifo scan, which would make a mass
+  // invalidation O(victims x entries) while every hit/publish waits on
+  // cachemu
+  std::deque<std::shared_ptr<NlCacheEntry>> keep;
+  uint64_t freed = 0;
+  for (auto& e : l->cache_fifo) {
+    bool hit = e->tags.empty();
+    if (!hit) {
+      // both sides sorted: one linear merge pass per entry
+      size_t i = 0, j = 0;
+      while (i < e->tags.size() && j < want.size()) {
+        if (e->tags[i] == want[j]) {
+          hit = true;
+          break;
+        }
+        if (e->tags[i] < want[j]) ++i;
+        else ++j;
+      }
+    }
+    if (!hit) {
+      keep.push_back(e);
+      continue;
+    }
+    freed += e->key.size() + e->reply.size();
+    uint64_t hv = nl_cache_hash(e->key.data(), e->key.size());
+    auto it = l->cache.find(hv);
+    if (it != l->cache.end()) {
+      auto& v = it->second;
+      for (size_t i = 0; i < v.size(); ++i) {
+        if (v[i] == e) {
+          v.erase(v.begin() + i);
+          break;
+        }
+      }
+      if (v.empty()) l->cache.erase(it);
+    }
+  }
+  l->cache_fifo.swap(keep);
+  l->cache_bytes -= freed;
   l->cache_invals.fetch_add(1, std::memory_order_relaxed);
 }
 

@@ -51,6 +51,12 @@ PHASES: Tuple[Tuple[str, Tuple[str, ...]], ...] = (
     # but NOT folded into the derived client/wire math — the worker's
     # wire round already contains it, like server_apply.
     ("agg_hold", ("ps_agg_hold_seconds",)),
+    # the native zero-upcall serve path (README "Native observability"):
+    # READ-hit service time measured INSIDE the epoll loop — the only
+    # latency truth for frames no Python code ever touches. Its own row,
+    # never folded into the derived math: reads are serving traffic, not
+    # part of the push/pull step envelope.
+    ("native_serve", ("ps_nl_read_hit_seconds",)),
 )
 
 
@@ -135,10 +141,12 @@ class TraceBreakdown:
     per-STEP phase costs, not of individual waits."""
 
     #: phases a trace is decomposed into (server = all cat="server"
-    #: dispatch spans; wire = root minus server minus flush_wait,
-    #: clamped — overlapped pump rounds can exceed the envelope)
+    #: dispatch spans; agg = cat="aggregator" merge spans — the two-tier
+    #: hop's own work inside a worker→aggregator→shard chain; wire = root
+    #: minus server minus flush_wait, clamped — overlapped pump rounds
+    #: can exceed the envelope)
     TRACE_PHASES = ("total", "flush_wait", "server", "server_apply",
-                    "ack_wait", "wire")
+                    "ack_wait", "agg", "wire")
 
     def __init__(self):
         self.hist: Dict[str, Histogram] = {
@@ -175,6 +183,8 @@ class TraceBreakdown:
                                     if s["name"] == "server_apply") / 1e6,
                 "ack_wait": sum(s["dur_us"] for s in ss
                                 if s["name"] == "replica_ack_wait") / 1e6,
+                "agg": sum(s["dur_us"] for s in ss
+                           if s["cat"] == "aggregator") / 1e6,
             }
             phase_s["wire"] = max(
                 total - phase_s["server"] - phase_s["flush_wait"], 0.0)

@@ -51,11 +51,16 @@ _STATS_COUNTERS = (
     ("loop_iters", "ps_van_loop_iterations_total"),
     ("loop_requests", "ps_van_loop_requests_total"),
     ("loop_upcalls", "ps_van_loop_upcalls_total"),
+    # in-loop native telemetry (README "Native observability"): frames
+    # the slow-frame watchdog captured — a fleet-wide rash of these is
+    # the page-worthy signal the per-frame ring exists for
+    ("nl_slow_frames", "ps_nl_slow_frames_total"),
 )
 
 #: TransportStats gauges (absolute, not cumulative) shipped fleet-wide
 _STATS_GAUGES = (
     ("loop_conns", "ps_van_live_connections"),
+    ("nl_tail_backlog_bytes", "ps_nl_tail_backlog_bytes"),
 )
 
 

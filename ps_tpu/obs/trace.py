@@ -219,6 +219,25 @@ class Tracer:
         self._ring.append(span)
         self._total += 1
 
+    def record_external(self, name: str, cat: str, trace_id: str,
+                        parent_id: Optional[str], ts_us: float,
+                        dur_us: float, **args) -> "Span":
+        """Record a span whose timing happened OUTSIDE Python — e.g. the
+        native event loop's slow-frame capture, whose per-stage stamps
+        were taken with no interpreter anywhere near the work. The span
+        joins the given trace (always recorded: the propagated context
+        means the root already paid the sampling decision) with explicit
+        wall-clock start and duration instead of the context-manager
+        timing."""
+        sp = Span(self, name, cat, str(trace_id), _new_id(),
+                  None if parent_id is None else str(parent_id))
+        sp.ts_us = float(ts_us)
+        sp.dur_us = max(float(dur_us), 0.0)
+        sp._tid = threading.get_ident()
+        sp.args.update(args)
+        self._record(sp)
+        return sp
+
     # -- introspection / export ------------------------------------------------
 
     def spans(self) -> List[Span]:

@@ -99,6 +99,24 @@ class TransportStats:
         ("agg_hold_s", "ps_agg_hold_seconds",
          "member pushes held at the aggregator until the merged "
          "upstream flush commits"),
+        # in-loop native telemetry (README "Native observability"): the
+        # epoll loop's own lock-free striped histograms, synced ABSOLUTE
+        # from nl_hist_snapshot on the pump's gauge tick (set_nl_hists —
+        # the native side owns the counting; these Python twins exist so
+        # the families ride /metrics, STATS frames, and the delta-encoded
+        # fleet telemetry exactly like every other latency surface). The
+        # read-hit family is the zero-upcall serve path's ONLY latency
+        # truth — no Python code ever runs on that path.
+        ("nl_read_frame_s", "ps_nl_read_frame_seconds",
+         "native loop frame read latency (first byte to frame complete)"),
+        ("nl_queue_wait_s", "ps_nl_queue_wait_seconds",
+         "native loop ready-queue wait (frame complete to pump claim)"),
+        ("nl_read_hit_s", "ps_nl_read_hit_seconds",
+         "native READ-hit service time (frame complete to reply "
+         "written, zero upcalls)"),
+        ("nl_flush_s", "ps_nl_flush_seconds",
+         "native loop staged-tail EPOLLOUT flush latency (writev "
+         "stall to drain complete)"),
     )
 
     def __init__(self, window: int = 256):
@@ -186,6 +204,12 @@ class TransportStats:
         self.loop_requests = 0
         self.loop_conns = 0       # gauge, not cumulative
         self.loop_upcalls = 0
+        # in-loop native telemetry (README "Native observability"):
+        # slow frames the watchdog ring recorded and the current
+        # staged-reply tail backlog — absolute values synced from
+        # nl_stats_snapshot, like the loop counters above
+        self.nl_slow_frames = 0
+        self.nl_tail_backlog_bytes = 0  # gauge, not cumulative
         # high-QPS read path (README "Read path"). Server side:
         # pump-served READs and the native cache's counters (absolute
         # values synced from nl_cache_stats on the pump's gauge tick).
@@ -291,6 +315,37 @@ class TransportStats:
             self.loop_iters = int(iters)
             self.loop_requests = int(requests)
             self.loop_conns = int(conns)
+
+    def set_nl_hists(self, states: Dict[str, dict]) -> None:
+        """Sync the native loop's in-loop histograms (absolute raw-state
+        overwrite — the native stripes own the counting; only the loop's
+        pump ever calls this for its endpoint, so nothing Python-side
+        records into these instruments). A state whose geometry does not
+        match the registered instrument is skipped rather than
+        mis-bucketed."""
+        import math as _math
+
+        for key, st in states.items():
+            h = self.hist.get(key)
+            if h is None or len(st["c"]) != len(h.counts) \
+                    or (st["lo"], st["hi"]) != (h.lo, h.hi):
+                continue
+            # plain slot swaps: Histogram reads tolerate racing updates
+            # by design (the registry render snapshots counts)
+            h.counts = [int(c) for c in st["c"]]
+            h.total = int(st["n"])
+            h.sum = float(st["s"])
+            h.vmax = float(st["mx"])
+            mn = st.get("mn")
+            h.vmin = _math.inf if mn is None else float(mn)
+
+    def set_nl_stats(self, slow_frames: int, tail_backlog_bytes: int
+                     ) -> None:
+        """Sync the loop's slow-frame count + staged-tail backlog gauge
+        (absolute values from nl_stats_snapshot)."""
+        with self._lock:
+            self.nl_slow_frames = int(slow_frames)
+            self.nl_tail_backlog_bytes = int(tail_backlog_bytes)
 
     def record_read_served(self) -> None:
         """Server side: one READ answered in Python (the pump path — a

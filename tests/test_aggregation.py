@@ -680,3 +680,58 @@ def test_aggregator_serves_from_native_loop():
         agg.stop()
         svc.stop()
         ps.shutdown()
+
+
+# -- trace context across the aggregator hop ----------------------------------
+
+
+def test_trace_chain_worker_aggregator_shard_resolves():
+    """A traced member push threads ONE trace through every hop: the
+    member's op span -> the aggregator's serve span -> the agg_merge
+    span (which names every constituent's trace beside the dedup
+    tokens) -> the upstream op -> the shard's dispatch -> server_apply.
+    TraceBreakdown decomposes the chain with an ``agg`` phase."""
+    from ps_tpu import obs
+    from ps_tpu.obs.breakdown import TraceBreakdown
+
+    store, svc, uri = _job()
+    agg = AggregatorService(uri, _params(), group_size=FAN_IN)
+    ws = [connect_async(uri, w, _params(),
+                        aggregator=f"127.0.0.1:{agg.port}")
+          for w in range(FAN_IN)]
+    obs.tracer().clear()
+    obs.tracer().sample = 1.0
+    try:
+        _group_rounds(ws, range(1))
+        obs.tracer().sample = 0.0
+        spans = obs.tracer().spans()
+        by_id = {s.span_id: s for s in spans}
+        applies = [s for s in spans if s.name == "server_apply"]
+        assert applies, "shard never opened a server_apply span"
+        # the apply names every constituent's trace context beside the
+        # dedup tokens the merged push carried
+        mtc = applies[0].args.get("members_tc")
+        assert mtc and len(mtc) == FAN_IN
+        # walk the parent chain: it must pass through the aggregator's
+        # merge span and terminate at a WORKER root (one trace, end to
+        # end — the first member's; the others are linked via members_tc)
+        cats, cur = [], applies[0]
+        while cur is not None:
+            cats.append(cur.cat)
+            cur = by_id.get(cur.parent_id)
+        assert "aggregator" in cats, f"no agg_merge in the chain: {cats}"
+        assert cats[-1] == "worker", f"chain rootless: {cats}"
+        # every span of the chain shares the root's trace id
+        assert len({s.trace_id for s in applies}) == 1
+        tb = TraceBreakdown()
+        assert tb.feed(spans) >= 1
+        summary = tb.summary()
+        assert "agg" in summary and summary["agg"]["count"] >= 1
+        assert "server_apply" in summary
+    finally:
+        obs.tracer().sample = 0.0
+        for w in ws:
+            w.close()
+        agg.stop()
+        svc.stop()
+        ps.shutdown()

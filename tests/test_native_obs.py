@@ -1,0 +1,346 @@
+"""In-loop native telemetry (README "Native observability").
+
+The contracts this file pins:
+
+1. **Geometry**: durations recorded by the C++ striped histograms land
+   in raw log2 buckets that merge LOSSLESSLY with the Python
+   ``Histogram`` family — two loops' snapshots ``state_add`` into fleet
+   quantiles within the documented ~19% bound of numpy over the
+   concatenated samples (mirroring PR 8's pooled-sample test, with the
+   native bucket math as the recorder).
+2. **End-to-end visibility**: a READ served entirely in C++ (zero
+   upcalls) shows up in ``ps_nl_read_hit_seconds`` on the process
+   registry (/metrics), in the STATS ``loop`` dict's ``nlp99_us``, and
+   as the ``native_serve`` phase of ``breakdown()``.
+3. **The slow-frame contract**: a frame whose in-loop latency crosses
+   ``PS_NL_SLOW_FRAME_MS`` becomes a ``slow_frame`` flight event naming
+   the conn/kind with per-stage timings — and, when the request carried
+   a ``tc`` header, a reconstructed span parented to the request's own
+   context (the zero-upcall path joins its trace).
+4. **Off switch**: ``PS_NL_STATS=0`` serves identically with empty
+   native histograms (the instrumentation must be optional).
+"""
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import ps_tpu as ps
+from ps_tpu import obs
+from ps_tpu.backends.remote_async import AsyncPSService
+from ps_tpu.control import native_loop as nl
+from ps_tpu.control import tensor_van as tv
+from ps_tpu.obs.metrics import Histogram, state_add
+
+pytestmark = pytest.mark.skipif(
+    not nl.available(),
+    reason="native event loop needs Linux epoll + the nl_* van build",
+)
+
+
+def _params():
+    return {"a/w": jnp.zeros((16, 8), jnp.float32),
+            "b/w": jnp.ones((32,), jnp.float32)}
+
+
+def _svc(**kw):
+    st = ps.KVStore(optimizer="sgd", learning_rate=0.5, mode="async")
+    st.init(_params())
+    return AsyncPSService(st, bind="127.0.0.1", native_loop=True, **kw)
+
+
+def _request(port, payload):
+    ch = tv.Channel.connect("127.0.0.1", port)
+    try:
+        return bytes(ch.request(payload))
+    finally:
+        ch.close()
+
+
+def _wait(pred, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(0.1)
+    return pred()
+
+
+# -- 1: native bucket geometry merges into fleet quantiles --------------------
+
+
+def test_native_hist_buckets_merge_into_fleet_quantiles():
+    """KNOWN durations through the REAL native bucket math (the
+    nl_hist_record test seam), two loops as two fleet members, merged
+    via state_add — quantiles within the documented ~19% log2 bound of
+    numpy over the concatenated samples, like PR 8's pooled test."""
+    rng = np.random.default_rng(7)
+    members = [
+        rng.lognormal(mean=-10, sigma=0.9, size=8000),   # fast member
+        rng.lognormal(mean=-7.5, sigma=0.6, size=8000),  # slow member
+    ]
+    merged = None
+    loops = []
+    try:
+        for xs in members:
+            lst = tv.Listener(port=0, bind="127.0.0.1")
+            loop = nl.NativeEventLoop(lst)
+            loops.append((lst, loop))
+            for x in xs:
+                loop.hist_record(2, int(x * 1e9))  # 2 = read_hit
+            st = loop.hist_snapshots()["nl_read_hit_s"]
+            # the native snapshot IS a Python-geometry state: from_state
+            # accepts it unchanged (the lossless-merge precondition)
+            assert len(st["c"]) == len(Histogram("ps_x_seconds").counts)
+            assert st["n"] == len(xs)
+            merged = state_add(merged, st)
+        allx = np.concatenate(members)
+        hm = Histogram.from_state("ps_nl_read_hit_seconds", merged)
+        assert hm.total == len(allx)
+        for q in (0.5, 0.9, 0.99, 0.999):
+            est = hm.quantile(q)
+            true = float(np.quantile(allx, q))
+            # 1.25: one sub-bucket ratio (2^(1/4) ≈ 1.19) + ns rounding
+            assert true / 1.25 <= est <= true * 1.25, (q, est, true)
+        # under/overflow bins: the native math lands edge samples where
+        # the Python recorder would
+        lst = tv.Listener(port=0, bind="127.0.0.1")
+        loop = nl.NativeEventLoop(lst)
+        loops.append((lst, loop))
+        loop.hist_record(2, 10)                  # 10 ns: underflow
+        loop.hist_record(2, int(7200 * 1e9))     # 2 h: overflow
+        st = loop.hist_snapshots()["nl_read_hit_s"]
+        assert st["c"][0] == 1 and st["c"][-1] == 1
+        assert st["mn"] == pytest.approx(1e-8)
+        assert st["mx"] == pytest.approx(7200.0)
+    finally:
+        for lst, loop in loops:
+            loop.close()
+            lst.close()
+
+
+# -- 2: the zero-upcall READ is visible end to end ----------------------------
+
+
+def test_read_hit_visible_on_metrics_stats_and_breakdown():
+    ps.init(backend="tpu", mode="async", num_workers=1, dc_lambda=0.0)
+    svc = _svc()
+    try:
+        payload = tv.encode(tv.READ, 0, None)
+        miss = _request(svc.port, payload)   # pump path; publishes
+        hit = _request(svc.port, payload)    # served entirely in C++
+        assert hit == miss
+        # the pump syncs the native states ~1/s
+        assert _wait(lambda: svc.transport.hist["nl_read_hit_s"].total
+                     >= 1), "native read-hit histogram never synced"
+        # /metrics: the family renders from the process registry
+        snap = obs.default_registry().snapshot()
+        assert snap.get("ps_nl_read_hit_seconds", {}).get("count", 0) >= 1
+        assert "ps_nl_read_hit_seconds" in obs.default_registry() \
+            .render_prometheus()
+        # STATS loop dict: the ps_top nlp99/qw99 columns' source
+        kind, _, _, extra = tv.decode(memoryview(_request(
+            svc.port, tv.encode(tv.STATS, 0, None))))
+        assert kind == tv.OK
+        loop = extra["loop"]
+        assert loop["nlp99_us"] > 0
+        assert "qw99_us" in loop and "slow_frames" in loop
+        # breakdown(): the native_serve phase
+        bd = obs.breakdown(lambda m: snap.get(m))
+        assert bd["native_serve"]["metric"] == "ps_nl_read_hit_seconds"
+        assert bd["native_serve"]["count"] >= 1
+        # frame-read + queue-wait families counted too (the pump path)
+        assert svc.transport.hist["nl_read_frame_s"].total >= 2
+        assert svc.transport.hist["nl_queue_wait_s"].total >= 1
+    finally:
+        svc.stop()
+        ps.shutdown()
+
+
+def test_read_hit_merges_into_coordinator_fleet_quantiles():
+    """The whole PR-8 pipeline over the native families: a REAL loop's
+    synced read-hit state rides collect_telemetry -> delta wire ->
+    decode -> FleetTSDB, and two members' raw buckets merge into one
+    pooled fleet quantile (count = sum of members; p99 inside the
+    observed range)."""
+    import json as _json
+
+    from ps_tpu.obs.collector import (
+        DeltaDecoder,
+        DeltaEncoder,
+        collect_telemetry,
+    )
+    from ps_tpu.obs.tsdb import FleetTSDB
+
+    ps.init(backend="tpu", mode="async", num_workers=1, dc_lambda=0.0)
+    svc = _svc()
+    try:
+        payload = tv.encode(tv.READ, 0, None)
+        _request(svc.port, payload)
+        for _ in range(3):
+            _request(svc.port, payload)  # native hits
+        assert _wait(lambda: svc.transport.hist["nl_read_hit_s"].total
+                     >= 3)
+        n_hits = svc.transport.hist["nl_read_hit_s"].total
+        tsdb = FleetTSDB(window_s=30.0)
+        for member in ("shard0", "shard1"):
+            enc = DeltaEncoder(lambda: collect_telemetry(svc.transport))
+            wire = _json.loads(_json.dumps(enc.snapshot()))  # van round trip
+            state = DeltaDecoder().ingest(wire)
+            assert state is not None
+            assert "ps_nl_read_hit_seconds" in state
+            tsdb.ingest(member, state)
+        win = tsdb.fleet_window("ps_nl_read_hit_seconds")
+        assert win and win["summary"]["count"] == 2 * n_hits
+        p99 = tsdb.quantile("ps_nl_read_hit_seconds", 0.99)
+        mx = svc.transport.hist["nl_read_hit_s"].vmax
+        assert p99 is not None and 0 < p99 <= mx
+    finally:
+        svc.stop()
+        ps.shutdown()
+
+
+def test_nl_stats_off_serves_with_empty_histograms(monkeypatch):
+    monkeypatch.setenv("PS_NL_STATS", "0")
+    ps.init(backend="tpu", mode="async", num_workers=1, dc_lambda=0.0)
+    svc = _svc()
+    try:
+        assert not svc._nl_stats
+        payload = tv.encode(tv.READ, 0, None)
+        r1 = _request(svc.port, payload)
+        r2 = _request(svc.port, payload)
+        assert r1 == r2
+        time.sleep(1.2)  # a pump tick passes without syncing anything
+        assert svc.transport.hist["nl_read_hit_s"].total == 0
+        assert svc._nloop.hist_snapshots()["nl_read_frame_s"]["n"] == 0
+        assert "nlp99_us" not in svc.replica_state()["loop"]
+    finally:
+        svc.stop()
+        ps.shutdown()
+
+
+# -- 3: the slow-frame drill --------------------------------------------------
+
+
+def test_slow_frame_drill_names_conn_kind_and_links_trace(monkeypatch):
+    """Artificially slow apply: a PUSH that sleeps on the pump makes the
+    next traced READ's queue wait cross the 5 ms watchdog bar — the
+    drill asserts the flight event names the right conn/kind, carries
+    per-stage timings, and links the propagated trace id, and that the
+    reconstructed span parents to the request's own context."""
+    monkeypatch.setenv("PS_NL_SLOW_FRAME_MS", "5")
+    ps.init(backend="tpu", mode="async", num_workers=2, dc_lambda=0.0)
+    svc = _svc()
+    orig = svc._handle
+
+    def slow_handle(kind, worker, tensors, extra):
+        if kind == tv.PUSH:
+            time.sleep(0.08)  # well past the 5 ms bar
+        return orig(kind, worker, tensors, extra)
+
+    svc._handle = slow_handle
+    obs.flight().clear()
+    tid, sid = "f" * 16, "0" * 16
+    try:
+        grads = {k: np.full(np.asarray(v).shape, 0.01, np.float32)
+                 for k, v in _params().items()}
+        ch1 = tv.Channel.connect("127.0.0.1", svc.port)
+        ch2 = tv.Channel.connect("127.0.0.1", svc.port)
+        try:
+            # the PUSH occupies the pump; the traced READ queues behind it
+            ch1.send(tv.encode(tv.PUSH, 0, grads))
+            time.sleep(0.01)
+            ch2.send(tv.encode(tv.READ, 0, None,
+                               extra={obs.WIRE_KEY: [tid, sid]}))
+            ch1.recv()
+            ch2.recv()
+        finally:
+            ch1.close()
+            ch2.close()
+
+        def drilled():
+            return [e for e in obs.flight().events()
+                    if e["kind"] == "slow_frame"
+                    and e.get("trace_id") == tid]
+
+        def respanned():
+            return [s for s in obs.tracer().spans()
+                    if s.name == "slow_frame" and s.trace_id == tid]
+        # wait for BOTH surfaces: the pump records the event and the
+        # reconstructed span a few bytecodes apart, and this thread can
+        # observe the gap
+        assert _wait(lambda: drilled() and respanned()), \
+            f"no traced slow_frame: {obs.flight().events()[-5:]}"
+        evt = drilled()[0]
+        assert evt["wire_kind"] == "read"
+        assert evt["conn"] > 0 and evt["size"] > 0
+        assert evt["wait_ms"] > 5.0  # the queue wait IS the incident
+        spans = respanned()
+        assert spans[0].parent_id == sid
+        assert spans[0].dur_us >= 5_000
+        assert spans[0].args["wire_kind"] == "read"
+        # the watchdog count rode STATS/fleet telemetry too
+        assert _wait(lambda: svc.transport.nl_slow_frames >= 1)
+    finally:
+        svc.stop()
+        ps.shutdown()
+
+
+# -- 4: knobs + tool plumbing -------------------------------------------------
+
+
+def test_nl_knobs_four_way_synced(monkeypatch):
+    import dataclasses
+    import inspect
+    import os
+
+    from ps_tpu import config as cfgmod
+
+    cfg = cfgmod.Config()
+    assert cfg.nl_stats is True and cfg.nl_slow_frame_ms == 250.0
+    monkeypatch.setenv("PS_NL_STATS", "0")
+    monkeypatch.setenv("PS_NL_SLOW_FRAME_MS", "12.5")
+    cfg = cfgmod.Config.from_env()
+    assert cfg.nl_stats is False and cfg.nl_slow_frame_ms == 12.5
+    with pytest.raises(ValueError):
+        cfgmod.Config(nl_slow_frame_ms=-1)
+    fields = {f.name for f in dataclasses.fields(cfgmod.Config)}
+    assert {"nl_stats", "nl_slow_frame_ms"} <= fields
+    assert "PS_NL_STATS" in cfgmod.__doc__
+    assert "PS_NL_SLOW_FRAME_MS" in cfgmod.__doc__
+    assert "nl_stats:" in cfgmod.Config.__doc__
+    assert "nl_slow_frame_ms:" in cfgmod.Config.__doc__
+    src = inspect.getsource(cfgmod)
+    assert "PS_NL_STATS" in src and "PS_NL_SLOW_FRAME_MS" in src
+    readme = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "README.md")
+    with open(readme) as f:
+        text = f.read()
+    for name in ("PS_NL_STATS", "PS_NL_SLOW_FRAME_MS", "nl_stats",
+                 "nl_slow_frame_ms", "ps_nl_read_hit_seconds"):
+        assert name in text, f"README lost {name}"
+
+
+def test_ps_doctor_native_section_from_fleet_telemetry():
+    import sys
+
+    sys.path.insert(0, "tools")
+    try:
+        from ps_doctor import native_section
+    finally:
+        sys.path.remove("tools")
+    tel = {
+        "fleet": {
+            "ps_nl_read_hit_seconds": {"count": 42, "p50": 1e-5,
+                                       "p99": 3e-5, "p999": 5e-5},
+            "ps_nl_queue_wait_seconds": {"count": 40, "p50": 2e-5,
+                                         "p99": 9e-5, "p999": 2e-4},
+        },
+        "counters": {"ps_nl_slow_frames_total": {"delta": 3}},
+    }
+    out = native_section(tel)
+    assert out == {"read_hit_p99_ms": 0.03, "read_hits": 42,
+                   "queue_wait_p99_ms": 0.09, "slow_frames": 3}
+    assert native_section({"fleet": {}, "counters": {}}) == {}

@@ -471,6 +471,69 @@ def test_connect_budget_env_bounds_dead_dial(monkeypatch):
     assert time.monotonic() - t0 < 5.0
 
 
+# -- per-key sparse invalidation (ROADMAP PR-12 follow-up) --------------------
+
+
+def test_sparse_per_key_invalidation_keeps_disjoint_sets_native():
+    """A sparse row apply bumps the generation floor for everyone but
+    drops ONLY cached id-sets intersecting the applied ids: under a
+    push churn over one id-set, a disjoint hot set keeps serving
+    natively (hits grow with no republish), while the touched set's
+    entry drops and republishes with the post-apply rows."""
+    import jax
+
+    from ps_tpu.backends.remote_sparse import (
+        SparsePSService,
+        connect_sparse,
+    )
+    from ps_tpu.kv.sparse import SparseEmbedding
+
+    ps.init(backend="tpu", mode="async", num_workers=1)
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:1]), ("data",))
+    emb = SparseEmbedding(64, 8, optimizer="sgd", learning_rate=0.5,
+                          mesh=mesh)
+    emb.init(np.random.default_rng(0)
+             .normal(0, 0.01, (64, 8)).astype(np.float32))
+    svc = SparsePSService({"deep": emb}, native_loop=True)
+    hot = tv.encode(tv.READ, 0,
+                    {"deep/ids": np.array([1, 2, 3], np.int32)})
+    cold = tv.encode(tv.READ, 0,
+                     {"deep/ids": np.array([40, 41], np.int32)})
+    try:
+        m_hot, m_cold = _raw_read(svc.port, hot), _raw_read(svc.port, cold)
+        assert _raw_read(svc.port, hot) == m_hot    # both cached now
+        assert _raw_read(svc.port, cold) == m_cold
+        cs0 = svc._nloop.cache_stats()
+        w = connect_sparse(f"127.0.0.1:{svc.port}", 0, {"deep": (64, 8)})
+        try:
+            # churn: several applies, all intersecting ONLY the hot set
+            for i in range(4):
+                w.push({"deep": (np.array([2], np.int32),
+                                 np.full((1, 8), 0.1 * (i + 1),
+                                         np.float32))})
+                # the untouched set keeps serving its exact bytes —
+                # NATIVELY (asserted via the hit counter below)
+                assert _raw_read(svc.port, cold) == m_cold
+            # the touched set dropped: its next read republishes the
+            # post-apply rows (different bytes)
+            assert _raw_read(svc.port, hot) != m_hot
+        finally:
+            w.close()
+        cs1 = _cache_settled(
+            svc, lambda c: c["hits"] >= cs0["hits"] + 4
+            and c["puts"] >= cs0["puts"] + 1)
+        # every churn-loop cold read was a native hit (no cold republish
+        # needed: exactly one extra put — the hot set's)
+        assert cs1["hits"] >= cs0["hits"] + 4, (cs0, cs1)
+        assert cs1["invalidations"] >= cs0["invalidations"] + 4
+        # the floor still rose per apply: the publish-vs-apply race
+        # stays closed even for disjoint sets
+        assert cs1["floor"] >= cs0["floor"] + 4
+    finally:
+        svc.stop()
+        ps.shutdown()
+
+
 # -- aggregator members read through the coalesced snapshot -------------------
 
 

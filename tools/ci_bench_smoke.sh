@@ -376,8 +376,28 @@ assert det["replica_read_share"] > 0.2, \
     f"reads not spreading over the replica set: {det['replica_read_share']}"
 assert det["staleness_drill"]["violations"] == 0, \
     f"staleness bound violated: {det['staleness_drill']}"
+# in-loop telemetry (README "Native observability"): the zero-upcall
+# READ-hit latency must be visible END TO END — native striped buckets
+# -> pump sync -> /metrics — with a sane p99 (a native hit is a memcmp
+# + a writev: microseconds, never approaching a second)
+nl = det["nl_read_hit_metrics"]
+assert nl["on_metrics"] and nl["count"] > 0, \
+    f"ps_nl_read_hit_seconds missing from /metrics: {nl}"
+assert nl["p99_ms"] is not None and 0 < nl["p99_ms"] < 1000.0, \
+    f"native read-hit p99 insane: {nl}"
+assert det["native_hit_p99_us"] and det["native_hit_p99_us"] > 0, det
+# instrumentation must not tax the path it measures: stats-on vs
+# stats-off read QPS (quiet-hardware bar < 2%; the CI bound is loose
+# because best-of-2 windows on a 2-core host carry scheduler noise)
+assert det["telemetry_overhead_pct"] < 25.0, \
+    f"in-loop telemetry overhead way over budget: " \
+    f"{det['telemetry_overhead_pct']}%"
 print(f"  scaling {det['read_scaling']}x, read_all p99 "
       f"{det['read_p99_ms']}ms, replica share "
       f"{det['replica_read_share']}, staleness violations 0")
+print(f"  native hit p99 {det['native_hit_p99_us']}us "
+      f"(/metrics count {nl['count']}, p99 {nl['p99_ms']}ms); "
+      f"nl-stats overhead {det['telemetry_overhead_pct']}% "
+      f"({det['nl_stats_off_qps']} -> {det['nl_stats_on_qps']} reads/s)")
 print("serve read-path smoke OK")
 EOF

@@ -44,6 +44,28 @@ def _ms(v) -> str:
     return "-" if v is None else f"{v:8.3f}"
 
 
+def native_section(tel: dict) -> dict:
+    """The native event loop's fleet view (README "Native
+    observability"): the in-loop p99s ps_top's nlp99/qw99 columns show,
+    from the same merged fleet quantiles — plus the windowed slow-frame
+    count. Empty dict when no member serves through the loop (nothing
+    reported the ps_nl_* families)."""
+    fleet = tel.get("fleet") or {}
+    counters = tel.get("counters") or {}
+    out: dict = {}
+    rh = fleet.get("ps_nl_read_hit_seconds")
+    if rh:
+        out["read_hit_p99_ms"] = round(rh["p99"] * 1e3, 3)
+        out["read_hits"] = int(rh["count"])
+    qw = fleet.get("ps_nl_queue_wait_seconds")
+    if qw:
+        out["queue_wait_p99_ms"] = round(qw["p99"] * 1e3, 3)
+    if out:
+        sf = counters.get("ps_nl_slow_frames_total") or {}
+        out["slow_frames"] = int(sf.get("delta", 0))
+    return out
+
+
 def render(view: dict, tel: dict, stream=sys.stdout) -> None:
     table = view.get("table") or {}
     print(f"== ps_doctor: fleet of {len(table.get('shards') or [])} "
@@ -92,12 +114,25 @@ def render(view: dict, tel: dict, stream=sys.stdout) -> None:
                           for name, c in sorted(counters.items())),
               file=stream)
 
+    native = native_section(tel)
+    if native:
+        print("\n-- native loop (in-loop telemetry) --", file=stream)
+        if "read_hit_p99_ms" in native:
+            print(f"  read-hit serve p99 {native['read_hit_p99_ms']:8.3f}"
+                  f"ms over {native.get('read_hits', 0)} hit(s) "
+                  f"(zero upcalls)", file=stream)
+        if "queue_wait_p99_ms" in native:
+            print(f"  ready-queue wait p99 "
+                  f"{native['queue_wait_p99_ms']:8.3f}ms", file=stream)
+        print(f"  slow frames (window): {native.get('slow_frames', 0)}",
+              file=stream)
+
     print("\n-- per-step breakdown --", file=stream)
     bd = tel.get("breakdown") or {}
     if not bd:
         print("  (no step telemetry yet)", file=stream)
     order = ("total", "flush_wait", "wire_round", "wire", "server_apply",
-             "ack_wait", "client")
+             "ack_wait", "agg_hold", "native_serve", "client")
     for phase in order:
         row = bd.get(phase)
         if not row:
@@ -157,7 +192,8 @@ def main(argv=None) -> int:
               file=sys.stderr)
         return 2
     if args.json:
-        print(json.dumps({"view": view, "telemetry": tel}, default=str))
+        print(json.dumps({"view": view, "telemetry": tel,
+                          "native": native_section(tel)}, default=str))
     else:
         render(view, tel)
     unhealthy = bool(tel.get("stragglers")) or any(

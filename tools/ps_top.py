@@ -55,8 +55,8 @@ COLS = [
     ("epoch", 5), ("version", 9),
     ("applies", 9), ("lag", 5), ("repl", 14), ("dedup", 6), ("stale", 6),
     ("moved", 8), ("gbps", 7), ("ack_p99_ms", 10), ("bkt_p99_ms", 10),
-    ("loop", 10), ("reads", 8), ("nhit%", 6), ("chit%", 6),
-    ("rshare%", 7),
+    ("loop", 10), ("nlp99", 8), ("qw99", 8), ("reads", 8), ("nhit%", 6),
+    ("chit%", 6), ("rshare%", 7),
 ]
 
 COORD_COLS = [
@@ -133,6 +133,7 @@ def render_row(st: dict) -> dict:
                 "applies": "-", "lag": "-", "repl": st["error"][:24],
                 "dedup": "-", "stale": "-", "moved": "-", "gbps": "-",
                 "ack_p99_ms": "-", "bkt_p99_ms": "-", "loop": "-",
+                "nlp99": "-", "qw99": "-",
                 "reads": "-", "nhit%": "-", "chit%": "-",
                 "rshare%": "-"}
     repl = st.get("repl") or {}
@@ -175,6 +176,11 @@ def render_row(st: dict) -> dict:
         "loop": (f"{st['loop'].get('conns', 0)}c/"
                  f"{st['loop'].get('requests', 0)}r"
                  if isinstance(st.get("loop"), dict) else "-"),
+        # in-loop native p99s (µs, from the STATS loop dict — README
+        # "Native observability"): zero-upcall READ-hit serve time and
+        # the ready-queue wait pump-bound frames pay before dispatch
+        "nlp99": _loop_us(st, "nlp99_us"),
+        "qw99": _loop_us(st, "qw99_us"),
         # serve-path read columns (README "Read path"): total READs this
         # endpoint answered (native hits + Python-served) and the
         # native-cache hit share. Backups answering reads show up as
@@ -188,6 +194,15 @@ def render_row(st: dict) -> dict:
         # row of a shard — the read-replica share of its traffic)
         "rshare%": _opt(st.get("_rshare")),
     }
+
+
+def _loop_us(st: dict, key: str):
+    """One native in-loop p99 cell, rendered as ``<µs>u`` ("-" when the
+    endpoint serves threaded, or the histogram is still empty)."""
+    loop = st.get("loop")
+    if not isinstance(loop, dict) or loop.get(key) is None:
+        return "-"
+    return f"{loop[key]:.0f}u"
 
 
 def _reads_total(st: dict):

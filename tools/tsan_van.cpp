@@ -66,8 +66,18 @@ void nl_stop(void* h);
 void nl_cache_config(void* h, int kind, uint64_t max_bytes);
 int nl_cache_put(void* h, const void* key, uint64_t klen, const void* buf,
                  uint64_t len, uint64_t gen);
+int nl_cache_put_tagged(void* h, const void* key, uint64_t klen,
+                        const void* buf, uint64_t len, uint64_t gen,
+                        const uint64_t* tags, int ntags);
 void nl_cache_invalidate(void* h, uint64_t gen);
+void nl_cache_invalidate_tags(void* h, uint64_t gen, const uint64_t* tags,
+                              int ntags);
 void nl_cache_stats(void* h, uint64_t* out);
+void nl_telemetry_config(void* h, int stats_on, uint64_t slow_frame_ns);
+int nl_hist_snapshot(void* h, int which, uint64_t* out);
+void nl_stats_snapshot(void* h, uint64_t* out);
+int nl_slow_drain(void* h, uint64_t* vals, char* tids, int cap);
+void nl_hist_record(void* h, int which, uint64_t ns);
 }
 
 static void sleep_ms(int ms) {
@@ -512,11 +522,16 @@ int main() {
   // --- native read cache (nl_cache_*): publish-while-serve churn — the
   // read path's three concurrent parties all live at once: loop threads
   // answering cache hits (nl_cache_serve under cachemu then wmu), the
-  // pump publishing replies on misses (nl_cache_put), and an "applier"
-  // thread bumping the invalidation floor on a tight cadence
-  // (nl_cache_invalidate — the invalidation-on-apply race), while a
-  // stats thread hammers nl_cache_stats. Clients verify every reply —
-  // hit or miss — echoes their request bytes exactly.
+  // pump publishing replies on misses (nl_cache_put / nl_cache_put_tagged
+  // — TAGGED on alternate keys, exercising the per-key entry metadata),
+  // and an "applier" thread bumping the invalidation floor on a tight
+  // cadence (alternating full nl_cache_invalidate with per-key
+  // nl_cache_invalidate_tags — the invalidation-on-apply race, both
+  // flavors), while a stats thread hammers nl_cache_stats PLUS the whole
+  // in-loop telemetry surface (nl_stats_snapshot, every nl_hist_snapshot,
+  // nl_slow_drain) with the slow-frame watchdog armed at 1 ns so EVERY
+  // served frame also contends the slow ring. Clients verify every reply
+  // — hit or miss — echoes their request bytes exactly.
   {
     void* clst = tv_listen("127.0.0.1", 0, 64);
     if (!clst) { std::fprintf(stderr, "cache listen failed\n"); return 1; }
@@ -524,20 +539,36 @@ int main() {
     if (!loop) { std::fprintf(stderr, "cache nl_start failed\n"); return 1; }
     const char kCacheKind = 0x42;
     nl_cache_config(loop, kCacheKind, 1u << 20);
+    nl_telemetry_config(loop, 1, 1);  // stats on; everything is "slow"
     int cport = tv_listener_port(clst);
     std::atomic<bool> cstop{false};
     std::atomic<uint64_t> genctr{0};
     std::atomic<int> cserved{0};
-    std::thread applier([&] {  // invalidation-on-apply churn
+    std::thread applier([&] {  // invalidation-on-apply churn, both flavors
+      uint64_t round = 0;
       while (!cstop.load()) {
-        nl_cache_invalidate(loop, genctr.fetch_add(1) + 1);
+        uint64_t g = genctr.fetch_add(1) + 1;
+        if (++round % 2 == 0) {
+          nl_cache_invalidate(loop, g);
+        } else {
+          // tag 0 matches half the tagged entries; untagged entries
+          // drop too (the conservative contract under TSan churn)
+          uint64_t tags[2] = {0, round};
+          nl_cache_invalidate_tags(loop, g, tags, 2);
+        }
         sleep_ms(1);
       }
     });
-    std::thread cstats([&] {
+    std::thread cstats([&] {  // stats-while-serve: the whole read surface
       uint64_t out[8];
+      uint64_t hist[4 + 160];
+      uint64_t svals[7 * 8];
+      char stids[2 * 20 * 8];
       while (!cstop.load()) {
         nl_cache_stats(loop, out);
+        nl_stats_snapshot(loop, out);
+        for (int w = 0; w < 4; ++w) nl_hist_snapshot(loop, w, hist);
+        nl_slow_drain(loop, svals, stids, 8);
         sleep_ms(1);
       }
     });
@@ -555,8 +586,17 @@ int main() {
           nl_reply_vec(loop, ids[i], bufs, ls, 1, 0, 0);
           if (lens[i] >= 1 && ((char*)bodies[i])[0] == kCacheKind) {
             // publish the echo under the request's own bytes — some of
-            // these race the applier and are refused at the floor
-            nl_cache_put(loop, bodies[i], lens[i], bodies[i], lens[i], g);
+            // these race the applier and are refused at the floor;
+            // alternate tagged and untagged entries by the key selector
+            char sel = lens[i] >= 2 ? ((char*)bodies[i])[1] : 0;
+            if (sel % 2 == 0) {
+              uint64_t tags[1] = {(uint64_t)sel};
+              nl_cache_put_tagged(loop, bodies[i], lens[i], bodies[i],
+                                  lens[i], g, tags, 1);
+            } else {
+              nl_cache_put(loop, bodies[i], lens[i], bodies[i], lens[i],
+                           g);
+            }
           }
           nl_body_free(loop, bodies[i]);
           cserved.fetch_add(1);
@@ -602,6 +642,28 @@ int main() {
     cpump.join();
     uint64_t cs[8];
     nl_cache_stats(loop, cs);
+    // in-loop telemetry landed: read latency + read-hit serve histograms
+    // counted, and the 1 ns watchdog filled the slow ring (drain sanity:
+    // every entry names a conn and a stage time)
+    uint64_t hist[4 + 160];
+    int nb = nl_hist_snapshot(loop, 0, hist);
+    uint64_t frames_counted = hist[0];
+    if (nb <= 0 || nl_hist_snapshot(loop, 2, hist) != nb) {
+      std::fprintf(stderr, "nl_hist_snapshot bucket counts drifted\n");
+      return 1;
+    }
+    uint64_t hits_counted = hist[0];
+    uint64_t nlst[8];
+    nl_stats_snapshot(loop, nlst);
+    uint64_t svals[7 * 8];
+    char stids[2 * 20 * 8];
+    int drained = nl_slow_drain(loop, svals, stids, 8);
+    for (int i = 0; i < drained; ++i) {
+      if (svals[i * 7 + 0] == 0) {
+        std::fprintf(stderr, "slow-frame entry names no conn\n");
+        return 1;
+      }
+    }
     nl_stop(loop);
     tv_listener_close(clst);
     if (cok.load() < 400) {
@@ -616,10 +678,23 @@ int main() {
                    (unsigned long long)cs[2], (unsigned long long)cs[4]);
       return 1;
     }
+    if (frames_counted == 0 || hits_counted == 0 || nlst[3] == 0) {
+      std::fprintf(stderr,
+                   "in-loop telemetry never counted under churn: "
+                   "frames=%llu hits=%llu slow=%llu\n",
+                   (unsigned long long)frames_counted,
+                   (unsigned long long)hits_counted,
+                   (unsigned long long)nlst[3]);
+      return 1;
+    }
     std::printf("nl read-cache churn: OK (%d ok, %llu hits, %llu puts, "
-                "%llu invals, %llu rejects)\n", cok.load(),
+                "%llu invals, %llu rejects; telemetry frames=%llu "
+                "hit-samples=%llu slow=%llu drained=%d)\n", cok.load(),
                 (unsigned long long)cs[0], (unsigned long long)cs[2],
-                (unsigned long long)cs[4], (unsigned long long)cs[3]);
+                (unsigned long long)cs[4], (unsigned long long)cs[3],
+                (unsigned long long)frames_counted,
+                (unsigned long long)hits_counted,
+                (unsigned long long)nlst[3], drained);
   }
 
   std::printf("tsan van driver: OK\n");
