@@ -1792,6 +1792,15 @@ def bench_widedeep(args, retried: bool):
         )
     else:
         flops, flops_src = None, None
+    # sparse-apply trajectory (README "Sparse apply"): rows applied per
+    # second through whichever tier the tables resolved to, plus the
+    # analytic HBM bytes/apply under the gathered-slab vs full-table
+    # designs — so the fused-path claim is a recorded number per round,
+    # not a one-off log line (the focused A/B lives in --model sparse_apply)
+    from ps_tpu.ops.sparse_apply import hbm_bytes_model
+    rows_per_push = ((deep.rows_pushed + wide.rows_pushed)
+                     / max(deep.push_count, 1))
+    batch_rows = batch_size * cfg.num_sparse  # ids per push per table
     _emit(
         "widedeep_examples_per_sec_per_chip",
         steps * batch_size / dt / ndev, "examples/sec/chip",
@@ -1803,6 +1812,13 @@ def bench_widedeep(args, retried: bool):
             "embed_rows_total": cfg.total_rows,
             "embed_dim": cfg.embed_dim,
             "sparse_row_traffic_gb": round(row_gb, 4),
+            "sparse_apply": {
+                "tier": deep.fused_tier,
+                "rows_applied_per_s": round(
+                    rows_per_push * steps / dt, 1),
+                "hbm_bytes_per_apply": hbm_bytes_model(
+                    cfg.total_rows, cfg.embed_dim, batch_rows, deep._opt),
+            },
         },
         note=(
             "Wide&Deep composite step: sharded-table row gather + dense "
@@ -1814,11 +1830,102 @@ def bench_widedeep(args, retried: bool):
     )
 
 
+# -- sparse_apply -------------------------------------------------------------
+
+
+def bench_sparse_apply(args, retried: bool):
+    """Fused vs full-table sparse apply A/B (ROADMAP item 6; README
+    "Sparse apply"): identical push streams against a table >=100x the
+    batch id-set, through the legacy masked full-table tier ('off') and
+    the platform's fast fused tier (pallas on TPU, jax elsewhere).
+    Reports rows-applied/s for both, the speedup, the analytic HBM
+    bytes/apply under each design, and the measured numerical parity of
+    the final tables — the >=2x acceptance claim as a recorded
+    trajectory in the BENCH json."""
+    import numpy as np
+
+    from ps_tpu.kv.sparse import SparseEmbedding
+    from ps_tpu.ops.sparse_apply import hbm_bytes_model, resolve_tier
+
+    dev = jax.devices()[0]
+    ndev = len(jax.devices())
+    on_tpu = dev.platform == "tpu"
+    # table = 256x the push id-set: comfortably inside the >=100x regime
+    # the acceptance bar names (and item 3's hot-tier regime)
+    vocab = (1 << 18) if on_tpu else (1 << 17)
+    dim = 64 if on_tpu else 32
+    batch = vocab // 256
+    steps = 50 if on_tpu else (20 if args.quick else 40)
+    fast = resolve_tier(None)  # the platform's fast tier
+
+    ps.init(backend="tpu")
+    rng = np.random.default_rng(0)
+    ids_seq = [rng.integers(0, vocab, size=batch).astype(np.int32)
+               for _ in range(4)]
+    grads_seq = [(rng.normal(size=(batch, dim)) * 0.01).astype(np.float32)
+                 for _ in range(4)]
+
+    def run_tier(tier):
+        emb = SparseEmbedding(vocab, dim, optimizer="adagrad",
+                              learning_rate=0.05, fused_apply=tier)
+        emb.init(jax.random.key(0), scale=0.01)
+        for i in range(2):  # warmup: compile both jit wrappers
+            emb.push(ids_seq[i % 4], grads_seq[i % 4])
+        jax.block_until_ready(emb.table)
+        t0 = time.time()
+        for i in range(steps):
+            emb.push(ids_seq[i % 4], grads_seq[i % 4])
+        jax.block_until_ready(emb.table)
+        dt = max(time.time() - t0, 1e-9)
+        return emb, steps * batch / dt
+
+    emb_off, rows_off = run_tier("off")
+    emb_fast, rows_fast = run_tier(fast)
+    t_off = np.asarray(emb_off.table)
+    t_fast = np.asarray(emb_fast.table)
+    model = hbm_bytes_model(vocab, dim, batch, emb_fast._opt)
+    speedup = round(rows_fast / max(rows_off, 1e-9), 2)
+    _emit(
+        "sparse_rows_applied_per_s", rows_fast / ndev, "rows/sec/chip",
+        ndev=ndev, dev=dev, batch_size=batch, timed_steps=steps,
+        rep_times=None, retried=retried, input_mode="preplaced",
+        loss=None, flops=None, flops_src=None,
+        dt=steps * batch / max(rows_fast, 1e-9), summary=None,
+        extra_detail={
+            "tier": fast,
+            "table_rows": vocab,
+            "embed_dim": dim,
+            "batch_ids": batch,
+            "table_to_batch_x": vocab // batch,
+            "rows_applied_per_s": {"off": round(rows_off, 1),
+                                   fast: round(rows_fast, 1)},
+            "speedup_x": speedup,
+            "hbm_bytes_per_apply": model,
+            # parity of the identical push streams: bitwise is expected
+            # for adagrad (fixed reduction order); allclose is the bar
+            "parity_bitwise": bool(np.array_equal(t_off, t_fast)),
+            "parity_allclose": bool(np.allclose(t_off, t_fast,
+                                                rtol=1e-6, atol=1e-7)),
+            "parity_max_abs": float(np.max(np.abs(t_off - t_fast))),
+        },
+        note=(
+            "in-process SparseEmbedding push stream, adagrad rows; 'off' "
+            "is the legacy masked full-table apply (O(table) HBM "
+            "traffic), the fast tier is the fused batch-sized "
+            "gather->apply->scatter (ps_tpu/ops/sparse_apply.py); "
+            "hbm_bytes_per_apply is the analytic lower-bound model of "
+            "both designs, speedup_x the measured rows/s ratio at a "
+            "table 256x the push id-set (detail.table_to_batch_x)"
+        ),
+    )
+
+
 def main(argv=None, retried: bool = False):
     ap = argparse.ArgumentParser()
     ap.add_argument("--model", default="resnet",
                     choices=["resnet", "bert", "widedeep", "transport",
-                             "failover", "rebalance", "serve"])
+                             "failover", "rebalance", "serve",
+                             "sparse_apply"])
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--transport-mb", type=float, default=96.0,
                     help="(transport) parameter-tree size for the van "
@@ -1867,7 +1974,7 @@ def main(argv=None, retried: bool = False):
         args.per_chip_batch = {"resnet": 256, "bert": 128,
                                "widedeep": 4096, "transport": 0,
                                "failover": 0, "rebalance": 0,
-                               "serve": 0}[args.model]
+                               "serve": 0, "sparse_apply": 0}[args.model]
 
     if ps.is_initialized():  # retry path: reset the runtime
         ps.shutdown()
@@ -1879,7 +1986,8 @@ def main(argv=None, retried: bool = False):
      "transport": bench_transport,
      "failover": bench_failover,
      "rebalance": bench_rebalance,
-     "serve": bench_serve}[args.model](args, retried)
+     "serve": bench_serve,
+     "sparse_apply": bench_sparse_apply}[args.model](args, retried)
 
 
 def _is_transport_error(e: BaseException) -> bool:

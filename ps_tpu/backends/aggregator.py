@@ -410,7 +410,18 @@ class AggregatorService(VanService):
         """The group's shared snapshot for the CURRENT round: served from
         the last merged flush when fresh, else ONE upstream wire fetch —
         concurrent readers wait on the same fetch instead of fanning N
-        identical pulls over the cross-host path."""
+        identical pulls over the cross-host path.
+
+        The wire fetch is a ``read_all`` (README "Read path"), not a
+        pull: a coalesced fetch between flushes is a serving read, so it
+        rides the shard's native zero-upcall cache and its replica set —
+        and, crucially, it needs no ``_ulock`` (dedicated read channels,
+        never the flusher's framed stream), so a read-mostly member no
+        longer waits out a merged flush to refresh its snapshot. The
+        upstream DC stale snapshot stays pinned to the last flush's
+        push_pull — which is the snapshot the group's grads were
+        computed against when rounds are flowing; a mid-round coalesced
+        read deliberately does not move it."""
         while True:
             with self._rcv:
                 rid = self._rounds_done
@@ -423,10 +434,12 @@ class AggregatorService(VanService):
                     continue
                 self._pull_fetching = True
             try:
-                with self._ulock:  # never drive the shared upstream
-                    # client concurrently with the flusher
-                    params = self._client.pull_all()
-                    version = self._client.version
+                # AS-SERVED version, atomic with the bytes: the known
+                # self._client.version can run ahead of a bounded-stale
+                # replica read (or a flush decoding acks mid-read), and
+                # a snapshot stamped newer than its bytes would park
+                # stale rows in members' version-keyed caches
+                params, version = self._client.read_all_versioned()
                 kv, _ = keymod.flatten_with_keys(params)
                 snap = {"round": rid,
                         "kv": {k: np.ascontiguousarray(np.asarray(v))

@@ -2146,10 +2146,22 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         wait on THAT fetch instead of fanning identical requests. Does
         not touch the training-path params (:meth:`pull_all`'s snapshot
         is unaffected)."""
+        return self.read_all_versioned()[0]
+
+    def read_all_versioned(self) -> Tuple[Any, int]:
+        """:meth:`read_all` plus the summed AS-SERVED shard versions of
+        the returned bytes. Distinct from :attr:`version` (the highest
+        versions this worker has OBSERVED): a replica serving within
+        the staleness bound, or a concurrent writer decoding a newer
+        ack mid-read, can make ``version`` exceed what these bytes
+        actually are — a re-publisher (the aggregator's coalesced
+        snapshot) must stamp the served version, never the known one,
+        or downstream caches park stale bytes under a fresh stamp."""
         import jax.numpy as jnp
 
         with self._op("read"):
             kv: Dict[str, Any] = {}
+            version = 0
             if len(self._active) > 1:
                 # fan the per-shard reads out concurrently, like
                 # pull_all's _fanout — a serving read must not pay K
@@ -2163,16 +2175,21 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                         for i in self._active}
                 concurrent.futures.wait(futs.values())
                 for i, f in futs.items():
-                    kv.update(f.result()["kv"])
+                    snap = f.result()
+                    kv.update(snap["kv"])
+                    version += int(snap["version"])
             else:
                 for i in self._active:
-                    kv.update(self._read_shard(i)["kv"])
+                    snap = self._read_shard(i)
+                    kv.update(snap["kv"])
+                    version += int(snap["version"])
             missing = [k for k in self._key_order if k not in kv]
             if missing:
                 raise self._incomplete_pull(missing)
-            return keymod.unflatten(
+            tree = keymod.unflatten(
                 self._treedef, {k: jnp.asarray(v) for k, v in kv.items()},
                 self._key_order)
+            return tree, version
 
     def _read_executor(self):
         if self._read_pool is None:

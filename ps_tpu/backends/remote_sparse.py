@@ -203,6 +203,18 @@ class SparsePSService(VanService):
         self.rows_applied: Dict[str, int] = {
             n: int(emb.rows_pushed) for n, emb in self._tables.items()
         }
+        # sparse fused apply (README "Sparse apply"): which tier each
+        # table's scatter-apply runs (resolved at SparseEmbedding
+        # construction from PS_FUSED_APPLY / the backend), plus the
+        # fleet-visible row counter — ps_top's tier/rows columns and the
+        # ps_sparse_rows_applied_total family both ride these
+        self.fused_tiers: Dict[str, str] = {
+            n: getattr(emb, "fused_tier", "off")
+            for n, emb in self._tables.items()
+        }
+        self._rows_counter = obs.default_registry().counter(
+            "ps_sparse_rows_applied_total",
+            "raw sparse row updates applied (server side)")
         # exactly-once under failover replay + the checkpoint drain round:
         # worker -> (nonce, cycle seq, fanout) of the last applied push.
         # The seq dedups replays; the fanout set tells the coordinator
@@ -373,10 +385,26 @@ class SparsePSService(VanService):
                     self._pause_wait_end()
             if self._draining:
                 raise RuntimeError("server is draining; push refused")
+            import jax as _jax
+
+            t_rows = _ptime.perf_counter()
+            rows = 0
             for name, ids, grads in todo:
                 self._tables[name].push(ids, grads)
                 self.versions[name] += 1
                 self.rows_applied[name] += int(ids.size)
+                rows += int(ids.size)
+            # block on the updated tables INSIDE the timed window: push
+            # dispatches async, and an enqueue-time histogram would show
+            # no jump when a shard falls off the fused tier — the signal
+            # this family exists for. The wait moves, it doesn't add:
+            # the next request on this lock syncs on the same queued
+            # work (pulls np.asarray the very tables).
+            _jax.block_until_ready([self._tables[n].table
+                                    for n, _, _ in todo])
+            self.transport.record_sparse_apply(
+                rows, _ptime.perf_counter() - t_rows)
+            self._rows_counter.inc(rows)
             # invalidation-on-apply (README "Read path"), PER KEY: only
             # cached id-sets intersecting the applied rows drop (their
             # bytes changed); disjoint hot sets keep serving natively.
@@ -545,6 +573,13 @@ class SparsePSService(VanService):
             out = {
                 "versions": dict(self.versions),
                 "rows_applied": dict(self.rows_applied),
+                # fused-apply view (README "Sparse apply"): per-table
+                # tier + total raw row updates — ps_top's tier/rows
+                # columns; a shard off the fused tier is visible here
+                "fused": {
+                    "tiers": dict(self.fused_tiers),
+                    "rows_applied": sum(self.rows_applied.values()),
+                },
                 "apply_log": log,
                 "apply_log_total": log_total,
                 "stale_epochs": self.transport.stale_epochs,
@@ -714,15 +749,27 @@ class SparsePSService(VanService):
         # _apply_push (which re-acquires it)
         if op != "push":
             raise ValueError(f"unknown replica op {op!r}")
+        import jax as _jax
+
         tree = decode_tree(dict(tensors), extra.get("enc"),
                            stats=self.transport)
         split = self._split(tree)
+        t_rows = _ptime.perf_counter()
+        rows = 0
         for name, t in split.items():
             ids = self._localize(name, np.array(t["ids"]))
             grads = np.array(t["grads"])  # own memory past the frame
             self._tables[name].push(ids, grads)
             self.versions[name] += 1
             self.rows_applied[name] += int(ids.size)
+            rows += int(ids.size)
+        # the backup's fused tier is observable too: a promoted replica
+        # must not silently serve the table-sized path (block inside the
+        # window, as in _apply_push — dispatch time is not apply time)
+        _jax.block_until_ready([self._tables[n].table for n in split])
+        self.transport.record_sparse_apply(
+            rows, _ptime.perf_counter() - t_rows)
+        self._rows_counter.inc(rows)
         # per-key, like the primary's apply: a backup's cached reads for
         # disjoint id-sets stay valid across this replicated row apply
         self._invalidate_reads(tags=self._tags_for(split, APPLY_TAG_CAP))

@@ -730,6 +730,18 @@ class TpuBackend:
         if self.failure_detector is not None:
             self.failure_detector.check()
 
+    def fused_apply_tier(self) -> str:
+        """The concrete sparse fused-apply tier this backend's devices
+        get (README "Sparse apply"): ``Config.fused_apply`` with 'auto'
+        resolved against the MESH's device platform — the one place the
+        by-backend detection lives, so every SparseEmbedding on this
+        backend (in-process tables and the remote sparse server's range
+        slices alike) lands on the same tier."""
+        from ps_tpu.ops.sparse_apply import resolve_tier
+
+        platform = next(iter(self.mesh.devices.flat)).platform
+        return resolve_tier(self.config.fused_apply, platform=platform)
+
     def create_server(self, optimizer, mode: Optional[str] = None,
                       aggregate: str = "mean", placement: str = "replicated",
                       partition_rules=None):

@@ -86,6 +86,11 @@ Environment variables honored by :meth:`Config.from_env`:
 - ``PS_AGG_PROBE_MAX_WAIT_MS`` — sleep budget of the stale-aggregator
   liveness probe a discovering worker runs before dialing its host's
   registered aggregator (default 200)
+- ``PS_FUSED_APPLY``        — sparse embedding fused apply tier (README
+  "Sparse apply"): 'off' = legacy masked full-table apply, 'jax' =
+  batch-sized gather→apply→scatter in pure JAX, 'pallas' = the fused
+  one-HBM-pass TPU kernel, 'auto' (default) = pallas on TPU, jax
+  elsewhere
 - ``PS_CKPT_ROOT``          — server side: confine CHECKPOINT saves under
   this root (client paths relative-only, ``..`` refused)
 - ``PS_REPLICAS``           — replica-set size per shard (1 = no
@@ -347,6 +352,16 @@ class Config:
         round trip; version bumps piggyback on every reply the worker
         decodes plus a REPLICA_STATE probe on the heartbeat cadence.
         Off by default (explicit opt-in, like shm).
+      fused_apply: sparse embedding fused apply tier (README "Sparse
+        apply"; ps_tpu/ops/sparse_apply.py): 'off' keeps the legacy
+        masked full-table apply (O(num_rows) HBM traffic per push);
+        'jax' gathers only the touched rows + their per-row optimizer
+        state, applies the dense-rows rule, and scatters back —
+        batch-sized, pure JAX; 'pallas' fuses that gather→apply→scatter
+        into one TPU kernel pass over HBM; 'auto' (default) resolves by
+        backend platform — pallas on TPU, jax anywhere else. Numerics
+        are pinned to the 'off' path by the parity drill
+        (tests/test_sparse_apply.py).
       connect_max_wait_ms: total sleep budget of one Channel.connect
         dial's retry backoff (the boot patience). Read-path failover
         tuning turns it down; 15 s default preserved.
@@ -505,6 +520,11 @@ class Config:
     # threshold (ms; 0 disarms)
     nl_stats: bool = True
     nl_slow_frame_ms: float = 250.0
+    # sparse fused apply (ps_tpu/ops/sparse_apply.py, README "Sparse
+    # apply"): which tier SparseEmbedding's scatter-apply routes through
+    # — 'off' (legacy masked full-table), 'jax' (batch-sized fallback),
+    # 'pallas' (fused one-HBM-pass kernel), 'auto' (by backend platform)
+    fused_apply: str = "auto"
     # dial budgets (previously hardcoded): Channel.connect's total
     # retry-sleep budget and the discovered-aggregator liveness probe's
     connect_max_wait_ms: int = 15_000
@@ -660,6 +680,11 @@ class Config:
                              "(0 disarms the slow-frame watchdog)")
         if self.read_staleness < 0:
             raise ValueError("read_staleness must be >= 0 versions")
+        if self.fused_apply not in ("auto", "off", "jax", "pallas"):
+            raise ValueError(
+                f"unknown fused_apply tier {self.fused_apply!r}; use "
+                "'off', 'jax', 'pallas' or 'auto'"
+            )
         if self.connect_max_wait_ms < 0:
             raise ValueError("connect_max_wait_ms must be >= 0")
         if self.agg_probe_max_wait_ms < 0:
@@ -823,6 +848,9 @@ class Config:
             kwargs["nl_slow_frame_ms"] = float(env["PS_NL_SLOW_FRAME_MS"])
         if "PS_PULL_CACHE" in env:
             kwargs["pull_cache"] = env_flag("PS_PULL_CACHE", False)
+        if "PS_FUSED_APPLY" in env:
+            # "" explicitly selects the auto detection
+            kwargs["fused_apply"] = env["PS_FUSED_APPLY"].strip() or "auto"
         if "PS_CONNECT_MAX_WAIT_MS" in env:
             kwargs["connect_max_wait_ms"] = int(env["PS_CONNECT_MAX_WAIT_MS"])
         if "PS_AGG_PROBE_MAX_WAIT_MS" in env:

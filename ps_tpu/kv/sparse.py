@@ -51,6 +51,7 @@ except ImportError:  # jax < 0.6 keeps shard_map in experimental
     from jax.experimental.shard_map import shard_map
 
 from ps_tpu.api import current_context
+from ps_tpu.ops.sparse_apply import fused_sparse_apply, resolve_tier
 from ps_tpu.optim.rowwise import make_rowwise
 from ps_tpu.parallel.mesh import DATA_AXIS
 
@@ -68,11 +69,18 @@ class SparseEmbedding:
       exchange: 'gather' (lossless) | 'a2a' (capacity-bounded all_to_all).
       capacity_factor: 'a2a' only — per-destination bucket capacity multiple.
       dtype: table dtype (f32 default; bf16 halves pull bytes).
+      fused_apply: which apply tier the scatter-apply routes through
+        (README "Sparse apply"): 'off' = the legacy masked full-table
+        apply, 'jax'/'pallas' = the batch-sized fused
+        gather→apply→scatter (ps_tpu/ops/sparse_apply.py), 'auto' =
+        by backend platform. None (default) inherits the backend's
+        resolution of ``Config.fused_apply`` (PS_FUSED_APPLY).
     """
 
     def __init__(self, num_rows: int, dim: int, optimizer="adagrad",
                  exchange: str = "gather", capacity_factor: float = 2.0,
                  dtype=jnp.float32, mesh=None, axis: str = DATA_AXIS,
+                 fused_apply: Optional[str] = None,
                  **opt_kwargs):
         if exchange not in ("gather", "a2a"):
             raise ValueError("exchange must be 'gather' or 'a2a'")
@@ -92,6 +100,15 @@ class SparseEmbedding:
         self.exchange = exchange
         self.capacity_factor = capacity_factor
         self._opt = make_rowwise(optimizer, **opt_kwargs)
+        # fused apply tier (README "Sparse apply"): explicit arg wins;
+        # otherwise the backend's resolution of Config.fused_apply (the
+        # one place the by-platform 'auto' detection lives)
+        if fused_apply is None:
+            tier_fn = getattr(ctx.backend, "fused_apply_tier", None)
+            fused_apply = tier_fn() if tier_fn is not None else None
+        self.fused_tier = resolve_tier(
+            fused_apply,
+            platform=next(iter(self.mesh.devices.flat)).platform)
         self._table: Optional[jax.Array] = None
         self._state: Any = None
         self._jit_apply = None   # cached jit wrappers: a fresh jax.jit per
@@ -203,9 +220,18 @@ class SparseEmbedding:
         count of real rows lost to a2a bucket overflow this push (always 0
         for the lossless gather exchange); the observable signal
         ``capacity_factor`` is tuned from.
+
+        Apply tier (README "Sparse apply"): with ``fused_tier`` 'off'
+        the owner shard builds a TABLE-SIZED ``gsum``/``cnt`` and the
+        optimizer updates the whole shard under a mask (three-plus full
+        HBM passes per push); 'jax'/'pallas' route through
+        :func:`~ps_tpu.ops.sparse_apply.fused_sparse_apply` — dedupe at
+        batch size, gather only the touched rows + state, apply the
+        dense-rows rule, scatter back — so apply cost is O(batch ids),
+        not O(rows_per_shard). Same math by the parity contract.
         """
         rps, dim, axis, k = self.rows_per_shard, self.dim, self.axis, self.k
-        opt_apply = self._opt.apply
+        opt, tier = self._opt, self.fused_tier
 
         def shard_apply(table_shard, state_shard, ids_loc, grads_loc):
             if self.exchange == "gather" or k == 1:
@@ -220,21 +246,33 @@ class SparseEmbedding:
             lo = jax.lax.axis_index(axis) * rps
             local = all_ids - lo
             ok = (local >= 0) & (local < rps)
-            slot = jnp.where(ok, local, rps)  # overflow slot, sliced off
-            g = jnp.where(ok[:, None], all_grads, 0).astype(jnp.float32)
-            gsum = jnp.zeros((rps + 1, dim), jnp.float32).at[slot].add(g)[:-1]
-            cnt = jnp.zeros((rps + 1,), jnp.int32).at[slot].add(
-                ok.astype(jnp.int32))[:-1]
-            new_table, new_state = opt_apply(
-                table_shard, state_shard, gsum, cnt > 0
-            )
+            if tier == "off":
+                slot = jnp.where(ok, local, rps)  # overflow slot, sliced off
+                g = jnp.where(ok[:, None], all_grads, 0).astype(jnp.float32)
+                gsum = jnp.zeros((rps + 1, dim),
+                                 jnp.float32).at[slot].add(g)[:-1]
+                cnt = jnp.zeros((rps + 1,), jnp.int32).at[slot].add(
+                    ok.astype(jnp.int32))[:-1]
+                new_table, new_state = opt.apply(
+                    table_shard, state_shard, gsum, cnt > 0
+                )
+            else:
+                ids_m = jnp.where(ok, local, -1)
+                g = jnp.where(ok[:, None], all_grads, 0).astype(jnp.float32)
+                new_table, new_state = fused_sparse_apply(
+                    table_shard, state_shard, ids_m, g, opt, tier
+                )
             return new_table, new_state, dropped
 
         state_specs = self._state_specs()
+        # check_rep stays on for the non-pallas tiers; shard_map has no
+        # replication rule for pallas_call, and the fused kernel's output
+        # specs are exactly the input shardings anyway
         fn = shard_map(
             shard_apply, mesh=self.mesh,
             in_specs=(P(axis, None), state_specs, P(axis), P(axis, None)),
             out_specs=(P(axis, None), state_specs, P()),
+            check_rep=(tier != "pallas"),
         )
         return fn(table, state, ids, row_grads)
 
@@ -273,7 +311,17 @@ class SparseEmbedding:
                 [row_grads, jnp.zeros((pad, self.dim), row_grads.dtype)]
             )
         if self._jit_apply is None:
-            self._jit_apply = jax.jit(self.apply)
+            # fused tiers donate like the composite step (ps_tpu/train.py):
+            # the old table/state buffers die with the call, so the
+            # batch-sized scatter is a true in-place update instead of a
+            # full-table output copy (references from earlier pull()s are
+            # row COPIES and stay valid; init()'s returned placement is
+            # superseded by .table, as the composite step already assumes).
+            # The 'off' tier does NOT donate: PS_FUSED_APPLY=off promises
+            # today's exact behavior, buffer lifetimes included — a caller
+            # holding .table across a push keeps a readable array there.
+            donate = (0, 1) if self.fused_tier != "off" else ()
+            self._jit_apply = jax.jit(self.apply, donate_argnums=donate)
         self._table, self._state, dropped = self._jit_apply(
             self.table, self._state, ids, row_grads
         )

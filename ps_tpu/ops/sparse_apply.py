@@ -1,0 +1,307 @@
+"""Fused sparse embedding update: gather → optimizer-apply → scatter,
+batch-sized, in one HBM pass (ROADMAP item 6; SURVEY §8 P3).
+
+Why: the legacy sparse apply (``ps_tpu/kv/sparse.py`` ``shard_apply``)
+pays three-plus full-table HBM passes per push — two ``zeros().at[].add``
+scatter-sums building a TABLE-SIZED ``gsum``/``cnt``, then the row-wise
+optimizer updates the ENTIRE shard under a ``touched`` mask. Apply cost
+is O(num_rows) even when a batch touches 0.1% of rows — exactly the
+regime out-of-HBM tiered tables (ROADMAP item 3) will live in. This
+module makes apply cost O(batch): dedupe/segment-sum the pushed ids at
+BATCH size, gather only the touched rows and their per-row optimizer
+state, apply the dense-rows rule (``RowwiseOptimizer.apply_rows``), and
+scatter rows+state back.
+
+Three tiers, selected by ``PS_FUSED_APPLY`` (``Config.fused_apply``,
+``off|jax|pallas|auto``; README "Sparse apply"):
+
+- ``pallas`` — the fast tier: ONE kernel walks the deduped id list with
+  the table and state in HBM (``pl.ANY``), DMA-gathers each touched
+  row + its state slices into VMEM, runs ``apply_rows`` on-chip, and
+  DMA-scatters the results back. Filler slots (id -1: push padding,
+  merged duplicates) are skipped by ``pl.when`` — never a write, so no
+  read-modify-write hazard against a real row's update. Total HBM
+  traffic per push ≈ 2 · B · (row + state) bytes, table size absent
+  from the expression. Off-TPU the kernel runs in interpret mode, so
+  CPU CI drills the same kernel logic (the flash-attention precedent).
+- ``jax`` — the batch-sized pure-JAX fallback: take/gather the touched
+  rows + state, ``apply_rows``, ``.at[].set(mode='drop')`` scatter
+  (filler ids redirect out of range and drop). Same O(batch) traffic
+  shape, XLA-scheduled; the tier CPU CI runs by default.
+- ``off`` — the legacy masked full-table path, byte-for-byte today's
+  behavior (the caller keeps its own code path; this module is not
+  involved).
+
+Numerical contract (tests/test_sparse_apply.py): both fused tiers match
+the masked full-table apply bitwise for SGD/Adagrad where the duplicate
+reduction order is fixed (stable-sorted segments sum duplicates in
+arrival order — the same order the full path's scatter-add applies
+them), and within 1e-6 relative for Adam, across dup-heavy / empty /
+all-rows id distributions.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+TIERS = ("off", "jax", "pallas")
+
+#: rows per pallas grid step: each program walks this many deduped ids
+#: sequentially (per-row DMA chains). Small keeps VMEM scratch tiny; the
+#: win over 'off' is O(batch) vs O(table) traffic, not DMA batching.
+_BLOCK_ROWS = 8
+
+
+def resolve_tier(requested: Optional[str], platform: Optional[str] = None
+                 ) -> str:
+    """Normalize a ``PS_FUSED_APPLY`` value to a concrete tier.
+
+    ``auto`` (or None) detects by backend platform: ``pallas`` on TPU,
+    ``jax`` anywhere else (the kernel's interpret mode is a correctness
+    tier, not a fast one — CPU's fast tier IS the jax path). Unknown
+    values fail loudly: a typo'd knob must not silently select 'off'.
+    """
+    if requested is None or requested == "auto":
+        if platform is None:
+            platform = jax.devices()[0].platform
+        return "pallas" if platform == "tpu" else "jax"
+    if requested not in TIERS:
+        raise ValueError(
+            f"unknown fused-apply tier {requested!r}; use "
+            f"'off', 'jax', 'pallas' or 'auto'")
+    return requested
+
+
+def batch_segment_sum(ids: jax.Array, grads: jax.Array
+                      ) -> Tuple[jax.Array, jax.Array, jax.Array]:
+    """Batch-sized dedupe + segment sum of a push's (ids, grads).
+
+    ``ids`` [N] int32 with duplicates and -1 filler allowed; ``grads``
+    [N, D]. Returns ``(uids, gsum, cnt)`` all length N: each unique real
+    id survives at one slot with its duplicates' grads summed (f32, in
+    stable-sorted arrival order — the fixed reduction order the bitwise
+    parity contract names), duplicates and filler become ``uid=-1,
+    gsum=0, cnt=0``. The table never appears: this is the O(batch) twin
+    of the legacy table-sized ``zeros(rps).at[slot].add`` build.
+    """
+    n = ids.shape[0]
+    if n == 0:
+        return ids, grads.astype(jnp.float32), jnp.zeros((0,), jnp.int32)
+    order = jnp.argsort(ids)  # stable: duplicates keep arrival order
+    ids_s = ids[order]
+    grads_s = grads[order].astype(jnp.float32)
+    first = jnp.concatenate(
+        [jnp.ones((1,), bool), ids_s[1:] != ids_s[:-1]])
+    seg = jnp.cumsum(first) - 1
+    summed = jnp.zeros(grads_s.shape, jnp.float32).at[seg].add(grads_s)
+    seg_cnt = jnp.zeros((n,), jnp.int32).at[seg].add(
+        (ids_s >= 0).astype(jnp.int32))
+    real = first & (ids_s >= 0)
+    uids = jnp.where(real, ids_s, -1)
+    gsum = jnp.where(real[:, None], summed[seg], 0.0)
+    cnt = jnp.where(real, seg_cnt[seg], 0)
+    return uids, gsum, cnt
+
+
+def fused_sparse_apply(table: jax.Array, state: Any, ids: jax.Array,
+                       grads: jax.Array, opt, tier: str,
+                       interpret: Optional[bool] = None
+                       ) -> Tuple[jax.Array, Any]:
+    """THE entry point every sparse apply routes through (``kv/sparse``'s
+    shard_apply, and through it the remote sparse server and the mesh
+    backend). ``ids`` [N] are SHARD-LOCAL row indices with -1 filler
+    (out-of-range/padding already masked by the caller), ``grads``
+    [N, D] with filler rows zeroed. Returns the updated (table, state);
+    only touched rows' bytes move."""
+    if tier == "off":
+        raise ValueError("tier 'off' is the caller's own full-table path "
+                         "— fused_sparse_apply never runs it")
+    if tier not in TIERS:
+        raise ValueError(f"unknown fused-apply tier {tier!r}")
+    if ids.shape[0] == 0:  # empty push: nothing gathered, nothing written
+        return table, state
+    uids, gsum, cnt = batch_segment_sum(ids, grads)
+    if tier == "pallas":
+        return _apply_pallas(opt, table, state, uids, gsum, cnt,
+                             interpret=interpret)
+    return _apply_jax(opt, table, state, uids, gsum, cnt)
+
+
+# -- jax tier ----------------------------------------------------------------
+
+
+def _apply_jax(opt, table, state, uids, gsum, cnt):
+    """Batch-sized gather → apply_rows → scatter in plain JAX. Filler
+    slots gather row 0 (harmless: cnt 0 and gsum 0 make apply_rows the
+    identity for them) and scatter out of range (``mode='drop'``)."""
+    num_rows = table.shape[0]
+    slot = jnp.where(uids >= 0, uids, 0)
+    rows = jnp.take(table, slot, axis=0)
+    state_rows = jax.tree_util.tree_map(
+        lambda leaf: jnp.take(leaf, slot, axis=0), state)
+    new_rows, new_state_rows = opt.apply_rows(rows, state_rows, gsum, cnt)
+    dst = jnp.where(uids >= 0, uids, num_rows)  # filler drops off the end
+    new_table = table.at[dst].set(new_rows.astype(table.dtype),
+                                  mode="drop")
+    new_state = jax.tree_util.tree_map(
+        lambda leaf, nrows: leaf.at[dst].set(nrows.astype(leaf.dtype),
+                                             mode="drop"),
+        state, new_state_rows)
+    return new_table, new_state
+
+
+# -- pallas tier -------------------------------------------------------------
+
+
+def _leaf_2d(leaf):
+    """Per-row state leaves as 2D [R, S] views for row-sliced DMA."""
+    return leaf if leaf.ndim == 2 else leaf[:, None]
+
+
+def _make_kernel(treedef, leaf_2d_flags, apply_rows):
+    """Build the fused kernel for one (optimizer, state structure). Ref
+    layout per PrefetchScalarGridSpec: scalar-prefetch (uids, cnt), then
+    inputs (gsum block, table, *state), outputs (table, *state — aliased
+    to the inputs), scratch (row, *state rows, one DMA semaphore).
+    ``leaf_2d_flags[k]`` records whether state leaf k was natively 2D
+    (per-dim state like adam's moments) or a per-row scalar reshaped to
+    [R, 1] for row-sliced DMA."""
+    nleaves = len(leaf_2d_flags)
+
+    def kernel(uids_ref, cnt_ref, gsum_ref, *refs):
+        # inputs and outputs alias the same buffers: all reads and
+        # writes go through the out refs, so the data flow is explicit
+        tbl_out = refs[1 + nleaves]
+        st_outs = refs[2 + nleaves:2 + 2 * nleaves]
+        row_scr = refs[2 + 2 * nleaves]
+        st_scrs = refs[3 + 2 * nleaves:3 + 3 * nleaves]
+        i = pl.program_id(0)
+        for j in range(_BLOCK_ROWS):  # npad is a _BLOCK_ROWS multiple:
+            idx = i * _BLOCK_ROWS + j  # every idx is in range
+            rid = uids_ref[idx]
+
+            @pl.when(rid >= 0)  # filler: no DMA, no write — a real
+            def _row(j=j, rid=rid):  # row's update can never be clobbered
+                def run(sem_ref):
+                    # gather: row + its state slices, HBM -> VMEM
+                    cp = pltpu.make_async_copy(
+                        tbl_out.at[pl.ds(rid, 1)], row_scr, sem_ref)
+                    cp.start()
+                    cp.wait()
+                    for st_out, st_scr in zip(st_outs, st_scrs):
+                        cp = pltpu.make_async_copy(
+                            st_out.at[pl.ds(rid, 1)], st_scr, sem_ref)
+                        cp.start()
+                        cp.wait()
+                    # apply: the SAME dense-rows rule as every tier,
+                    # on a [1, D] slab entirely in VMEM
+                    leaves = [s[:] if was_2d else s[:, 0]
+                              for s, was_2d in zip(st_scrs, leaf_2d_flags)]
+                    st = jax.tree_util.tree_unflatten(treedef, leaves)
+                    g = gsum_ref[pl.ds(j, 1)]
+                    c = cnt_ref[idx][None]
+                    new_row, new_st = apply_rows(row_scr[:], st, g, c)
+                    row_scr[:] = new_row.astype(row_scr.dtype)
+                    new_leaves = jax.tree_util.tree_leaves(new_st)
+                    for s, nl, was_2d in zip(st_scrs, new_leaves,
+                                             leaf_2d_flags):
+                        s[:] = (nl if was_2d else nl[:, None]).astype(
+                            s.dtype)
+                    # scatter back: VMEM -> the same HBM rows
+                    cp = pltpu.make_async_copy(
+                        row_scr, tbl_out.at[pl.ds(rid, 1)], sem_ref)
+                    cp.start()
+                    cp.wait()
+                    for st_out, st_scr in zip(st_outs, st_scrs):
+                        cp = pltpu.make_async_copy(
+                            st_scr, st_out.at[pl.ds(rid, 1)], sem_ref)
+                        cp.start()
+                        cp.wait()
+
+                pl.run_scoped(run, sem_ref=pltpu.SemaphoreType.DMA)
+
+    return kernel
+
+
+def _apply_pallas(opt, table, state, uids, gsum, cnt, interpret=None):
+    """One-HBM-pass fused apply: the deduped id list drives per-row DMA
+    gather/apply/scatter against the table and state resident in HBM
+    (``pl.ANY``). Inputs are aliased to the outputs, so untouched rows
+    are never read OR written."""
+    if interpret is None:
+        interpret = jax.devices()[0].platform != "tpu"
+    n = uids.shape[0]
+    dim = table.shape[1]
+    pad = (-n) % _BLOCK_ROWS
+    if pad:
+        uids = jnp.concatenate([uids, jnp.full((pad,), -1, uids.dtype)])
+        cnt = jnp.concatenate([cnt, jnp.zeros((pad,), cnt.dtype)])
+        gsum = jnp.concatenate(
+            [gsum, jnp.zeros((pad, dim), gsum.dtype)])
+    npad = n + pad
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    leaves2d = [_leaf_2d(lf) for lf in leaves]
+    kernel = _make_kernel(treedef, [lf.ndim == 2 for lf in leaves],
+                          opt.apply_rows)
+    nleaves = len(leaves)
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # uids, cnt -> SMEM, indexable pre-DMA
+        grid=(npad // _BLOCK_ROWS,),
+        in_specs=[
+            pl.BlockSpec((_BLOCK_ROWS, dim),
+                         lambda i, uids, cnt: (i, 0)),
+            pl.BlockSpec(memory_space=pltpu.ANY),  # table stays in HBM
+        ] + [pl.BlockSpec(memory_space=pltpu.ANY)] * nleaves,
+        out_specs=[pl.BlockSpec(memory_space=pltpu.ANY)] * (1 + nleaves),
+        scratch_shapes=(
+            [pltpu.VMEM((1, dim), table.dtype)]
+            + [pltpu.VMEM((1, lf.shape[1]), lf.dtype) for lf in leaves2d]
+        ),
+    )
+    out_shape = ([jax.ShapeDtypeStruct(table.shape, table.dtype)]
+                 + [jax.ShapeDtypeStruct(lf.shape, lf.dtype)
+                    for lf in leaves2d])
+    # operand k of (uids, cnt, gsum, table, *state) aliases output k-3:
+    # the kernel updates the table and state IN PLACE, one row at a time
+    aliases = {3 + k: k for k in range(1 + nleaves)}
+    outs = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=out_shape,
+        input_output_aliases=aliases,
+        interpret=interpret,
+    )(uids, cnt, gsum, table, *leaves2d)
+    new_table = outs[0]
+    new_leaves = [
+        out if lf.ndim == 2 else out[:, 0]
+        for out, lf in zip(outs[1:], leaves)
+    ]
+    return new_table, jax.tree_util.tree_unflatten(treedef, new_leaves)
+
+
+# -- HBM traffic model -------------------------------------------------------
+
+
+def hbm_bytes_model(num_rows: int, dim: int, batch_rows: int, opt,
+                    table_dtype_bytes: int = 4) -> dict:
+    """Arithmetic HBM bytes per apply under the two designs — the model
+    ``bench.py``'s sparse leg records beside the measured rows/s so the
+    ≥2x claim is a trajectory, not a log line. ``batch_rows`` = unique
+    touched rows. Fused: read+write exactly those rows and their state,
+    plus the batch-sized gsum/cnt build. Full-table: read+write every
+    row and its state, build a table-sized gsum/cnt, plus the incoming
+    batch read. Both are lower-bound models (no padding/layout slack)."""
+    state_row = opt.state_scalars_per_row(dim) * 4
+    row = dim * table_dtype_bytes + state_row
+    grad_row = (dim + 1) * 4  # summed grads + count per row
+    fused = batch_rows * (2 * row + 2 * grad_row)
+    full = (num_rows * (2 * row + 2 * grad_row)
+            + batch_rows * grad_row)
+    return {"fused_bytes_per_apply": int(fused),
+            "full_table_bytes_per_apply": int(full),
+            "ratio": round(full / max(fused, 1), 2)}

@@ -13,8 +13,18 @@ tests/test_sparse.py:
   does not advance (dense adam would keep moving previously-touched rows).
   This matches sparse-PS semantics, not dense optax.adam.
 
-All rules consume a *summed* duplicate-row gradient (``gsum``) plus a
-``touched`` mask, both produced by the scatter-apply in ps_tpu/kv/sparse.py.
+The ONE update rule per optimizer is the **dense-rows form**
+``apply_rows(rows, state, gsum, cnt)``: it consumes a slab of rows — a
+gathered batch of touched rows (the fused sparse path,
+ps_tpu/ops/sparse_apply.py) or the whole table shard (the legacy masked
+path) — with the matching per-row state slices, the duplicate-summed
+gradient ``gsum`` and an int32 per-row duplicate count ``cnt`` (0 =
+untouched/filler). The full-table ``apply(rows, state, gsum, touched)``
+is DERIVED from it (``cnt = touched``), so the two entry points cannot
+drift numerically: the fused gather→apply→scatter path and the masked
+full-table path run literally the same expressions, restricted to
+different row sets. That identity is what the fused path's bitwise
+parity contract (tests/test_sparse_apply.py) rests on.
 """
 
 from __future__ import annotations
@@ -27,25 +37,47 @@ import jax.numpy as jnp
 
 @dataclasses.dataclass(frozen=True)
 class RowwiseOptimizer:
-    """init(rows) -> state; apply(rows, state, gsum, touched) -> (rows, state).
+    """init(rows) -> state; the row-update rule in two views of one math.
 
-    ``rows``: [R, D] table shard. ``gsum``: [R, D] duplicate-summed grads
-    (zero for untouched rows). ``touched``: [R] bool.
+    ``apply_rows(rows, state, gsum, cnt) -> (rows, state)`` — the
+    dense-rows contract: ``rows`` [B, D] is ANY slab of rows (a gathered
+    batch or a whole shard), ``state`` the same-structure per-row state
+    restricted to those rows, ``gsum`` [B, D] the duplicate-summed grads
+    (zero where untouched), ``cnt`` [B] int32 the duplicate count per row
+    (0 = untouched or filler — the row and its state must pass through
+    unchanged up to float identity, so a fused scatter of the result is a
+    no-op for it).
+
+    ``apply(rows, state, gsum, touched) -> (rows, state)`` — the legacy
+    full-table view over a shard with a bool ``touched`` mask; derived
+    from ``apply_rows`` (never a second implementation).
     """
 
     init: Callable[[jnp.ndarray], Any]
-    apply: Callable[..., Tuple[jnp.ndarray, Any]]
+    apply_rows: Callable[..., Tuple[jnp.ndarray, Any]]
+    #: per-row optimizer-state f32 scalars per table row (for the HBM
+    #: traffic model: adagrad 1 accumulator scalar/row; adam 2D+1)
+    state_scalars_per_row: Callable[[int], int] = lambda dim: 0
+
+    @property
+    def apply(self) -> Callable[..., Tuple[jnp.ndarray, Any]]:
+        rows_fn = self.apply_rows
+
+        def apply(rows, state, gsum, touched):
+            return rows_fn(rows, state, gsum, touched.astype(jnp.int32))
+
+        return apply
 
 
 def sgd(learning_rate: float = 0.01) -> RowwiseOptimizer:
     def init(rows):
         return ()
 
-    def apply(rows, state, gsum, touched):
-        del touched  # zero grad already leaves untouched rows unchanged
+    def apply_rows(rows, state, gsum, cnt):
+        del cnt  # zero grad already leaves untouched rows unchanged
         return rows - learning_rate * gsum.astype(rows.dtype), state
 
-    return RowwiseOptimizer(init, apply)
+    return RowwiseOptimizer(init, apply_rows)
 
 
 def adagrad(learning_rate: float = 0.01, eps: float = 1e-8) -> RowwiseOptimizer:
@@ -55,14 +87,15 @@ def adagrad(learning_rate: float = 0.01, eps: float = 1e-8) -> RowwiseOptimizer:
     def init(rows):
         return jnp.zeros((rows.shape[0],), jnp.float32)
 
-    def apply(rows, acc, gsum, touched):
-        del touched
+    def apply_rows(rows, acc, gsum, cnt):
+        del cnt
         g = gsum.astype(jnp.float32)
         acc = acc + (g * g).mean(axis=-1)
         step = learning_rate * g / jnp.sqrt(acc + eps)[:, None]
         return rows - step.astype(rows.dtype), acc
 
-    return RowwiseOptimizer(init, apply)
+    return RowwiseOptimizer(init, apply_rows,
+                            state_scalars_per_row=lambda dim: 1)
 
 
 def adam(learning_rate: float = 1e-3, b1: float = 0.9, b2: float = 0.999,
@@ -74,8 +107,10 @@ def adam(learning_rate: float = 1e-3, b1: float = 0.9, b2: float = 0.999,
         return {"m": zeros, "v": zeros,
                 "t": jnp.zeros((rows.shape[0],), jnp.int32)}
 
-    def apply(rows, state, gsum, touched):
+    def apply_rows(rows, state, gsum, cnt):
         g = gsum.astype(jnp.float32)
+        touched = cnt > 0  # a row's step advances once however many
+        # duplicates its gsum merged — cnt is provenance, not a multiplier
         mask = touched[:, None]
         t = state["t"] + touched.astype(jnp.int32)
         m = jnp.where(mask, b1 * state["m"] + (1 - b1) * g, state["m"])
@@ -87,7 +122,8 @@ def adam(learning_rate: float = 1e-3, b1: float = 0.9, b2: float = 0.999,
         step = jnp.where(mask, learning_rate * mhat / (jnp.sqrt(vhat) + eps), 0.0)
         return rows - step.astype(rows.dtype), {"m": m, "v": v, "t": t}
 
-    return RowwiseOptimizer(init, apply)
+    return RowwiseOptimizer(init, apply_rows,
+                            state_scalars_per_row=lambda dim: 2 * dim + 1)
 
 
 _REGISTRY = {"sgd": sgd, "adagrad": adagrad, "adam": adam}

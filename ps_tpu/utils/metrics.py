@@ -85,6 +85,14 @@ class TransportStats:
         # default signal (ps_tpu/obs/breakdown.py, obs/straggler.py)
         ("apply_s", "ps_server_apply_seconds",
          "server engine apply of one committed push (lock held)"),
+        # sparse fused apply (README "Sparse apply"): the row-apply
+        # alone — dedupe/segment-sum + gather + apply_rows + scatter of
+        # ONE push's rows, whichever tier ran it. Falls inside apply_s
+        # (which also counts lock wait); its own family exists so the
+        # fleet view can see a shard fall off the fused tier (the
+        # distribution jumps from batch-sized to table-sized)
+        ("sparse_apply_s", "ps_sparse_apply_seconds",
+         "server sparse row apply (gather->apply->scatter), per push"),
         # native event-loop serve path (README "Native event loop"): how
         # many complete requests each nl_poll upcall handed Python — the
         # batching the one-pump-thread design lives on (a flat histogram
@@ -218,6 +226,10 @@ class TransportStats:
         # wire fetch), replica- vs primary-served wire reads, and
         # staleness-bound fallbacks (a replica's version trailed the
         # bound and the read re-routed to the primary).
+        # sparse fused apply (README "Sparse apply"): RAW row updates
+        # this endpoint applied (same units as SparseEmbedding.rows_pushed
+        # — a merged duplicate counts every update it carried)
+        self.sparse_rows_applied = 0
         self.reads_served = 0
         self.read_native_hits = 0     # synced absolute, native owns it
         self.read_native_misses = 0   # synced absolute
@@ -346,6 +358,14 @@ class TransportStats:
         with self._lock:
             self.nl_slow_frames = int(slow_frames)
             self.nl_tail_backlog_bytes = int(tail_backlog_bytes)
+
+    def record_sparse_apply(self, rows: int, seconds: float) -> None:
+        """One sparse row apply: ``rows`` RAW row updates landed in
+        ``seconds`` (the apply call alone, lock wait excluded — that
+        lives in ``apply_s``)."""
+        self.hist["sparse_apply_s"].record(seconds)
+        with self._lock:
+            self.sparse_rows_applied += int(rows)
 
     def record_read_served(self) -> None:
         """Server side: one READ answered in Python (the pump path — a
@@ -510,7 +530,8 @@ class TransportStats:
                     self.agg_rounds, self.agg_members, self.agg_degrades,
                     self.reads_served, self.read_cache_hits,
                     self.read_wire, self.read_coalesced,
-                    self.reads_replica, self.read_fallbacks)
+                    self.reads_replica, self.read_fallbacks,
+                    self.sparse_rows_applied)
 
     def summary(self, since: Optional[tuple] = None) -> Dict[str, float]:
         now = self.snapshot()
@@ -591,6 +612,9 @@ class TransportStats:
                 out["replica_read_share"] = round(d[34] / d[32], 4)
             if d[35] > 0:
                 out["read_fallbacks"] = int(d[35])
+        if d[36] > 0:
+            # sparse fused apply: raw row updates applied this interval
+            out["sparse_rows_applied"] = int(d[36])
         # latency DISTRIBUTIONS (ps_tpu/obs): quantiles of everything the
         # histograms saw — lifetime, not interval (a p99 over an interval
         # delta of log buckets is computable but the lifetime tail is

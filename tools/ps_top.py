@@ -56,7 +56,7 @@ COLS = [
     ("applies", 9), ("lag", 5), ("repl", 14), ("dedup", 6), ("stale", 6),
     ("moved", 8), ("gbps", 7), ("ack_p99_ms", 10), ("bkt_p99_ms", 10),
     ("loop", 10), ("nlp99", 8), ("qw99", 8), ("reads", 8), ("nhit%", 6),
-    ("chit%", 6), ("rshare%", 7),
+    ("chit%", 6), ("rshare%", 7), ("tier", 6), ("rows", 9), ("sap99", 8),
 ]
 
 COORD_COLS = [
@@ -135,7 +135,7 @@ def render_row(st: dict) -> dict:
                 "ack_p99_ms": "-", "bkt_p99_ms": "-", "loop": "-",
                 "nlp99": "-", "qw99": "-",
                 "reads": "-", "nhit%": "-", "chit%": "-",
-                "rshare%": "-"}
+                "rshare%": "-", "tier": "-", "rows": "-", "sap99": "-"}
     repl = st.get("repl") or {}
     # a live session renders "<ack mode>@<acked seq>" so an operator sees
     # the stream advancing between refreshes; degraded wins the cell
@@ -193,7 +193,27 @@ def render_row(st: dict) -> dict:
         # backup rows' reads over the whole set's (same value on every
         # row of a shard — the read-replica share of its traffic)
         "rshare%": _opt(st.get("_rshare")),
+        # sparse fused apply (README "Sparse apply"): the shard's apply
+        # tier, raw row updates applied, and the per-push row-apply p99
+        # (ms) — a shard falling off the fused tier shows 'off' here and
+        # its sap99 jumps from batch-sized to table-sized
+        "tier": _fused_tier(st),
+        "rows": (st["fused"].get("rows_applied", "-")
+                 if isinstance(st.get("fused"), dict) else "-"),
+        "sap99": _opt(_p99_ms(st, "sparse_apply_s")),
     }
+
+
+def _fused_tier(st: dict):
+    """One cell for the shard's fused-apply tiers: the common tier, or
+    'mixed' when its tables resolved differently ("-" = dense shard)."""
+    fused = st.get("fused")
+    if not isinstance(fused, dict):
+        return "-"
+    tiers = set((fused.get("tiers") or {}).values())
+    if not tiers:
+        return "-"
+    return tiers.pop() if len(tiers) == 1 else "mixed"
 
 
 def _loop_us(st: dict, key: str):
