@@ -951,6 +951,135 @@ def bench_transport(args, retried: bool):
     svc.stop()
     ps.shutdown()
 
+    # zero-upcall push admission A/B (README "Push path"): the SAME
+    # N-worker replay-storm workload against two identical shards —
+    # PS_PUSH_NATIVE_ADMIT=off (the pump parity oracle) vs on — measures
+    # what moving admission into the epoll loop buys on the push plane:
+    # pure failover replays are acked with zero Python upcalls, so
+    # pushes/s rises and the replay p99 drops while the applied state
+    # stays bit-identical (tools/ci_bench_smoke.sh gates on
+    # params_match AND the pushes/s win).
+    import hashlib
+    import threading as _threading
+
+    from ps_tpu.backends.remote_async import AsyncPSService
+    from ps_tpu.control import tensor_van as tv
+
+    n_push = 8
+    replays = 40 if args.quick else 320
+    prng = np.random.default_rng(7)
+    ptree = {f"blk{i}/w": prng.normal(0, 1, (256, 64)).astype(np.float32)
+             for i in range(4)}
+    # IDENTICAL grads for every worker and every push: each SGD apply
+    # subtracts the same lr*g, so the final bytes depend only on the
+    # APPLY COUNT, not the thread interleaving — exactly the invariant
+    # the admission tier must preserve (replays acked, never re-applied)
+    pgrads = {k: prng.normal(0, 1e-3, v.shape).astype(np.float32)
+              for k, v in ptree.items()}
+    ps.init(backend="tpu", mode="async", num_workers=n_push, dc_lambda=0.0)
+
+    def admit_leg(admit: bool) -> dict:
+        os.environ["PS_PUSH_NATIVE_ADMIT"] = "on" if admit else "off"
+        st2 = ps.KVStore(optimizer="sgd", learning_rate=0.01, mode="async")
+        st2.init(ptree)
+        svc2 = AsyncPSService(st2, bind="127.0.0.1", native_loop=True)
+        lat_s = [[] for _ in range(n_push)]
+        replay_acked = [0] * n_push
+
+        def member(w: int):
+            ch = tv.Channel.connect("127.0.0.1", svc2.port)
+            fresh = bytes(tv.encode(tv.PUSH, w, pgrads,
+                                    extra={"pseq": 1, "pnonce": f"inc{w}"}))
+            ch.request(fresh)  # seeds this worker's ledger row
+            for _ in range(replays):
+                t0 = time.perf_counter()
+                raw = ch.request(fresh)  # the failover-replay storm
+                lat_s[w].append(time.perf_counter() - t0)
+                _, _, _, ex = tv.decode(raw)
+                if ex.get("dedup"):
+                    replay_acked[w] += 1
+            # one strictly-fresh tail push: the stamped-admission path
+            # stays exercised inside the measured run
+            ch.request(bytes(tv.encode(
+                tv.PUSH, w, pgrads,
+                extra={"pseq": 2, "pnonce": f"inc{w}"})))
+            ch.close()
+
+        threads = [_threading.Thread(target=member, args=(w,))
+                   for w in range(n_push)]
+        t0 = time.monotonic()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        dt = max(time.monotonic() - t0, 1e-9)
+
+        admit_detail = None
+        if admit:
+            asn = svc2._nloop.admit_stats()
+            classified = (asn["acks"] + asn["refusals"] + asn["fresh"]
+                          + asn["punts"])
+            padm = {}
+            for _ in range(30):  # the pump syncs STATS ~1/s
+                rs = svc2.replica_state()
+                padm = (rs.get("loop") or {}).get("padm") or {}
+                if int(padm.get("acks", 0)) >= asn["acks"]:
+                    break
+                time.sleep(0.1)
+            admit_detail = {
+                "native_acks": asn["acks"],
+                "refusals": asn["refusals"],
+                "fresh": asn["fresh"],
+                "punts": asn["punts"],
+                "entries": asn["entries"],
+                "ack_armed": asn.get("ack_armed"),
+                "refusal_armed": asn.get("refusal_armed"),
+                "share": round((asn["acks"] + asn["refusals"])
+                               / classified, 4) if classified else None,
+                "stats_share": padm.get("share"),
+            }
+
+        # applied-state digest: pull the final tree and hash it — the
+        # A/B gate is bitwise, not approximate
+        wd = connect_async(f"127.0.0.1:{svc2.port}", 0, ptree)
+        fin = wd.pull_all()
+        h = hashlib.sha256()
+        for k in sorted(fin):
+            h.update(np.asarray(fin[k]).tobytes())
+        wd.close()
+        svc2.stop()
+        flat = sorted(s for per in lat_s for s in per)
+        return {
+            "pushes_per_s": round(n_push * replays / dt, 1),
+            "push_p99_us": round(float(np.percentile(flat, 99)) * 1e6, 1),
+            "replay_acked": sum(replay_acked),
+            "digest": h.hexdigest(),
+            "admit": admit_detail,
+        }
+
+    push_off = admit_leg(False)
+    push_on = admit_leg(True)
+    os.environ.pop("PS_PUSH_NATIVE_ADMIT", None)
+    ps.shutdown()
+    push_plane = {
+        "workers": n_push,
+        "replays_per_worker": replays,
+        "pushes_per_s": {"off": push_off["pushes_per_s"],
+                         "on": push_on["pushes_per_s"]},
+        "push_p99_us": {"off": push_off["push_p99_us"],
+                        "on": push_on["push_p99_us"]},
+        "speedup": round(push_on["pushes_per_s"]
+                         / push_off["pushes_per_s"], 3)
+        if push_off["pushes_per_s"] else None,
+        "native_admit_share": (push_on["admit"] or {}).get("share"),
+        "admit": push_on["admit"],
+        "replay_acked": {"off": push_off["replay_acked"],
+                         "on": push_on["replay_acked"]},
+        "params_match": push_off["digest"] == push_on["digest"],
+        "digest_off": push_off["digest"],
+        "digest_on": push_on["digest"],
+    }
+
     print(json.dumps({
         "metric": "van_push_pull_gbps_bucketed",
         "value": round(bucketed_gbps, 3),
@@ -1005,6 +1134,7 @@ def bench_transport(args, retried: bool):
             # two-tier leg where the whole group pays it ONCE per round
             "cross_host_bytes_per_step": int(wire_per_cycle),
             "agg": agg_detail,
+            "push_plane": push_plane,
             "transport": ts,
             "note": (
                 "loopback van, serial vs bucketed push_pull on one server; "

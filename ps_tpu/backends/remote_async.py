@@ -377,7 +377,12 @@ class AsyncPSService(VanService):
             # merged push) — a verdict computed before the park would be
             # stale, which is exactly a double-apply window
             fresh = grads
-            if pseq is not None:
+            # the native admission stamp proves the loop classified this
+            # frame strictly fresh at a generation no apply has superseded
+            # (checked HERE, under the lock and after any park): the
+            # per-key dedup scan would find nothing, so skip it. A stale
+            # or absent stamp takes the full scan — never a double apply.
+            if pseq is not None and not self._admit_fresh_hint():
                 fresh = self._dedup_fresh(worker, pnonce, int(pseq), grads)
                 if not fresh:
                     # every key already carries this (nonce, seq): the
@@ -431,6 +436,14 @@ class AsyncPSService(VanService):
             # the merged push dedups against the derived token — the two
             # live under different worker ids, so neither evicts the other.
             self._record_members(extra.get("members"), fresh)
+            # republish the settled ledger rows this apply advanced — the
+            # pushing worker's and, for a merged push, every constituent
+            # member's — plus the fresh replay-ack template, to the native
+            # admission mirror at the post-apply generation (the
+            # _invalidate_reads above bumped it)
+            self._admit_publish(worker,
+                                *[int(w) for w in
+                                  (extra.get("members") or {})])
             self._pause_cond.notify_all()  # a drain_to waiter may be watching
             with self._log_lock:
                 self.apply_log.append(worker)
@@ -525,6 +538,48 @@ class AsyncPSService(VanService):
                 continue
             fresh[k] = v
         return fresh
+
+    # -- zero-upcall push plane (README "Push path") ---------------------------
+
+    def _admit_kind(self):
+        # whole-tree PUSH only: PUSH_PULL replies with params (no
+        # template can pre-encode them) and bucket frames are staged
+        return tv.PUSH
+
+    def _admit_entry(self, worker: int):
+        """This worker's per-key token map folded to one (nonce, lo, hi)
+        ledger row — publishable only when EVERY served key carries a
+        token under ONE nonce (lo = min seq, hi = max seq): a replay
+        at/below lo is settled on every key (the pump would pure-ack
+        it), above hi is strictly fresh on every key. A partial or
+        mixed-nonce map returns None and the worker's frames punt — the
+        straddling-replay subtree apply stays pump-only."""
+        toks = self._applied_pseq.get(worker)
+        order = self._key_order
+        if not toks or not order:
+            return None
+        nonce = None
+        lo = hi = 0
+        for k in order:
+            t = toks.get(k)
+            if t is None or not isinstance(t[0], str):
+                return None
+            if nonce is None:
+                nonce, lo, hi = t[0], int(t[1]), int(t[1])
+            elif t[0] != nonce:
+                return None
+            else:
+                s = int(t[1])
+                lo = min(lo, s)
+                hi = max(hi, s)
+        return nonce, lo, hi
+
+    def _admit_ack_bytes(self):
+        # byte-for-byte the pump's pure-replay ack (worker id patched by
+        # the loop): current engine version, dedup flag set
+        return tv.encode(tv.OK, 0, None, extra={
+            "version": self._engine.version, "dedup": True,
+        })
 
     def _check_push_keys(self, grads) -> None:
         """Key-range validation (engine lock held). On an elastic service
@@ -798,6 +853,10 @@ class AsyncPSService(VanService):
                     return tv.encode(tv.ERR, worker, None,
                                      extra={"error": self._ckpt_busy_error()})
                 self._paused = True
+                # paused: every push must reach the pump and PARK there
+                # (cross-shard snapshot atomicity) — drop the native
+                # admission mirror until resume reseeds it
+                self._admit_drop()
                 applied = {str(w): n for w, n in self._applied.items()}
             return tv.encode(tv.OK, worker, None, extra={
                 "version": self._engine.version, "applied": applied,
@@ -810,6 +869,7 @@ class AsyncPSService(VanService):
             with self._engine._lock:
                 self._paused = False
                 self._ckpt_clear_token()
+                self._admit_sync(locked=True)  # pause over: reseed
                 self._pause_cond.notify_all()
             return tv.encode(tv.OK, worker, None,
                              extra={"version": self._engine.version,
@@ -853,6 +913,8 @@ class AsyncPSService(VanService):
             with self._engine._lock:
                 self._paused = False
                 self._ckpt_clear_token()
+                self._admit_sync(locked=True)  # pause over: reseed the
+                # admission mirror from the drained ledger
                 self._pause_cond.notify_all()
             return tv.encode(tv.OK, worker, None,
                              extra={"version": self._engine.version})
@@ -998,6 +1060,10 @@ class AsyncPSService(VanService):
                 now_moved.update({k: new_epoch for k in keys})
                 self._moved_keys = now_moved
                 self.table_epoch = max(self.table_epoch, new_epoch)
+                # the key range (and the per-key token folds over it)
+                # changed shape: structural reseed, still under the
+                # cutover's lock hold so no frame sees a half-moved mirror
+                self._admit_sync(locked=True)
                 committed = True
         finally:
             with engine._lock:
@@ -1138,6 +1204,9 @@ class AsyncPSService(VanService):
             # retryably from now on (and remembering the commit so a
             # re-asked MIGRATE_COMMIT acks instead of "aborting" it)
             self._elastic = True
+            # the key range grew and the donor's tokens merged in: the
+            # per-worker ledger folds are stale — structural reseed
+            self._admit_sync(locked=True)
         with self._stage_lock:
             self._migrate_in = None
             self._migrate_committed = {
@@ -1165,6 +1234,8 @@ class AsyncPSService(VanService):
             self._draining = True
             self._pause_cond.notify_all()  # paused pushes wake into refusal
         self._invalidate_reads()  # no native hit may outlive the drain
+        self._admit_drop()  # nor any native push ack/refusal: the pump's
+        # draining refusal is the only correct answer now
 
     def stop(self, grace: float = 10.0) -> None:
         m = self._coord_member

@@ -370,7 +370,12 @@ class SparsePSService(VanService):
         apply_s = None
         with obs.tracer().child("server_apply", cat="server"), \
                 self._lock:
-            if pseq is not None:
+            # the native admission stamp proves the loop classified this
+            # frame strictly fresh at a generation no apply superseded
+            # (checked under the lock): the replay check would find
+            # nothing, so skip it. Stale/absent stamps take the full
+            # check — never a double apply.
+            if pseq is not None and not self._admit_fresh_hint():
                 last = self._applied_pseq.get(worker)
                 if (last is not None and last[0] == pnonce
                         and int(pseq) <= last[1]):
@@ -416,6 +421,10 @@ class SparsePSService(VanService):
             if pseq is not None:
                 self._applied_pseq[worker] = (pnonce, int(pseq),
                                               list(pfan or []))
+            # republish this worker's settled ledger row + the fresh
+            # replay-ack template to the native admission mirror at the
+            # post-apply generation (_invalidate_reads above bumped it)
+            self._admit_publish(worker)
             self._pause_cond.notify_all()  # a drain_to waiter may watch
             with self._log_lock:
                 self.apply_log.append(worker)
@@ -438,6 +447,30 @@ class SparsePSService(VanService):
         if rec is None:
             return True  # the targeted cycle's message is still in flight
         return rec[0] == nonce and rec[1] < seq
+
+    # -- zero-upcall push plane (README "Push path") ---------------------------
+
+    def _admit_kind(self):
+        # flat ROW_PUSH only: ROW_PUSH_PULL replies with rows (no
+        # template can pre-encode them) and bucketed row pushes stage
+        return tv.ROW_PUSH
+
+    def _admit_entry(self, worker: int):
+        """The scalar sparse ledger row: a worker's last applied cycle is
+        one (nonce, seq) — lo == hi == seq, so a replay at/below it is
+        settled and anything above is strictly fresh (exactly the pump's
+        replay predicate)."""
+        rec = self._applied_pseq.get(worker)
+        if rec is None or not isinstance(rec[0], str):
+            return None
+        return rec[0], int(rec[1]), int(rec[1])
+
+    def _admit_ack_bytes(self):
+        # byte-for-byte the pump's pure-replay ack (worker id patched by
+        # the loop): current table versions, dedup flag set
+        return tv.encode(tv.OK, 0, None, extra={
+            "versions": dict(self.versions), "dedup": True,
+        })
 
     def _rows_payload(self, worker: int,
                       per_table: Dict[str, Dict[str, np.ndarray]]) -> bytes:
@@ -628,6 +661,9 @@ class SparsePSService(VanService):
                     return tv.encode(tv.ERR, worker, None,
                                      extra={"error": self._ckpt_busy_error()})
                 self._paused = True
+                # paused: every push must reach the pump (drain_to decides
+                # admission there) — drop the native mirror until resume
+                self._admit_drop()
                 applied = {str(w): [nonce, seq, fan]
                            for w, (nonce, seq, fan)
                            in self._applied_pseq.items()}
@@ -641,6 +677,7 @@ class SparsePSService(VanService):
             with self._lock:
                 self._paused = False
                 self._ckpt_clear_token()
+                self._admit_sync(locked=True)  # pause over: reseed
                 self._pause_cond.notify_all()
             return tv.encode(tv.OK, worker, None,
                              extra={"versions": dict(self.versions),
@@ -691,6 +728,8 @@ class SparsePSService(VanService):
             with self._lock:
                 self._paused = False
                 self._ckpt_clear_token()
+                self._admit_sync(locked=True)  # pause over: reseed the
+                # admission mirror from the drained ledger
                 self._pause_cond.notify_all()
             return tv.encode(tv.OK, worker, None,
                              extra={"versions": dict(self.versions)})
@@ -709,6 +748,8 @@ class SparsePSService(VanService):
             self._draining = True
             self._pause_cond.notify_all()  # paused pushes wake into refusal
         self._invalidate_reads()  # no native hit may outlive the drain
+        self._admit_drop()  # nor any native push ack: the pump's
+        # draining refusal is the only correct answer now
 
     # -- shard replication hooks (ps_tpu/replica) -----------------------------
 

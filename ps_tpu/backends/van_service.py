@@ -377,6 +377,37 @@ class VanService:
             if cache_bytes:
                 self._nloop.cache_config(tv.READ, cache_bytes)
                 self._native_read_cache = True
+        # zero-upcall push plane (README "Push path"): the loop classifies
+        # steady-state push frames against a per-worker (nonce, settled
+        # seq) ledger mirror ON THE OWNER THREAD — pure replays acked
+        # natively with the recorded dedup template, role refusals
+        # (backup/fenced) answered natively with the pump's exact bytes,
+        # fresh pushes admission-stamped so the apply can skip the dedup
+        # scan. off|on|auto (auto == on wherever the loop runs); the pump
+        # path stays the drop-in parity oracle, and blocker kinds,
+        # aggregator rounds, and paused/draining states always punt.
+        self._native_admit = False
+        if self._nloop is not None:
+            from ps_tpu.config import env_str as _env_str
+
+            # validated service-level read (pslint PSL406): mirrors
+            # Config.push_native_admit; an unknown token warns and keeps
+            # the default instead of taking the service down
+            admit_mode = (_env_str("PS_PUSH_NATIVE_ADMIT", "auto")
+                          or "auto").strip().lower()
+            if admit_mode not in ("off", "on", "auto"):
+                logging.getLogger(__name__).warning(
+                    "PS_PUSH_NATIVE_ADMIT=%r not in off|on|auto; keeping "
+                    "'auto'", admit_mode)
+                admit_mode = "auto"
+            admit_kind = self._admit_kind()
+            if admit_mode != "off" and admit_kind is not None:
+                self._nloop.admit_config(admit_kind)
+                self._native_admit = True
+                # seed the mirror from the engine's settled ledger (a
+                # checkpoint-restored or backup service starts with
+                # history; a fresh one arms the role refusal only)
+                self._admit_sync()
         # in-loop native telemetry (README "Native observability"):
         # PS_NL_STATS arms the loop's own lock-free histograms (frame
         # read, queue wait, native read-hit serve, tail flush — the
@@ -415,6 +446,14 @@ class VanService:
                 "ps_pull_cache_version_lag",
                 "engine versions the cached READ snapshot trails by "
                 "(0 = fresh or empty)")
+            self._padm_acks_gauge = obs.default_registry().gauge(
+                "ps_push_native_acks_total",
+                "push replays acked by the native admission ledger with "
+                "zero upcalls")
+            self._padm_ref_gauge = obs.default_registry().gauge(
+                "ps_push_native_refusals_total",
+                "push frames refused natively (backup/fenced role) with "
+                "zero upcalls")
             self._pump_thread = threading.Thread(
                 target=self._loop_pump, daemon=True
             )
@@ -596,15 +635,22 @@ class VanService:
         cached entries whose tag set intersects are dropped, so hot
         id-sets disjoint from the apply keep serving natively. None (the
         dense services, and every structural change) drops everything.
-        Cheap no-op when the native cache is off."""
-        if not self._native_read_cache:
+        The native push-admission mirror rides the same generation: the
+        bump raises its floor too (dropping the version-stamped ack
+        template, which the post-apply :meth:`_admit_publish` re-arms),
+        so a pre-apply classification can never ack a post-apply replay.
+        Cheap no-op when both native mirrors are off."""
+        if not (self._native_read_cache or self._native_admit):
             return
         with self._read_gen_lock:
             self._read_gen += 1
             gen = self._read_gen
         nloop = self._nloop
         if nloop is not None:
-            nloop.cache_invalidate(gen, tags=tags)
+            if self._native_read_cache:
+                nloop.cache_invalidate(gen, tags=tags)
+            if self._native_admit:
+                nloop.admit_invalidate(gen)
 
     def _note_read_snapshot(self, gen: int, version: int,
                             tags=None) -> None:
@@ -616,6 +662,141 @@ class VanService:
         self._read_pub.gen = gen
         self._read_pub.version = int(version)
         self._read_pub.tags = tags
+
+    # -- zero-upcall push plane (README "Push path") ---------------------------
+
+    def _admit_kind(self) -> Optional[int]:
+        """Subclass hook: the ONE wire kind the native admission mirror
+        may classify (dense: PUSH; sparse: ROW_PUSH). None = this service
+        never admits natively — the aggregator's group rounds barrier on
+        the pump, and bucketed/push-pull kinds carry replies no template
+        can pre-encode, so they stay pump-only everywhere."""
+        return None
+
+    def _admit_entry(self, worker: int) -> Optional[tuple]:
+        """Subclass hook: this worker's settled-ledger row as
+        ``(nonce, lo, hi)`` — a replay at/below ``lo`` is fully applied
+        (ackable), above ``hi`` is strictly fresh, between punts. None =
+        not publishable (no uniform token across the served key range);
+        the native loop then punts this worker's frames to the pump."""
+        return None
+
+    def _admit_entries(self):
+        """Every publishable ledger row (for a full mirror reseed)."""
+        out = []
+        for w in list(getattr(self, "_applied_pseq", None) or ()):
+            ent = self._admit_entry(int(w))
+            if ent is not None:
+                out.append((int(w), ent[0], int(ent[1]), int(ent[2])))
+        return out
+
+    def _admit_ack_bytes(self) -> Optional[bytes]:
+        """Subclass hook: the encoded replay-ack reply (worker id 0 — the
+        loop patches the requester's id in before sending), byte-for-byte
+        what the pump would produce for a pure dedup replay RIGHT NOW.
+        Version-stamped: every apply invalidates it at the native floor
+        and the post-apply publish re-arms it, so a native ack can never
+        carry a superseded version stamp."""
+        return None
+
+    def _admit_refusal_bytes(self) -> Optional[bytes]:
+        """The typed role refusal the native loop answers push frames
+        with while this service is not serving worker traffic — the
+        EXACT bytes of :meth:`_dispatch_traced`'s backup/fenced refusal
+        (worker id 0; the loop patches the requester's id). None on a
+        serving primary."""
+        if self.role == "primary":
+            return None
+        return tv.encode(tv.ERR, 0, None, extra={
+            "error": (f"shard backup is not serving worker traffic "
+                      f"(role={self.role}, epoch {self.epoch}) — "
+                      f"retry after promotion"),
+            "backup": True, "epoch": self.epoch,
+        })
+
+    def _admit_sync(self, locked: bool = False) -> None:
+        """Structural reseed of the native admission mirror (promotion,
+        fencing, checkpoint resume, migration cutover, startup): drop
+        everything at a fresh generation, then republish the settled
+        ledger — or arm the role refusal instead on a non-primary. Takes
+        the service (apply) lock unless the caller already holds it, so
+        the ledger it reads cannot move under the reseed."""
+        if not self._native_admit or self._nloop is None:
+            return
+        if not locked:
+            with self._service_lock():
+                return self._admit_sync(locked=True)
+        nloop = self._nloop
+        with self._read_gen_lock:
+            self._read_gen += 1
+            gen = self._read_gen
+        nloop.admit_reset(gen)
+        refusal = self._admit_refusal_bytes()
+        if refusal is not None:
+            nloop.admit_set_refusal(refusal)
+            return
+        nloop.admit_set_refusal(b"")
+        if getattr(self, "_paused", False) or getattr(self, "_draining",
+                                                      False):
+            return  # paused/draining: every push must reach the pump
+        for w, nonce, lo, hi in self._admit_entries():
+            nloop.admit_put(w, nonce, lo, hi, gen)
+        ack = self._admit_ack_bytes()
+        if ack is not None:
+            nloop.admit_set_ack(ack, gen)
+
+    def _admit_drop(self) -> None:
+        """Suspend native admission (checkpoint pause, drain): drop the
+        whole mirror at a fresh generation so every push frame punts to
+        the pump until :meth:`_admit_sync` reseeds. Needs no service
+        lock — the bump only ever makes classification MORE conservative."""
+        if not self._native_admit or self._nloop is None:
+            return
+        with self._read_gen_lock:
+            self._read_gen += 1
+            gen = self._read_gen
+        self._nloop.admit_reset(gen)
+
+    def _admit_publish(self, *workers) -> None:
+        """Per-apply incremental publish (call under the apply lock,
+        AFTER the apply's :meth:`_invalidate_reads` bumped the
+        generation): push the named workers' settled-ledger rows and the
+        fresh replay-ack template to the native mirror at the post-apply
+        generation. The floor the invalidation raised refuses any
+        laggard publish from a superseded apply."""
+        if (not self._native_admit or self._nloop is None
+                or self.role != "primary"
+                or getattr(self, "_paused", False)
+                or getattr(self, "_draining", False)):
+            return
+        nloop = self._nloop
+        with self._read_gen_lock:
+            gen = self._read_gen
+        for w in workers:
+            if w is None:
+                continue
+            ent = self._admit_entry(int(w))
+            if ent is not None:
+                nloop.admit_put(int(w), ent[0], int(ent[1]), int(ent[2]),
+                                gen)
+        ack = self._admit_ack_bytes()
+        if ack is not None:
+            nloop.admit_set_ack(ack, gen)
+
+    def _admit_fresh_hint(self) -> bool:
+        """Consume this thread's native admission stamp: True iff the
+        loop classified the frame strictly fresh AND no apply/reseed
+        landed since (the stamp is floor+1 of its classification; every
+        state change bumps the shared generation). Call under the apply
+        lock — applies serialize there, so a True return proves the
+        dedup scan would find nothing and can be skipped. Any staleness
+        degrades to False: the full scan, never a double apply."""
+        gen = getattr(self._read_pub, "admit", 0)
+        if not gen:
+            return False
+        self._read_pub.admit = 0
+        with self._read_gen_lock:
+            return gen - 1 == self._read_gen
 
     def promote(self, reason: str = "request") -> int:
         """The backup→primary transition (idempotent): under the apply
@@ -638,6 +819,11 @@ class VanService:
         # outlive the promotion (its bytes are still correct state, but
         # freshness semantics changed — republish as primary)
         self._invalidate_reads()
+        # re-seed the admission mirror from the replicated ledger: the
+        # promoted backup suppresses exactly the replays its dead primary
+        # would have, natively, from the first post-promotion frame —
+        # and stops answering the backup refusal
+        self._admit_sync()
         self.promotion_s = _time.perf_counter() - t0
         obs.record_event("promotion", reason=reason, epoch=self.epoch,
                          promotion_s=round(self.promotion_s, 6))
@@ -695,8 +881,11 @@ class VanService:
             if self.role != "primary":
                 return
             self.role = "fenced"
-        # a zombie's cached reads die with its serving rights
+        # a zombie's cached reads die with its serving rights — and its
+        # admission mirror flips to the fenced refusal (native, byte-
+        # identical to the pump's): no ledger row may ack a push here
         self._invalidate_reads()
+        self._admit_sync()
         obs.record_event("self_fence", peer_epoch=int(peer_epoch),
                          epoch=self.epoch)
         logging.getLogger(__name__).error(
@@ -798,6 +987,22 @@ class VanService:
             s = self.transport.hist["nl_queue_wait_s"].summary()
             if s:
                 loop["qw99_us"] = round(s["p99"] * 1e6, 1)
+            t = self.transport
+            classified = (t.push_native_acks + t.push_native_refusals
+                          + t.push_native_fresh + t.push_native_punts)
+            if classified:
+                # push-admission visibility (ps_top's padm% column): how
+                # much of the push plane the native mirror settled without
+                # an upcall (acks + refusals), plus the raw counters
+                loop["padm"] = {
+                    "acks": t.push_native_acks,
+                    "refusals": t.push_native_refusals,
+                    "fresh": t.push_native_fresh,
+                    "punts": t.push_native_punts,
+                    "share": round((t.push_native_acks
+                                    + t.push_native_refusals)
+                                   / classified, 4),
+                }
             out["loop"] = loop
         return out
 
@@ -1118,6 +1323,13 @@ class VanService:
                     self._read_lag_gauge.set(
                         max(0, int(v) - self._read_pub_version)
                         if v is not None and cs["entries"] else 0)
+                if self._native_admit:
+                    asn = nloop.admit_stats()
+                    self.transport.set_admit_stats(
+                        asn["acks"], asn["refusals"], asn["fresh"],
+                        asn["punts"])
+                    self._padm_acks_gauge.set(asn["acks"])
+                    self._padm_ref_gauge.set(asn["refusals"])
                 if self._nl_stats:
                     self._sync_nl_telemetry(nloop)
             if batch is None:
@@ -1127,15 +1339,15 @@ class VanService:
             if self._pump_abort:
                 # kill(): drop read-ahead frames unserved — engine state
                 # must stay exactly as a SIGKILL would leave it
-                for _, _, ptr in batch:
+                for _, _, ptr, _ in batch:
                     nloop.free(ptr)
                 continue
             self.transport.record_upcall(len(batch))
             with self._inflight_cond:
                 self._inflight += len(batch)
-            for cid, view, ptr in batch:
+            for cid, view, ptr, admit_gen in batch:
                 try:
-                    self._loop_serve_one(cid, view, ptr)
+                    self._loop_serve_one(cid, view, ptr, admit_gen)
                 except Exception:
                     logging.getLogger(__name__).exception(
                         "native-loop request failed; connection %d "
@@ -1207,7 +1419,8 @@ class VanService:
         if fd >= 0:
             os.close(fd)
 
-    def _loop_serve_one(self, cid: int, msg, ptr: int) -> None:
+    def _loop_serve_one(self, cid: int, msg, ptr: int,
+                        admit_gen: int = 0) -> None:
         nloop = self._nloop
         if self._pump_abort:  # kill() landed mid-batch: drop, don't apply
             nloop.free(ptr)
@@ -1276,7 +1489,7 @@ class VanService:
                     threading.Thread(
                         target=self._loop_dispatch_reply,
                         args=(cid, kind, worker, tensors, extra, ptr,
-                              True, blocker, raw),
+                              True, blocker, raw, admit_gen),
                         daemon=True,
                     ).start()
                 else:
@@ -1288,7 +1501,7 @@ class VanService:
                     # lock (no parking condition is live on this branch).
                     self._punt_pool().submit(
                         self._loop_dispatch_reply, cid, kind, worker,
-                        tensors, extra, ptr, True, False, raw)
+                        tensors, extra, ptr, True, False, raw, admit_gen)
             except Exception as e:  # thread exhaustion: refuse, don't die
                 with self._inflight_cond:
                     self._inflight -= 1
@@ -1301,7 +1514,7 @@ class VanService:
                 nloop.free(ptr)
             return
         self._loop_dispatch_reply(cid, kind, worker, tensors, extra, ptr,
-                                  False, raw=raw)
+                                  False, raw=raw, admit_gen=admit_gen)
 
     def _dispatch_reply_payload(self, kind: int, worker: int, tensors,
                                 extra):
@@ -1345,7 +1558,7 @@ class VanService:
     def _loop_dispatch_reply(self, cid: int, kind: int, worker: int,
                              tensors, extra, ptr: int,
                              punted: bool, blocker: bool = False,
-                             raw=None) -> None:
+                             raw=None, admit_gen: int = 0) -> None:
         nloop = self._nloop
         prio = self._reply_priority(kind, extra)
         # mark this thread as serving a LOOP request for the dispatch's
@@ -1354,6 +1567,11 @@ class VanService:
         # pool threads are reused)
         this = threading.current_thread()
         this._ps_loop_req = True
+        # the frame's native admission stamp (0 = unclassified) rides a
+        # thread-local to the engine's apply, which consumes it via
+        # _admit_fresh_hint — set unconditionally: pool/pump threads are
+        # reused and a previous request's stamp must never leak forward
+        self._read_pub.admit = int(admit_gen)
         try:
             if raw is not None:
                 self._read_pub.gen = None  # pool/pump threads are reused:

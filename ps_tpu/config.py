@@ -72,6 +72,13 @@ Environment variables honored by :meth:`Config.from_env`:
   (kind, size, conn, per-stage timings, propagated trace id) that the
   pump drains into a ``slow_frame`` flight event with a reconstructed
   span (default 250; 0 disarms; needs PS_NL_STATS on)
+- ``PS_PUSH_NATIVE_ADMIT``  — zero-upcall push plane (README "Push
+  path"): 'off' | 'on' | 'auto' (default auto = on wherever the native
+  loop serves). The loop classifies steady-state push frames against a
+  per-worker dedup-ledger mirror: pure replays acked and role refusals
+  answered natively with the pump's exact bytes, fresh pushes
+  admission-stamped so the apply skips the dedup scan. 'off' keeps the
+  pump as the only admission path — the drop-in parity oracle
 - ``PS_READ_STALENESS``     — worker side: how many VERSIONS a replica-
   served READ may trail the last-known primary version before the read
   falls back to the primary (default 0 = replicas serve only what is
@@ -352,6 +359,13 @@ class Config:
         round trip; version bumps piggyback on every reply the worker
         decodes plus a REPLICA_STATE probe on the heartbeat cadence.
         Off by default (explicit opt-in, like shm).
+      push_native_admit: zero-upcall push plane (README "Push path"):
+        'off' | 'on' | 'auto' (default auto = on wherever the native
+        loop serves). The loop classifies steady-state push frames
+        against a per-worker dedup-ledger mirror — replays acked and
+        role refusals answered natively with the pump's exact bytes,
+        fresh pushes admission-stamped; 'off' keeps every push on the
+        pump (the parity oracle).
       fused_apply: sparse embedding fused apply tier (README "Sparse
         apply"; ps_tpu/ops/sparse_apply.py): 'off' keeps the legacy
         masked full-table apply (O(num_rows) HBM traffic per push);
@@ -515,6 +529,12 @@ class Config:
     native_read_cache_bytes: int = 64 << 20
     read_staleness: int = 0
     pull_cache: bool = False
+    # zero-upcall push plane (README "Push path"): native push admission
+    # in the epoll loop — replay acks + role refusals answered with zero
+    # upcalls, fresh pushes admission-stamped for the pump's apply.
+    # 'off' keeps the pump as the only admission path (the parity
+    # oracle); 'on'/'auto' arm it wherever the native loop serves.
+    push_native_admit: str = "auto"
     # in-loop native telemetry (README "Native observability"): the
     # epoll loop's own lock-free histograms + the slow-frame watchdog
     # threshold (ms; 0 disarms)
@@ -680,6 +700,11 @@ class Config:
                              "(0 disarms the slow-frame watchdog)")
         if self.read_staleness < 0:
             raise ValueError("read_staleness must be >= 0 versions")
+        if self.push_native_admit not in ("off", "on", "auto"):
+            raise ValueError(
+                f"unknown push_native_admit mode "
+                f"{self.push_native_admit!r}; use 'off', 'on' or 'auto'"
+            )
         if self.fused_apply not in ("auto", "off", "jax", "pallas"):
             raise ValueError(
                 f"unknown fused_apply tier {self.fused_apply!r}; use "
@@ -848,6 +873,10 @@ class Config:
             kwargs["nl_slow_frame_ms"] = float(env["PS_NL_SLOW_FRAME_MS"])
         if "PS_PULL_CACHE" in env:
             kwargs["pull_cache"] = env_flag("PS_PULL_CACHE", False)
+        if "PS_PUSH_NATIVE_ADMIT" in env:
+            # "" explicitly selects the auto default
+            kwargs["push_native_admit"] = (
+                env["PS_PUSH_NATIVE_ADMIT"].strip().lower() or "auto")
         if "PS_FUSED_APPLY" in env:
             # "" explicitly selects the auto detection
             kwargs["fused_apply"] = env["PS_FUSED_APPLY"].strip() or "auto"

@@ -82,6 +82,12 @@ def _lib():
         ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_uint64),
         ctypes.c_int, ctypes.c_int,
     ]
+    lib.nl_poll2.restype = ctypes.c_int
+    lib.nl_poll2.argtypes = [
+        ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_void_p), ctypes.POINTER(ctypes.c_uint64),
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_int, ctypes.c_int,
+    ]
     lib.nl_reply_vec.restype = ctypes.c_int
     lib.nl_reply_vec.argtypes = [
         ctypes.c_void_p, ctypes.c_uint64, ctypes.POINTER(ctypes.c_void_p),
@@ -121,6 +127,23 @@ def _lib():
         ctypes.c_void_p, ctypes.c_uint64,
         ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,
     ]
+    lib.nl_admit_config.argtypes = [ctypes.c_void_p, ctypes.c_int]
+    lib.nl_admit_put.restype = ctypes.c_int
+    lib.nl_admit_put.argtypes = [
+        ctypes.c_void_p, ctypes.c_uint32, ctypes.c_void_p,
+        ctypes.c_uint64, ctypes.c_uint64, ctypes.c_uint64,
+        ctypes.c_uint64,
+    ]
+    lib.nl_admit_set_ack.restype = ctypes.c_int
+    lib.nl_admit_set_ack.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                     ctypes.c_uint64, ctypes.c_uint64]
+    lib.nl_admit_set_refusal.restype = ctypes.c_int
+    lib.nl_admit_set_refusal.argtypes = [ctypes.c_void_p, ctypes.c_void_p,
+                                         ctypes.c_uint64]
+    lib.nl_admit_invalidate.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.nl_admit_reset.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+    lib.nl_admit_stats.argtypes = [ctypes.c_void_p,
+                                   ctypes.POINTER(ctypes.c_uint64)]
     lib.nl_telemetry_config.argtypes = [ctypes.c_void_p, ctypes.c_int,
                                         ctypes.c_uint64]
     lib.nl_hist_snapshot.restype = ctypes.c_int
@@ -179,8 +202,10 @@ class NativeEventLoop:
         self._ids = (ctypes.c_uint64 * MAX_BATCH)()
         self._ptrs = (ctypes.c_void_p * MAX_BATCH)()
         self._lens = (ctypes.c_uint64 * MAX_BATCH)()
+        self._admits = (ctypes.c_uint64 * MAX_BATCH)()
         self._stats_out = (ctypes.c_uint64 * 6)()
         self._cache_out = (ctypes.c_uint64 * 8)()
+        self._admit_out = (ctypes.c_uint64 * 8)()
         self._hist_out = (ctypes.c_uint64 * (4 + NL_HIST_BUCKETS))()
         self._nl_out = (ctypes.c_uint64 * 8)()
         self._slow_vals = (ctypes.c_uint64 * (_SLOW_VALS * MAX_BATCH))()
@@ -194,16 +219,20 @@ class NativeEventLoop:
     # -- pump side -----------------------------------------------------------
 
     def poll(self, timeout_ms: int = 100
-             ) -> Optional[List[Tuple[int, memoryview, int]]]:
+             ) -> Optional[List[Tuple[int, memoryview, int, int]]]:
         """Wait (GIL released) for ready requests. Returns a list of
-        ``(conn_id, frame_view, body_ptr)`` — possibly empty on timeout —
-        or None once the loop is stopping and fully drained (the pump's
-        exit signal). The frame view aliases native memory owned by the
-        caller until :meth:`free`."""
+        ``(conn_id, frame_view, body_ptr, admit_gen)`` — possibly empty
+        on timeout — or None once the loop is stopping and fully drained
+        (the pump's exit signal). ``admit_gen`` is the native admission
+        stamp: 0 for an unclassified frame, otherwise floor + 1 for a
+        PUSH frame the owner thread proved fresh (trust it only while
+        the engine's read generation still equals ``admit_gen - 1``).
+        The frame view aliases native memory owned by the caller until
+        :meth:`free`."""
         if self._closed:  # racing close(): the loop is gone
             return None
-        n = self._lib.nl_poll(self._h, self._ids, self._ptrs, self._lens,
-                              MAX_BATCH, int(timeout_ms))
+        n = self._lib.nl_poll2(self._h, self._ids, self._ptrs, self._lens,
+                               self._admits, MAX_BATCH, int(timeout_ms))
         if n < 0:
             return None
         out = []
@@ -216,7 +245,8 @@ class NativeEventLoop:
                 else:
                     view = memoryview(b"")
                 self._claimed.add(int(ptr))
-                out.append((int(self._ids[i]), view, int(ptr)))
+                out.append((int(self._ids[i]), view, int(ptr),
+                            int(self._admits[i])))
         return out
 
     def reply(self, conn_id: int, payload, close_after: bool = False,
@@ -361,6 +391,117 @@ class NativeEventLoop:
                     "puts": int(o[2]), "rejects": int(o[3]),
                     "invalidations": int(o[4]), "entries": int(o[5]),
                     "bytes": int(o[6]), "floor": int(o[7])}
+
+    # -- native push admission (zero-upcall push plane) ------------------------
+
+    def admit_config(self, kind: int) -> None:
+        """Arm push admission: frames whose first body byte is ``kind``
+        (the wire kind — tv.PUSH or tv.ROW_PUSH) are classified inside
+        the loop threads against the ledger mirror (kind < 0 disables
+        and clears the ledger and both reply templates)."""
+        with self._lock:
+            if not self._closed:
+                self._lib.nl_admit_config(self._h, int(kind))
+
+    def admit_put(self, worker: int, nonce: bytes, lo: int, hi: int,
+                  gen: int) -> bool:
+        """Publish one worker's ledger mirror entry: ``nonce`` its
+        current push nonce, ``lo`` the settled dedup bound (every key
+        the worker pushes settled at seq <= lo), ``hi`` the recorded
+        bound, ``gen`` the publish generation captured under the engine
+        lock. False = refused (admission off, an apply already raised
+        the floor past ``gen``, or a malformed nonce/window). The nonce
+        is copied native-side; never retained. A ``str`` nonce is
+        UTF-8 encoded — the native sniffer matches the frame's raw JSON
+        string bytes, and a nonce needing JSON escapes simply never
+        matches (the frame punts to the pump, which is always safe)."""
+        if isinstance(nonce, str):
+            nonce = nonce.encode("utf-8")
+        nv = np.frombuffer(nonce, np.uint8)
+        if not self._pin():
+            return False
+        try:
+            ok = self._lib.nl_admit_put(self._h, int(worker),
+                                        nv.ctypes.data, nv.nbytes,
+                                        int(lo), int(hi), int(gen))
+        finally:
+            self._unpin()
+        del nv  # pinned the source for exactly the call's duration
+        return bool(ok)
+
+    def admit_set_ack(self, frame: bytes, gen: int) -> bool:
+        """Publish the replay-ack template — the complete reply frame
+        the pump would send for a full-dedup replay, captured under the
+        engine lock with the version stamp the ledger covers (the worker
+        id is patched per serve). ``b""`` clears. False = refused: an
+        apply already raised the floor past ``gen``."""
+        fv = np.frombuffer(frame, np.uint8)
+        if not self._pin():
+            return False
+        try:
+            ok = self._lib.nl_admit_set_ack(
+                self._h, fv.ctypes.data if fv.nbytes else None, fv.nbytes,
+                int(gen))
+        finally:
+            self._unpin()
+        del fv  # pinned the source for exactly the call's duration
+        return bool(ok)
+
+    def admit_set_refusal(self, frame: bytes) -> bool:
+        """Publish (or clear, ``b""``) the role-refusal template: the
+        typed ERR every admissible PUSH frame gets while this shard must
+        refuse pushes (backup role, fenced zombie)."""
+        fv = np.frombuffer(frame, np.uint8)
+        if not self._pin():
+            return False
+        try:
+            ok = self._lib.nl_admit_set_refusal(
+                self._h, fv.ctypes.data if fv.nbytes else None, fv.nbytes)
+        finally:
+            self._unpin()
+        del fv  # pinned the source for exactly the call's duration
+        return bool(ok)
+
+    def admit_invalidate(self, gen: int) -> None:
+        """Invalidation-on-apply (the push twin of
+        :meth:`cache_invalidate`): raise the admission floor to ``gen``
+        and drop the version-stamped ack template; the ledger persists
+        (its bounds only ever advance, so stale entries punt — never
+        mis-ack). Pin-based: runs on the engine apply path."""
+        if not self._pin():
+            return
+        try:
+            self._lib.nl_admit_invalidate(self._h, int(gen))
+        finally:
+            self._unpin()
+
+    def admit_reset(self, gen: int) -> None:
+        """Structural re-seed (promotion, fence, migrate, pause/resume):
+        raise the floor and drop the ledger and BOTH templates; the
+        caller republishes whatever the new role/state allows."""
+        if not self._pin():
+            return
+        try:
+            self._lib.nl_admit_reset(self._h, int(gen))
+        finally:
+            self._unpin()
+
+    def admit_stats(self) -> dict:
+        """Cumulative admission counters: acks (native replay OKs),
+        refusals (native typed ERRs), fresh (stamped + queued), punts
+        (admissible frames the pump classified), ledger entries, floor,
+        and whether each template is armed."""
+        with self._lock:
+            if self._closed:
+                return {"acks": 0, "refusals": 0, "fresh": 0, "punts": 0,
+                        "entries": 0, "floor": 0, "ack_armed": False,
+                        "refusal_armed": False}
+            self._lib.nl_admit_stats(self._h, self._admit_out)
+            o = self._admit_out
+            return {"acks": int(o[0]), "refusals": int(o[1]),
+                    "fresh": int(o[2]), "punts": int(o[3]),
+                    "entries": int(o[4]), "floor": int(o[5]),
+                    "ack_armed": bool(o[6]), "refusal_armed": bool(o[7])}
 
     # -- in-loop telemetry (README "Native observability") --------------------
 

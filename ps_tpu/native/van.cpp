@@ -793,6 +793,10 @@ struct NlReq {
   uint64_t len;
   uint64_t read_ns;    // first byte -> frame complete (0 = stats off)
   uint64_t ready_ns;   // frame-complete stamp for the queue-wait measure
+  uint64_t admit_gen;  // native admission stamp (0 = not classified):
+  // admit_floor + 1 captured when the owner thread classified this PUSH
+  // frame fresh — Python skips its per-key dedup scan only while its
+  // _read_gen still equals stamp - 1 (no apply landed in between)
 };
 
 struct NlThread {
@@ -821,6 +825,28 @@ struct NlCacheEntry {
   // every tagged invalidation, so dense whole-tree replies and over-cap
   // id-sets stay exactly as conservative as before.
   std::vector<uint64_t> tags;
+};
+
+//: bounded tail window of the meta region the push-token sniff walks
+//: (the token lives in `extra`, the LAST top-level meta key)
+constexpr uint64_t kNlAdmitScan = 4096;
+//: longest worker push nonce the native ledger mirrors (in-tree nonces
+//: are short uuid hex; anything longer punts to the pump)
+constexpr int kNlAdmitNonceMax = 96;
+
+// One worker's native push-admission ledger mirror: the engine's settled
+// dedup bounds for the worker's CURRENT nonce. `lo` = every key the
+// worker pushes is settled at seq <= lo (a frame with pseq <= lo is a
+// PURE replay, ackable from the template alone); `hi` = no recorded OR
+// stamped-fresh seq exceeds hi (a frame with pseq > hi is FRESH — the
+// serve advances hi immediately, so a racing duplicate of the same seq
+// punts to the pump instead of also stamping fresh). Python publishes an
+// entry only when the worker's ledger is EXACT (one uniform nonce across
+// every key); everything else punts.
+struct NlAdmitEntry {
+  std::string nonce;
+  uint64_t lo = 0;
+  uint64_t hi = 0;
 };
 
 struct NlLoop {
@@ -880,6 +906,32 @@ struct NlLoop {
   std::mutex slowmu;
   std::deque<NlSlowFrame> slow_ring;
   std::atomic<uint64_t> slow_total{0}, slow_dropped{0};
+  // Native push admission (the zero-upcall push plane, README "Push
+  // path"): Python mirrors each worker's dedup ledger here so the owner
+  // thread can classify an arriving PUSH frame without an upcall — pure
+  // replays are acked from `admit_ack` (the byte-exact OK the pump
+  // would produce, worker id patched in per serve), role refusals from
+  // `admit_refusal` (armed only while this shard must refuse pushes:
+  // backup role, fenced zombie), and fresh frames are STAMPED with
+  // admit_floor + 1 and queued as usual. admitmu is a LEAF lock like
+  // cachemu: taken alone for the ledger/template touch, always released
+  // before the per-conn wmu write — never nested with tmu/qmu/wmu, so
+  // it adds no lock-order edges. admit_floor is the same invalidation
+  // generation the read cache uses: every committed apply raises it
+  // (nl_admit_invalidate), a publish below it is refused, and Python
+  // trusts a fresh stamp only while the floor it was taken at is still
+  // current — so a pre-apply classification can never ack (or skip the
+  // dedup scan for) a post-apply replay.
+  std::mutex admitmu;  // pslint: hot-lock
+  std::map<uint32_t, NlAdmitEntry> admit;
+  std::string admit_ack;      // [u64 le length][reply frame], or empty
+  std::string admit_refusal;  // same shape; armed = non-empty
+  uint64_t admit_floor = 0;
+  // first body byte marking an admissible frame; atomic so the read hot
+  // path gates on it without touching admitmu (mirrors cache_kind)
+  std::atomic<int> admit_kind{-1};
+  std::atomic<uint64_t> admit_acks{0}, admit_refusals{0};
+  std::atomic<uint64_t> admit_fresh{0}, admit_punts{0};
 };
 
 uint64_t nl_cache_hash(const char* p, uint64_t n) {
@@ -987,62 +1039,35 @@ void nl_destroy(NlLoop* l, NlThread& t, NlConn* c) {
   // fetched in this batch may still point at the struct
 }
 
-// Owner thread: answer one cacheable frame from the native read cache.
-// Returns true when the frame was SERVED (reply written or staged — the
-// caller frees the body and moves on); false = miss, queue it to Python
-// as usual (the strict fallback: anything the cache cannot answer takes
-// the pump path, so replies are bitwise identical by construction — the
-// cache only ever echoes buffers Python published).
-bool nl_cache_serve(NlLoop* l, NlThread& t, NlConn* c) {
-  std::shared_ptr<NlCacheEntry> e;
-  {
-    std::lock_guard<std::mutex> lock(l->cachemu);
-    if (!l->cache_limit) return false;
-    uint64_t hv = nl_cache_hash(c->body, c->body_len);
-    auto it = l->cache.find(hv);
-    if (it != l->cache.end()) {
-      for (auto& cand : it->second) {
-        if (cand->key.size() == c->body_len &&
-            memcmp(cand->key.data(), c->body, c->body_len) == 0) {
-          e = cand;
-          break;
-        }
-      }
-    }
-    if (!e) {
-      l->cache_miss.fetch_add(1, std::memory_order_relaxed);
-      return false;
-    }
-  }
-  // write under the per-conn wmu only (cachemu already released — a
-  // multi-KB reply send must not serialize other lookups/puts), same
-  // ordering discipline as nl_reply_vec's staged-tail path
+// Owner thread: write one ready-made reply (length prefix included) to
+// c under the per-conn wmu only — the shared tail of the native serve
+// paths (read-cache hits and push-admission acks/refusals; the caller's
+// table lock — cachemu or admitmu — is already released, since a
+// multi-KB reply send must not serialize other lookups/puts; same
+// ordering discipline as nl_reply_vec's staged-tail path). Returns
+// false ONLY for the pipelining punt: a peer with earlier frames still
+// queued at the pump would see its replies reordered — per-connection
+// reply order is part of the framed request/reply contract, so such a
+// frame must take the pump path behind them. (In-tree clients are
+// strict request/reply, so that branch costs real workloads nothing;
+// the decrement in nl_reply_vec happens under this same wmu and writes
+// under the same hold, so outstanding == 0 here proves every prior
+// reply is fully written or staged ahead of us in wbuf.) True =
+// handled: written, staged for EPOLLOUT, or severed as protocol abuse.
+bool nl_serve_bytes(NlLoop* l, NlThread& t, NlConn* c, const char* data,
+                    size_t len) {
   std::lock_guard<std::mutex> wl(c->wmu);
-  if (c->outstanding != 0) {
-    // a PIPELINING peer has earlier frames still queued at the pump:
-    // answering this one natively would reorder its replies. Punt it to
-    // the pump behind them — per-connection reply order is part of the
-    // framed request/reply contract. (In-tree clients are strict
-    // request/reply, so this branch costs real workloads nothing; the
-    // decrement in nl_reply_vec happens under this same wmu and writes
-    // under the same hold, so outstanding == 0 here proves every prior
-    // reply is fully written or staged ahead of us in wbuf.)
-    l->cache_miss.fetch_add(1, std::memory_order_relaxed);
-    return false;
-  }
-  l->cache_hits.fetch_add(1, std::memory_order_relaxed);
+  if (c->outstanding != 0) return false;
   if (!c->wbuf.empty() && c->wbuf.size() - c->woff > kNlMaxWbufBacklog) {
     // pipelining peer stopped reading: bound server memory (same
     // protocol-abuse sever as nl_reply_vec)
     shutdown(c->fd, SHUT_RDWR);
     return true;
   }
-  // a read reply is front-of-model-critical serving traffic: priority 0
-  // (the min rule matches nl_reply_vec — a staged tail keeps its most
+  // a native reply is front-of-model-critical serving traffic: priority
+  // 0 (the min rule matches nl_reply_vec — a staged tail keeps its most
   // urgent frame's priority)
   c->prio = c->wbuf.empty() ? 0 : std::min(c->prio, 0);
-  const char* data = e->reply.data();
-  size_t len = e->reply.size();
   if (c->wbuf.empty()) {
     size_t off = 0;
     while (off < len) {
@@ -1073,6 +1098,178 @@ bool nl_cache_serve(NlLoop* l, NlThread& t, NlConn* c) {
     epoll_ctl(t.epfd, EPOLL_CTL_MOD, c->fd, &ev);
   }
   return true;
+}
+
+// Owner thread: answer one cacheable frame from the native read cache.
+// Returns true when the frame was SERVED (reply written or staged — the
+// caller frees the body and moves on); false = miss, queue it to Python
+// as usual (the strict fallback: anything the cache cannot answer takes
+// the pump path, so replies are bitwise identical by construction — the
+// cache only ever echoes buffers Python published).
+bool nl_cache_serve(NlLoop* l, NlThread& t, NlConn* c) {
+  std::shared_ptr<NlCacheEntry> e;
+  {
+    std::lock_guard<std::mutex> lock(l->cachemu);
+    if (!l->cache_limit) return false;
+    uint64_t hv = nl_cache_hash(c->body, c->body_len);
+    auto it = l->cache.find(hv);
+    if (it != l->cache.end()) {
+      for (auto& cand : it->second) {
+        if (cand->key.size() == c->body_len &&
+            memcmp(cand->key.data(), c->body, c->body_len) == 0) {
+          e = cand;
+          break;
+        }
+      }
+    }
+    if (!e) {
+      l->cache_miss.fetch_add(1, std::memory_order_relaxed);
+      return false;
+    }
+  }
+  if (!nl_serve_bytes(l, t, c, e->reply.data(), e->reply.size())) {
+    // pipelining punt (see nl_serve_bytes): the pump answers it
+    l->cache_miss.fetch_add(1, std::memory_order_relaxed);
+    return false;
+  }
+  l->cache_hits.fetch_add(1, std::memory_order_relaxed);
+  return true;
+}
+
+// Bounded token sniff for admissible PUSH-kind frames: extract the
+// worker's dedup token (`"pseq": <int>`, `"pnonce": "<str>"`) from the
+// meta region without a JSON parser — the same discipline as
+// nl_extract_tc. The token lives in `extra`, the LAST top-level meta
+// key by the encoder contract, so the scan walks a bounded TAIL window
+// of the meta and takes the LAST occurrence of each key (a tensor name
+// embedding the literal text cannot shadow the real token). Returns the
+// nonce length (> 0) with *pseq filled, or 0 when the frame carries no
+// parseable token — the caller punts: the pump's full JSON decode is
+// the oracle for every frame this scan cannot classify.
+int nl_admit_token(const char* body, uint64_t len, uint64_t* pseq,
+                   char* nonce) {
+  if (body == nullptr || len < 13) return 0;
+  uint64_t mlen;
+  memcpy(&mlen, body + 5, 8);
+  if (mlen > len - 13) return 0;
+  const char* meta = body + 13;
+  uint64_t lo = mlen > kNlAdmitScan ? mlen - kNlAdmitScan : 0;
+  static const char kSeq[] = "\"pseq\":";
+  static const char kNonce[] = "\"pnonce\":";
+  const int64_t sl = (int64_t)sizeof(kSeq) - 1;
+  const int64_t nl = (int64_t)sizeof(kNonce) - 1;
+  int64_t si = -1, ni = -1;
+  for (int64_t i = (int64_t)mlen - sl; i >= (int64_t)lo; --i) {
+    if (memcmp(meta + i, kSeq, (size_t)sl) == 0) {
+      si = i;
+      break;
+    }
+  }
+  if (si < 0) return 0;
+  for (int64_t i = (int64_t)mlen - nl; i >= (int64_t)lo; --i) {
+    if (memcmp(meta + i, kNonce, (size_t)nl) == 0) {
+      ni = i;
+      break;
+    }
+  }
+  if (ni < 0) return 0;
+  uint64_t i = (uint64_t)(si + sl);
+  while (i < mlen && meta[i] == ' ') ++i;
+  if (i >= mlen || meta[i] < '0' || meta[i] > '9') return 0;
+  uint64_t v = 0;
+  for (; i < mlen && meta[i] >= '0' && meta[i] <= '9'; ++i) {
+    if (v > (~0ull - 9) / 10) return 0;  // implausible: not a token
+    v = v * 10 + (uint64_t)(meta[i] - '0');
+  }
+  *pseq = v;
+  i = (uint64_t)(ni + nl);
+  while (i < mlen && meta[i] == ' ') ++i;
+  if (i >= mlen || meta[i] != '"') return 0;  // null/non-string nonce
+  ++i;
+  int n = 0;
+  while (i < mlen && meta[i] != '"') {
+    // in-tree nonces are short uuid hex — an escape or an over-long
+    // nonce is not one of ours: punt rather than guess
+    if (meta[i] == '\\' || n >= kNlAdmitNonceMax) return 0;
+    nonce[n++] = meta[i++];
+  }
+  if (i >= mlen || n == 0) return 0;
+  return n;
+}
+
+// Owner thread: classify one admissible PUSH frame against the native
+// ledger mirror. Returns 1 when the frame was SERVED natively (replay
+// ack or role refusal written — the caller frees the body and moves
+// on), 2 when it is FRESH (the caller queues it to the pump stamped
+// with *admit_gen — the floor at classification time + 1, which Python
+// trusts only while no apply has landed since), or 0 to PUNT: queue it
+// unstamped, exactly the pre-admission path. The strict-fallback mirror
+// of nl_cache_serve: anything this tier cannot prove takes the pump, so
+// reply bytes stay identical by construction — the templates only ever
+// echo frames Python published.
+int nl_admit_serve(NlLoop* l, NlThread& t, NlConn* c,
+                   uint64_t* admit_gen) {
+  if (c->body_len < 13) return 0;
+  uint32_t worker;
+  memcpy(&worker, c->body + 1, 4);
+  uint64_t pseq = 0;
+  char nonce[kNlAdmitNonceMax];
+  int nlen = nl_admit_token(c->body, c->body_len, &pseq, nonce);
+  std::string reply;     // template copied out under admitmu: the send
+  bool refusal = false;  // happens under the conn's wmu only
+  {
+    std::lock_guard<std::mutex> lock(l->admitmu);
+    if (!l->admit_refusal.empty()) {
+      // role refusal (backup / fenced zombie): every admissible frame
+      // gets the typed ERR the pump would produce, token or not
+      reply = l->admit_refusal;
+      refusal = true;
+    } else if (nlen <= 0) {
+      l->admit_punts.fetch_add(1, std::memory_order_relaxed);
+      return 0;
+    } else {
+      auto it = l->admit.find(worker);
+      if (it == l->admit.end() ||
+          it->second.nonce.size() != (size_t)nlen ||
+          memcmp(it->second.nonce.data(), nonce, (size_t)nlen) != 0) {
+        // unknown worker, or a restarted one (new nonce): the pump's
+        // full ledger is the oracle until the next publish
+        l->admit_punts.fetch_add(1, std::memory_order_relaxed);
+        return 0;
+      }
+      NlAdmitEntry& e = it->second;
+      if (pseq > e.hi) {
+        // fresh: advance the pending bound NOW, so a racing duplicate
+        // of the same seq punts instead of also stamping fresh
+        e.hi = pseq;
+        *admit_gen = l->admit_floor + 1;
+        l->admit_fresh.fetch_add(1, std::memory_order_relaxed);
+        return 2;
+      }
+      if (pseq > e.lo || l->admit_ack.empty()) {
+        // in-window: a seq some key may not have settled yet (stamped
+        // fresh, apply not yet published back) — only the pump's
+        // per-key scan can answer it
+        l->admit_punts.fetch_add(1, std::memory_order_relaxed);
+        return 0;
+      }
+      reply = l->admit_ack;  // pure replay: every key settled <= lo
+    }
+  }
+  // patch the requesting worker's id into the template (reply layout:
+  // [u64 le length][kind u8][worker u32 le]...; templates are validated
+  // >= 13 frame bytes at publish, so offset 9..13 is in bounds)
+  memcpy(&reply[9], &worker, 4);
+  if (!nl_serve_bytes(l, t, c, reply.data(), reply.size())) {
+    // pipelining punt (see nl_serve_bytes): the pump answers it
+    l->admit_punts.fetch_add(1, std::memory_order_relaxed);
+    return 0;
+  }
+  if (refusal)
+    l->admit_refusals.fetch_add(1, std::memory_order_relaxed);
+  else
+    l->admit_acks.fetch_add(1, std::memory_order_relaxed);
+  return 1;
 }
 
 // Owner thread: read everything available on c; queue complete frames.
@@ -1150,6 +1347,33 @@ void nl_read(NlLoop* l, NlThread& t, NlConn* c) {
         continue;
       }
     }
+    uint64_t admit_gen = 0;
+    {
+      int ak = l->admit_kind.load(std::memory_order_relaxed);
+      if (ak >= 0 && c->body_len >= 1
+          && (uint8_t)c->body[0] == (uint8_t)ak) {
+        int rc = nl_admit_serve(l, t, c, &admit_gen);
+        if (rc == 1) {
+          // answered natively (replay ack or role refusal): the frame
+          // never queued, Python never saw it — the zero-upcall push
+          // path, same life cycle as a read-cache hit above
+          if (stats) {
+            uint64_t serve_ns = nl_now_ns() - done_ns;
+            uint64_t thr = l->slow_ns.load(std::memory_order_relaxed);
+            if (thr && read_ns + serve_ns > thr)
+              nl_slow_record(l, c->id, c->body, c->body_len, read_ns, 0,
+                             serve_ns);
+          }
+          // pslint: owns: body -- admission-served frame answered on
+          // the owner thread BEFORE the queue push: still
+          // thread-private, no ownership ever transferred to Python
+          free(c->body);
+          c->body = nullptr;
+          c->body_len = c->body_off = 0;
+          continue;
+        }
+      }
+    }
     uint32_t out;
     {
       std::lock_guard<std::mutex> lock(c->wmu);
@@ -1168,7 +1392,8 @@ void nl_read(NlLoop* l, NlThread& t, NlConn* c) {
       // pslint: transfers: body -- from this push the body is Python's,
       // nl_poll hands it out and ONLY nl_body_free may release it; the
       // UAF gate: any new native free of a body needs an owns: claim
-      l->ready.push_back({c->id, c->body, c->body_len, read_ns, done_ns});
+      l->ready.push_back({c->id, c->body, c->body_len, read_ns, done_ns,
+                          admit_gen});
     }
     l->requests.fetch_add(1, std::memory_order_relaxed);
     l->qcv.notify_one();
@@ -1388,9 +1613,15 @@ void* nl_start(void* listener, int nthreads) {
 // Pump upcall: block (GIL released by ctypes) until >= 1 complete request
 // is ready, then fill the out arrays with up to `cap` of them. Returns the
 // batch size (0 = timeout), or -1 once the loop is stopping AND drained.
-// Each body pointer is owned by the caller until nl_body_free.
-int nl_poll(void* h, uint64_t* conn_ids, void** bodies, uint64_t* lens,
-            int cap, int timeout_ms) {
+// Each body pointer is owned by the caller until nl_body_free. `admits`
+// (nullable — nl_poll passes nullptr for the legacy shape) receives each
+// frame's native admission stamp: 0 = not classified, otherwise the
+// admission floor at classification time + 1 for a frame the owner
+// thread proved FRESH — Python may skip its per-key dedup scan for it
+// only while its _read_gen still equals stamp - 1 (no apply landed in
+// between; see the NlLoop admit members).
+int nl_poll2(void* h, uint64_t* conn_ids, void** bodies, uint64_t* lens,
+             uint64_t* admits, int cap, int timeout_ms) {
   auto* l = static_cast<NlLoop*>(h);
   // claimed entries' telemetry stamps: captured during the pop, recorded
   // AFTER qmu is released (qmu is a hot lock — the histogram math and the
@@ -1421,6 +1652,7 @@ int nl_poll(void* h, uint64_t* conn_ids, void** bodies, uint64_t* lens,
     conn_ids[n] = r.conn_id;
     bodies[n] = r.body;
     lens[n] = r.len;
+    if (admits != nullptr) admits[n] = r.admit_gen;
     tel.emplace_back(r.read_ns, r.ready_ns);
     ++n;
     l->ready.pop_front();
@@ -1446,6 +1678,14 @@ int nl_poll(void* h, uint64_t* conn_ids, void** bodies, uint64_t* lens,
     }
   }
   return n;
+}
+
+// The pre-admission pump upcall shape, kept for drivers that never read
+// admission stamps (sanitizer harness legs, older pumps): exactly
+// nl_poll2 with no admits out-array.
+int nl_poll(void* h, uint64_t* conn_ids, void** bodies, uint64_t* lens,
+            int cap, int timeout_ms) {
+  return nl_poll2(h, conn_ids, bodies, lens, nullptr, cap, timeout_ms);
 }
 
 // Reply to one request: an immediate non-blocking scatter-gather writev of
@@ -2052,6 +2292,147 @@ void nl_cache_stats(void* h, uint64_t* out) {
   out[5] = (uint64_t)l->cache_fifo.size();
   out[6] = l->cache_bytes;
   out[7] = l->cache_floor;
+}
+
+// ---------------------------------------------------------------------------
+// Native push admission ("zero-upcall push plane"): Python mirrors each
+// worker's dedup ledger plus the engine's replay-ack / role-refusal
+// reply frames; the loop classifies PUSH frames on the owner thread —
+// pure replays and refusals answered with zero upcalls, fresh frames
+// stamped and queued. See the NlLoop admit members for the floor
+// contract.
+
+// Arm admission for frames whose FIRST body byte equals `kind` (the
+// wire kind — tv.PUSH or tv.ROW_PUSH); kind < 0 disables and clears the
+// ledger and both templates. Safe at any time; normally called once at
+// service start.
+void nl_admit_config(void* h, int kind) {
+  auto* l = static_cast<NlLoop*>(h);
+  std::lock_guard<std::mutex> lock(l->admitmu);
+  l->admit_kind.store(kind < 0 ? -1 : kind, std::memory_order_relaxed);
+  if (kind < 0) {
+    l->admit.clear();
+    l->admit_ack.clear();
+    l->admit_refusal.clear();
+  }
+}
+
+// Publish one worker's ledger mirror entry: `nonce` its CURRENT push
+// nonce, `lo` the settled bound (every key the worker pushes settled at
+// seq <= lo), `hi` the recorded bound (no recorded seq above hi), `gen`
+// the publish generation captured under the engine lock AFTER the
+// apply's invalidation bump. Returns 1 stored, 0 refused — admission
+// off, gen below the floor (a later apply superseded this snapshot), or
+// a malformed nonce/window. A same-nonce republish keeps the larger
+// lo/hi (frames stamped fresh between the apply and this publish have
+// already advanced the pending bound past the ledger's).
+int nl_admit_put(void* h, uint32_t worker, const void* nonce,
+                 uint64_t nonce_len, uint64_t lo, uint64_t hi,
+                 uint64_t gen) {
+  auto* l = static_cast<NlLoop*>(h);
+  if (nonce == nullptr || nonce_len == 0 ||
+      nonce_len > (uint64_t)kNlAdmitNonceMax || lo > hi)
+    return 0;
+  std::lock_guard<std::mutex> lock(l->admitmu);
+  if (l->admit_kind.load(std::memory_order_relaxed) < 0 ||
+      gen < l->admit_floor)
+    return 0;
+  NlAdmitEntry& e = l->admit[worker];
+  if (e.nonce.size() == nonce_len &&
+      memcmp(e.nonce.data(), nonce, nonce_len) == 0) {
+    if (lo > e.lo) e.lo = lo;
+    if (hi > e.hi) e.hi = hi;
+  } else {
+    e.nonce.assign((const char*)nonce, nonce_len);
+    e.lo = lo;
+    e.hi = hi;
+  }
+  return 1;
+}
+
+// Publish the replay-ack template: the COMPLETE reply frame (no length
+// prefix; prepended here, like nl_cache_put) the pump would send for a
+// full-dedup replay, captured under the engine lock with the version
+// stamp the ledger's `lo` bounds cover. The worker id at frame bytes
+// 1..5 is patched per serve. len == 0 clears. Returns 1 stored, 0
+// refused — gen below the floor (an apply changed the version this
+// template reports) or a frame too short to patch.
+int nl_admit_set_ack(void* h, const void* buf, uint64_t len,
+                     uint64_t gen) {
+  auto* l = static_cast<NlLoop*>(h);
+  std::lock_guard<std::mutex> lock(l->admitmu);
+  if (len == 0) {
+    l->admit_ack.clear();
+    return 1;
+  }
+  if (buf == nullptr || len < 13 || gen < l->admit_floor) return 0;
+  uint64_t len_le = len;
+  l->admit_ack.clear();
+  l->admit_ack.reserve(len + 8);
+  l->admit_ack.append((const char*)&len_le, sizeof(len_le));
+  l->admit_ack.append((const char*)buf, len);
+  return 1;
+}
+
+// Publish (or clear, len == 0) the role-refusal template: the typed ERR
+// every admissible frame gets while this shard must refuse pushes
+// (backup role, fenced zombie). NOT floor-gated — role does not change
+// on applies; promotion re-seeds through nl_admit_reset first.
+int nl_admit_set_refusal(void* h, const void* buf, uint64_t len) {
+  auto* l = static_cast<NlLoop*>(h);
+  std::lock_guard<std::mutex> lock(l->admitmu);
+  if (len == 0) {
+    l->admit_refusal.clear();
+    return 1;
+  }
+  if (buf == nullptr || len < 13) return 0;
+  uint64_t len_le = len;
+  l->admit_refusal.clear();
+  l->admit_refusal.reserve(len + 8);
+  l->admit_refusal.append((const char*)&len_le, sizeof(len_le));
+  l->admit_refusal.append((const char*)buf, len);
+  return 1;
+}
+
+// Invalidation-on-apply (the push twin of nl_cache_invalidate): raise
+// the floor to `gen` and drop the version-stamped ack template. The
+// LEDGER persists — its bounds only ever advance, so a stale entry is
+// conservative (it punts frames a fresher mirror would ack, never the
+// reverse), while dropping it would punt EVERY frame until the next
+// publish.
+void nl_admit_invalidate(void* h, uint64_t gen) {
+  auto* l = static_cast<NlLoop*>(h);
+  std::lock_guard<std::mutex> lock(l->admitmu);
+  if (gen > l->admit_floor) l->admit_floor = gen;
+  l->admit_ack.clear();
+}
+
+// Structural re-seed (promotion, fence, migrate, pause/resume): raise
+// the floor and drop the ledger AND both templates. The caller
+// republishes whatever the new role/state allows.
+void nl_admit_reset(void* h, uint64_t gen) {
+  auto* l = static_cast<NlLoop*>(h);
+  std::lock_guard<std::mutex> lock(l->admitmu);
+  if (gen > l->admit_floor) l->admit_floor = gen;
+  l->admit.clear();
+  l->admit_ack.clear();
+  l->admit_refusal.clear();
+}
+
+// out[8]: acks (native replay OKs), refusals (native typed ERRs), fresh
+// (frames stamped + queued), punts (admissible frames the pump had to
+// classify), ledger entries, floor, ack armed, refusal armed.
+void nl_admit_stats(void* h, uint64_t* out) {
+  auto* l = static_cast<NlLoop*>(h);
+  out[0] = l->admit_acks.load(std::memory_order_relaxed);
+  out[1] = l->admit_refusals.load(std::memory_order_relaxed);
+  out[2] = l->admit_fresh.load(std::memory_order_relaxed);
+  out[3] = l->admit_punts.load(std::memory_order_relaxed);
+  std::lock_guard<std::mutex> lock(l->admitmu);
+  out[4] = (uint64_t)l->admit.size();
+  out[5] = l->admit_floor;
+  out[6] = l->admit_ack.empty() ? 0 : 1;
+  out[7] = l->admit_refusal.empty() ? 0 : 1;
 }
 
 }  // extern "C"

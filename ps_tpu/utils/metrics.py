@@ -240,6 +240,17 @@ class TransportStats:
         self.read_coalesced = 0
         self.reads_replica = 0
         self.read_fallbacks = 0
+        # zero-upcall push plane (README "Push path"): the native
+        # admission mirror's counters, absolute values synced from
+        # nl_admit_stats on the pump's gauge tick — the loop owns the
+        # counting. acks = pure replays acked natively, refusals = role
+        # refusals answered natively, fresh = frames admission-stamped
+        # for the pump's apply, punts = classifiable push frames that
+        # fell through to the pump unclassified.
+        self.push_native_acks = 0      # synced absolute
+        self.push_native_refusals = 0  # synced absolute
+        self.push_native_fresh = 0     # synced absolute
+        self.push_native_punts = 0     # synced absolute
 
     def record_vec_send(self, nbytes: int) -> None:
         """One vectored (scatter-gather) send: ``nbytes`` of tensor payload
@@ -382,6 +393,17 @@ class TransportStats:
             self.read_native_misses = int(misses)
             self.read_cache_entries = int(entries)
             self.read_cache_bytes = int(nbytes)
+
+    def set_admit_stats(self, acks: int, refusals: int, fresh: int,
+                        punts: int) -> None:
+        """Sync the native push-admission mirror's counters (absolute
+        values — the native side owns the counting, like
+        set_read_cache_stats)."""
+        with self._lock:
+            self.push_native_acks = int(acks)
+            self.push_native_refusals = int(refusals)
+            self.push_native_fresh = int(fresh)
+            self.push_native_punts = int(punts)
 
     def record_read_cache(self, hit: bool) -> None:
         """Worker side: one read served from the local parameter cache

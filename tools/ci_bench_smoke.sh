@@ -3,7 +3,9 @@
 # tools/ci_tier1.sh:
 #   1. transport: `bench.py --model transport --quick` — asserts BOTH the
 #      bucketed-TCP lane and the same-host shared-memory lane move data,
-#      printing the per-lane GB/s.
+#      printing the per-lane GB/s — and the zero-upcall push-admission
+#      A/B: byte-identical final params and a pushes/s win at N=8
+#      replaying workers with native admission on vs off.
 #   2. failover: `bench.py --model failover --quick` — spawns a
 #      primary+backup pair, severs the primary (SIGKILL-equivalent),
 #      asserts the heartbeat-triggered promotion completed and the worker's
@@ -95,6 +97,26 @@ print(f"  agg drill: bytes/step {ag['flat_bytes_per_step']} -> "
       f"{ag['overlap_efficiency']}; flush-wait share "
       f"{ag['flat_flush_wait_share']} -> {ag['flush_wait_share']}; "
       f"wall {ag['flat_wall_s']}s -> {ag['wall_s']}s")
+# zero-upcall push admission A/B (README "Push path"): byte-identical
+# applied state is a HARD gate — the native tier must ack replays and
+# refuse roles without ever changing what applies; the pushes/s win at
+# N=8 replaying workers is the perf acceptance (the CI bar leaves
+# 2-core scheduler-noise room under the measured ~1.8x)
+pp = det["push_plane"]
+assert pp["params_match"], \
+    (f"admission on/off final params diverged: {pp['digest_off']} vs "
+     f"{pp['digest_on']}")
+assert pp["replay_acked"]["on"] == pp["replay_acked"]["off"], \
+    f"replay acks diverged across the A/B: {pp['replay_acked']}"
+assert pp["native_admit_share"] and pp["native_admit_share"] > 0.5, \
+    f"native admission barely classifying: {pp['native_admit_share']}"
+assert pp["speedup"] and pp["speedup"] > 1.05, \
+    f"no pushes/s win from native admission: {pp['speedup']}x"
+print(f"  push plane (N={pp['workers']}): "
+      f"{pp['pushes_per_s']['off']} -> {pp['pushes_per_s']['on']} "
+      f"pushes/s ({pp['speedup']}x), p99 "
+      f"{pp['push_p99_us']['off']} -> {pp['push_p99_us']['on']} us, "
+      f"native share {pp['native_admit_share']}, params bitwise-equal")
 print("transport smoke OK")
 EOF
 

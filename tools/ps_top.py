@@ -55,7 +55,8 @@ COLS = [
     ("epoch", 5), ("version", 9),
     ("applies", 9), ("lag", 5), ("repl", 14), ("dedup", 6), ("stale", 6),
     ("moved", 8), ("gbps", 7), ("ack_p99_ms", 10), ("bkt_p99_ms", 10),
-    ("loop", 10), ("nlp99", 8), ("qw99", 8), ("reads", 8), ("nhit%", 6),
+    ("loop", 10), ("nlp99", 8), ("qw99", 8), ("padm%", 6), ("reads", 8),
+    ("nhit%", 6),
     ("chit%", 6), ("rshare%", 7), ("tier", 6), ("rows", 9), ("sap99", 8),
 ]
 
@@ -133,7 +134,7 @@ def render_row(st: dict) -> dict:
                 "applies": "-", "lag": "-", "repl": st["error"][:24],
                 "dedup": "-", "stale": "-", "moved": "-", "gbps": "-",
                 "ack_p99_ms": "-", "bkt_p99_ms": "-", "loop": "-",
-                "nlp99": "-", "qw99": "-",
+                "nlp99": "-", "qw99": "-", "padm%": "-",
                 "reads": "-", "nhit%": "-", "chit%": "-",
                 "rshare%": "-", "tier": "-", "rows": "-", "sap99": "-"}
     repl = st.get("repl") or {}
@@ -181,6 +182,10 @@ def render_row(st: dict) -> dict:
         # the ready-queue wait pump-bound frames pay before dispatch
         "nlp99": _loop_us(st, "nlp99_us"),
         "qw99": _loop_us(st, "qw99_us"),
+        # zero-upcall push plane (README "Push path"): the share of
+        # classified push frames the native admission mirror settled
+        # without an upcall (replay acks + role refusals)
+        "padm%": _admit_pct(st),
         # serve-path read columns (README "Read path"): total READs this
         # endpoint answered (native hits + Python-served) and the
         # native-cache hit share. Backups answering reads show up as
@@ -223,6 +228,19 @@ def _loop_us(st: dict, key: str):
     if not isinstance(loop, dict) or loop.get(key) is None:
         return "-"
     return f"{loop[key]:.0f}u"
+
+
+def _admit_pct(st: dict):
+    """Native push-admission share: frames the loop's ledger mirror
+    settled with zero upcalls (replay acks + role refusals) over every
+    push frame it classified ("-" = admission off / no pushes yet)."""
+    loop = st.get("loop")
+    padm = loop.get("padm") if isinstance(loop, dict) else None
+    if not isinstance(padm, dict):
+        return "-"
+    native = int(padm.get("acks", 0)) + int(padm.get("refusals", 0))
+    total = native + int(padm.get("fresh", 0)) + int(padm.get("punts", 0))
+    return round(100.0 * native / total, 1) if total else "-"
 
 
 def _reads_total(st: dict):

@@ -73,6 +73,17 @@ void nl_cache_invalidate(void* h, uint64_t gen);
 void nl_cache_invalidate_tags(void* h, uint64_t gen, const uint64_t* tags,
                               int ntags);
 void nl_cache_stats(void* h, uint64_t* out);
+int nl_poll2(void* h, uint64_t* conn_ids, void** bodies, uint64_t* lens,
+             uint64_t* admits, int cap, int timeout_ms);
+void nl_admit_config(void* h, int kind);
+int nl_admit_put(void* h, uint32_t worker, const void* nonce,
+                 uint64_t nonce_len, uint64_t lo, uint64_t hi,
+                 uint64_t gen);
+int nl_admit_set_ack(void* h, const void* buf, uint64_t len, uint64_t gen);
+int nl_admit_set_refusal(void* h, const void* buf, uint64_t len);
+void nl_admit_invalidate(void* h, uint64_t gen);
+void nl_admit_reset(void* h, uint64_t gen);
+void nl_admit_stats(void* h, uint64_t* out);
 void nl_telemetry_config(void* h, int stats_on, uint64_t slow_frame_ns);
 int nl_hist_snapshot(void* h, int which, uint64_t* out);
 void nl_stats_snapshot(void* h, uint64_t* out);
@@ -695,6 +706,213 @@ int main() {
                 (unsigned long long)frames_counted,
                 (unsigned long long)hits_counted,
                 (unsigned long long)nlst[3], drained);
+  }
+
+  // --- native push admission (nl_admit_*): admission churn — loop
+  // threads classifying concurrent replays + fresh pushes (nl_poll2
+  // stamping), a promoter thread re-seeding the ledger wholesale
+  // (nl_admit_reset + republish + re-arm, with refusal-armed windows —
+  // the backup/fenced phases), an applier raising the invalidation
+  // floor and re-arming the ack template on a tight cadence (the
+  // per-apply publish shape), and a stats thread hammering
+  // nl_admit_stats — while clients verify every reply is either the
+  // pump echo (punt/fresh) or an armed template with THEIR worker id
+  // patched in.
+  {
+    void* alst = tv_listen("127.0.0.1", 0, 64);
+    if (!alst) { std::fprintf(stderr, "admit listen failed\n"); return 1; }
+    void* loop = nl_start(alst, 2);
+    if (!loop) { std::fprintf(stderr, "admit nl_start failed\n"); return 1; }
+    const uint8_t kPushKind = 0x02;
+    nl_admit_config(loop, kPushKind);
+    int aport = tv_listener_port(alst);
+
+    // push frame: [kind u8][worker u32 le][meta_len u64 le][meta json];
+    // the dedup token rides the meta TAIL, exactly where the encoder
+    // puts `extra` (the last top-level key)
+    auto mkpush = [&](uint32_t w, uint64_t seq, const char* nonce,
+                      bool tokened) {
+      char meta[160];
+      int mlen = tokened
+          ? std::snprintf(meta, sizeof(meta),
+                          "{\"tensors\": [], \"extra\": {\"pseq\": %llu, "
+                          "\"pnonce\": \"%s\"}}",
+                          (unsigned long long)seq, nonce)
+          : std::snprintf(meta, sizeof(meta),
+                          "{\"tensors\": [], \"extra\": {}}");
+      std::vector<char> f(13 + (size_t)mlen);
+      f[0] = (char)kPushKind;
+      std::memcpy(f.data() + 1, &w, 4);
+      uint64_t ml = (uint64_t)mlen;
+      std::memcpy(f.data() + 5, &ml, 8);
+      std::memcpy(f.data() + 13, meta, (size_t)mlen);
+      return f;
+    };
+    // reply templates (worker 0; the loop patches bytes 1..5 per serve)
+    auto mktmpl = [&](uint8_t kind) {
+      const char* meta = "{\"tensors\": [], \"extra\": {\"dedup\": true}}";
+      uint64_t ml = std::strlen(meta);
+      std::vector<char> f(13 + (size_t)ml);
+      f[0] = (char)kind;
+      uint32_t w0 = 0;
+      std::memcpy(f.data() + 1, &w0, 4);
+      std::memcpy(f.data() + 5, &ml, 8);
+      std::memcpy(f.data() + 13, meta, (size_t)ml);
+      return f;
+    };
+    std::vector<char> acktmpl = mktmpl(0x06);
+    std::vector<char> reftmpl = mktmpl(0x07);
+
+    std::atomic<bool> astop{false};
+    std::atomic<uint64_t> agen{1};
+    // seed: 4 workers settled at (nonce "n0", lo=hi=5), ack armed
+    for (uint32_t w = 0; w < 4; ++w)
+      nl_admit_put(loop, w, "n0", 2, 5, 5, 1);
+    nl_admit_set_ack(loop, acktmpl.data(), acktmpl.size(), 1);
+
+    std::thread promoter([&] {  // structural reseed churn + role flips
+      int round = 0;
+      while (!astop.load()) {
+        uint64_t g = agen.fetch_add(1) + 1;
+        nl_admit_reset(loop, g);
+        if (++round % 4 == 0) {
+          // a backup/fenced window: every admissible frame refused
+          nl_admit_set_refusal(loop, reftmpl.data(), reftmpl.size());
+          sleep_ms(1);
+          uint64_t g2 = agen.fetch_add(1) + 1;
+          nl_admit_reset(loop, g2);  // promotion clears the refusal
+          g = g2;
+        }
+        for (uint32_t w = 0; w < 4; ++w)
+          nl_admit_put(loop, w, "n0", 2, 5, 5, g);
+        nl_admit_set_ack(loop, acktmpl.data(), acktmpl.size(), g);
+        sleep_ms(2);
+      }
+    });
+    std::thread applier([&] {  // invalidation-on-apply + republish
+      while (!astop.load()) {
+        uint64_t g = agen.fetch_add(1) + 1;
+        nl_admit_invalidate(loop, g);
+        nl_admit_put(loop, 0, "n0", 2, 5, 5, g);
+        nl_admit_set_ack(loop, acktmpl.data(), acktmpl.size(), g);
+        sleep_ms(1);
+      }
+    });
+    std::thread astats([&] {
+      uint64_t out[8];
+      while (!astop.load()) {
+        nl_admit_stats(loop, out);
+        sleep_ms(1);
+      }
+    });
+    std::atomic<uint64_t> stamped{0};
+    std::thread apump([&] {  // echo everything the admission tier punts
+      uint64_t ids[16];
+      void* bodies[16];
+      uint64_t lens[16];
+      uint64_t admits[16];
+      while (true) {
+        int n = nl_poll2(loop, ids, bodies, lens, admits, 16, 50);
+        if (n < 0) break;
+        for (int i = 0; i < n; ++i) {
+          if (admits[i] != 0) stamped.fetch_add(1);
+          const void* bufs[1] = {bodies[i]};
+          uint64_t ls[1] = {lens[i]};
+          nl_reply_vec(loop, ids[i], bufs, ls, 1, 0, 0);
+          nl_body_free(loop, bodies[i]);
+        }
+      }
+    });
+    std::vector<std::thread> acls;
+    std::atomic<int> aok{0};
+    std::atomic<int> anative{0};
+    for (uint32_t w = 0; w < 4; ++w) {
+      acls.emplace_back([&, w] {
+        void* ch = tv_connect("127.0.0.1", aport, 2000);
+        if (!ch) return;
+        uint64_t fresh_seq = 10;
+        for (int r = 0; r < 150; ++r) {
+          // mix: pure replays (seq 3 <= lo), strictly-fresh seqs,
+          // tokenless pushes (always punt), and an unknown nonce
+          std::vector<char> req =
+              r % 5 == 0 ? mkpush(w, ++fresh_seq, "n0", true)
+              : r % 7 == 0 ? mkpush(w, 3, "zz", true)
+              : r % 11 == 0 ? mkpush(w, 3, "n0", false)
+              : mkpush(w, 3, "n0", true);
+          if (!tv_send(ch, req.data(), req.size())) break;
+          int64_t n = tv_recv_size(ch);
+          if (n <= 0) break;
+          std::vector<char> back((size_t)n);
+          if (!tv_recv_into(ch, back.data(), (uint64_t)n)) break;
+          if (back == req) {  // pump echo: punted or stamped-fresh
+            aok.fetch_add(1);
+            continue;
+          }
+          // native template: ack or refusal, worker id patched to OURS
+          uint32_t rw = 0;
+          if ((size_t)n >= 13) std::memcpy(&rw, back.data() + 1, 4);
+          if ((back[0] == 0x06 || back[0] == 0x07) && rw == w) {
+            aok.fetch_add(1);
+            anative.fetch_add(1);
+          }
+        }
+        tv_close(ch);
+      });
+    }
+    for (auto& t : acls) t.join();
+    astop.store(true);
+    promoter.join();
+    applier.join();
+    astats.join();
+    // ABI edge cases: malformed publishes refused, never crash
+    uint64_t gnow = agen.load() + 100;
+    if (nl_admit_put(loop, 9, "n0", 2, 7, 5, gnow) != 0) {  // lo > hi
+      std::fprintf(stderr, "inverted admit window accepted\n");
+      return 1;
+    }
+    if (nl_admit_put(loop, 9, "n0", 0, 5, 5, gnow) != 0) {  // empty nonce
+      std::fprintf(stderr, "empty admit nonce accepted\n");
+      return 1;
+    }
+    if (nl_admit_set_ack(loop, acktmpl.data(), 5, gnow) != 0) {
+      std::fprintf(stderr, "short ack template accepted\n");
+      return 1;
+    }
+    if (nl_admit_set_ack(loop, acktmpl.data(), acktmpl.size(), 0) != 0) {
+      std::fprintf(stderr, "ack template below the floor accepted\n");
+      return 1;
+    }
+    nl_admit_config(loop, -1);  // disable clears everything
+    if (nl_admit_put(loop, 0, "n0", 2, 5, 5, gnow) != 0) {
+      std::fprintf(stderr, "disabled admission accepted a put\n");
+      return 1;
+    }
+    uint64_t as[8];
+    nl_admit_stats(loop, as);
+    nl_stop_accept(loop);
+    nl_shutdown_conns(loop);
+    nl_begin_stop(loop);
+    apump.join();
+    nl_stop(loop);
+    tv_listener_close(alst);
+    if (aok.load() < 400) {
+      std::fprintf(stderr, "admit churn: only %d/600 round trips\n",
+                   aok.load());
+      return 1;
+    }
+    if (as[0] == 0 || as[2] == 0 || as[3] == 0) {
+      std::fprintf(stderr,
+                   "admit churn never exercised acks/fresh/punts: "
+                   "a=%llu f=%llu p=%llu\n", (unsigned long long)as[0],
+                   (unsigned long long)as[2], (unsigned long long)as[3]);
+      return 1;
+    }
+    std::printf("nl admission churn: OK (%d ok, %d native, %llu stamped; "
+                "acks=%llu refusals=%llu fresh=%llu punts=%llu)\n",
+                aok.load(), anative.load(),
+                (unsigned long long)stamped.load(),
+                (unsigned long long)as[0], (unsigned long long)as[1],
+                (unsigned long long)as[2], (unsigned long long)as[3]);
   }
 
   std::printf("tsan van driver: OK\n");
