@@ -1980,11 +1980,13 @@ def bench_sparse_apply(args, retried: bool):
     dev = jax.devices()[0]
     ndev = len(jax.devices())
     on_tpu = dev.platform == "tpu"
-    # table = 256x the push id-set: comfortably inside the >=100x regime
-    # the acceptance bar names (and item 3's hot-tier regime)
+    # table = --table-mult x the push id-set (default 256: comfortably
+    # inside the >=100x regime the acceptance bar names, and item 3's
+    # hot-tier regime); the flag lets this leg and the tiered leg sweep
+    # the same table/batch shapes
     vocab = (1 << 18) if on_tpu else (1 << 17)
     dim = 64 if on_tpu else 32
-    batch = vocab // 256
+    batch = max(1, vocab // args.table_mult)
     steps = 50 if on_tpu else (20 if args.quick else 40)
     fast = resolve_tier(None)  # the platform's fast tier
 
@@ -2026,6 +2028,7 @@ def bench_sparse_apply(args, retried: bool):
             "table_rows": vocab,
             "embed_dim": dim,
             "batch_ids": batch,
+            "table_mult": args.table_mult,
             "table_to_batch_x": vocab // batch,
             "rows_applied_per_s": {"off": round(rows_off, 1),
                                    fast: round(rows_fast, 1)},
@@ -2045,7 +2048,134 @@ def bench_sparse_apply(args, retried: bool):
             "gather->apply->scatter (ps_tpu/ops/sparse_apply.py); "
             "hbm_bytes_per_apply is the analytic lower-bound model of "
             "both designs, speedup_x the measured rows/s ratio at a "
-            "table 256x the push id-set (detail.table_to_batch_x)"
+            "table --table-mult x the push id-set "
+            "(detail.table_to_batch_x)"
+        ),
+    )
+
+
+def bench_tiered(args, retried: bool):
+    """Tiered embedding storage A/B (ROADMAP item 1; README "Tiered
+    embedding storage"): one Wide-&-Deep-shaped zipf push/read stream
+    against a TieredTable whose logical row count is 4x its device
+    budget, vs the identical stream against an untiered (all-hot)
+    SparseEmbedding of the full table. Reports the throughput ratio,
+    hot-hit rate, and promotion/eviction churn per 1k pushes; asserts
+    the two non-negotiables in-process — the ALL-HOT path is bitwise-
+    identical to an untiered table on the same id stream, and zero rows
+    are lost across admission/eviction churn (row-sum conservation)."""
+    import numpy as np
+
+    from ps_tpu.kv.sparse import SparseEmbedding
+    from ps_tpu.kv.tiered import TieredTable
+
+    dev = jax.devices()[0]
+    ndev = len(jax.devices())
+    on_tpu = dev.platform == "tpu"
+    vocab = (1 << 16) if on_tpu else ((1 << 13) if args.quick else 1 << 14)
+    dim = 64 if on_tpu else 32
+    budget = vocab // 4  # the acceptance shape: table = 4x the budget
+    batch = max(1, vocab // args.table_mult)
+    steps = 60 if on_tpu else (24 if args.quick else 48)
+
+    ps.init(backend="tpu")
+    rng = np.random.default_rng(0)
+    # Wide-&-Deep-shaped stream: zipf-skewed ids (a small hot set takes
+    # most touches — the regime tiering exists for), dense-ish grads
+    ids_seq = [(rng.zipf(1.3, size=batch) % vocab).astype(np.int32)
+               for _ in range(8)]
+    grads_seq = [(rng.normal(size=(batch, dim)) * 0.01).astype(np.float32)
+                 for _ in range(8)]
+
+    def run_stream(emb):
+        for i in range(16):  # warmup: two passes over every id set, so
+            # the apply wrappers compile for each cold-slab and
+            # move-batch size bucket the stream produces (tier
+            # placement shifts between the passes) before the timer
+            emb.push(ids_seq[i % 8], grads_seq[i % 8])
+        jax.block_until_ready(emb.table)
+        t0 = time.time()
+        for i in range(steps):
+            emb.push(ids_seq[i % 8], grads_seq[i % 8])
+            if i % 4 == 3:  # the serving read leg of the W&D stream
+                emb.pull(ids_seq[i % 8][: batch // 4])
+        jax.block_until_ready(emb.table)
+        return steps * batch / max(time.time() - t0, 1e-9)
+
+    full = np.asarray(0.01 * jax.random.normal(
+        jax.random.key(0), (vocab, dim), jnp.float32))
+    allhot = SparseEmbedding(vocab, dim, optimizer="adagrad",
+                             learning_rate=0.05)
+    allhot.init(full.copy())
+    tiered = TieredTable(vocab, dim, optimizer="adagrad",
+                         learning_rate=0.05, device_rows=budget,
+                         admit_freq=2)
+    tiered.init(full.copy())
+    rows_allhot = run_stream(allhot)
+    rows_tiered = run_stream(tiered)
+    st = tiered.tier_stats()
+    per_1k = 1000.0 / max(tiered.push_count, 1)
+
+    # conservation: churn moved rows between tiers; none may be lost.
+    # The untiered run IS the oracle — every logical row must hold the
+    # value the all-on-device run computed from the identical stream.
+    t_ref = np.asarray(allhot.table).astype(np.float64)
+    rowsum_ref = float(t_ref.sum())
+    rowsum_tiered = tiered.row_sum()
+    conserved = bool(np.isclose(rowsum_tiered, rowsum_ref,
+                                rtol=1e-9, atol=1e-6))
+
+    # all-hot-path parity: a stream confined to the resident hot set
+    # (admission never fires) must leave the device tier bitwise-equal
+    # to an untiered table of the same rows on the same stream
+    hot_ids = [(rng.integers(0, budget, size=batch)).astype(np.int32)
+               for _ in range(4)]
+    t2 = TieredTable(vocab, dim, optimizer="adagrad", learning_rate=0.05,
+                     device_rows=budget, admit_freq=1 << 30)
+    t2.init(full.copy())
+    u2 = SparseEmbedding(budget, dim, optimizer="adagrad",
+                         learning_rate=0.05)
+    u2.init(full[:budget].copy())
+    for i in range(8):
+        t2.push(hot_ids[i % 4], grads_seq[i % 4])
+        u2.push(hot_ids[i % 4], grads_seq[i % 4])
+    allhot_bitwise = bool(np.array_equal(np.asarray(t2.hot.table),
+                                         np.asarray(u2.table)))
+
+    ratio = round(rows_tiered / max(rows_allhot, 1e-9), 3)
+    _emit(
+        "tiered_rows_applied_per_s", rows_tiered / ndev, "rows/sec/chip",
+        ndev=ndev, dev=dev, batch_size=batch, timed_steps=steps,
+        rep_times=None, retried=retried, input_mode="preplaced",
+        loss=None, flops=None, flops_src=None,
+        dt=steps * batch / max(rows_tiered, 1e-9), summary=None,
+        extra_detail={
+            "table_rows": vocab,
+            "device_rows": budget,
+            "table_to_budget_x": vocab // budget,
+            "embed_dim": dim,
+            "batch_ids": batch,
+            "table_mult": args.table_mult,
+            "rows_applied_per_s": {"allhot": round(rows_allhot, 1),
+                                   "tiered": round(rows_tiered, 1)},
+            "throughput_ratio": ratio,
+            "hot_hit_rate": st["hit_rate"],
+            "promotions_per_1k": round(st["promotions"] * per_1k, 1),
+            "evictions_per_1k": round(st["evictions"] * per_1k, 1),
+            "allhot_parity_bitwise": allhot_bitwise,
+            "rowsum_conserved": conserved,
+            "rowsum_rel_err": float(abs(rowsum_tiered - rowsum_ref)
+                                    / max(abs(rowsum_ref), 1e-12)),
+        },
+        note=(
+            "in-process TieredTable vs untiered SparseEmbedding on the "
+            "identical zipf (Wide-&-Deep-shaped) push/read stream, table "
+            "4x the device budget; throughput_ratio is tiered/all-hot "
+            "rows/s (ROADMAP's >=70% is the TPU hardware acceptance — "
+            "the host-scaled CI floor lives in tools/ci_bench_smoke.sh), "
+            "allhot_parity_bitwise the non-negotiable hot-path check, "
+            "rowsum_conserved the zero-rows-lost churn audit against "
+            "the untiered oracle"
         ),
     )
 
@@ -2055,7 +2185,7 @@ def main(argv=None, retried: bool = False):
     ap.add_argument("--model", default="resnet",
                     choices=["resnet", "bert", "widedeep", "transport",
                              "failover", "rebalance", "serve",
-                             "sparse_apply"])
+                             "sparse_apply", "tiered"])
     ap.add_argument("--steps", type=int, default=20)
     ap.add_argument("--transport-mb", type=float, default=96.0,
                     help="(transport) parameter-tree size for the van "
@@ -2096,6 +2226,11 @@ def main(argv=None, retried: bool = False):
                     help="(bert) attention op; 'flash' is the Pallas "
                          "kernel — the memory regime's choice, see "
                          "BASELINE.md")
+    ap.add_argument("--table-mult", type=int, default=256,
+                    help="(sparse_apply, tiered) table rows as a "
+                         "multiple of the push id-set — both sparse "
+                         "legs sweep the same table/batch shapes "
+                         "(recorded in BENCH detail.table_mult)")
     ap.add_argument("--streaming", action="store_true",
                     help="(resnet) feed steps through the host->device "
                          "prefetch instead of cycling pre-placed batches")
@@ -2104,7 +2239,8 @@ def main(argv=None, retried: bool = False):
         args.per_chip_batch = {"resnet": 256, "bert": 128,
                                "widedeep": 4096, "transport": 0,
                                "failover": 0, "rebalance": 0,
-                               "serve": 0, "sparse_apply": 0}[args.model]
+                               "serve": 0, "sparse_apply": 0,
+                               "tiered": 0}[args.model]
 
     if ps.is_initialized():  # retry path: reset the runtime
         ps.shutdown()
@@ -2117,7 +2253,8 @@ def main(argv=None, retried: bool = False):
      "failover": bench_failover,
      "rebalance": bench_rebalance,
      "serve": bench_serve,
-     "sparse_apply": bench_sparse_apply}[args.model](args, retried)
+     "sparse_apply": bench_sparse_apply,
+     "tiered": bench_tiered}[args.model](args, retried)
 
 
 def _is_transport_error(e: BaseException) -> bool:

@@ -362,6 +362,14 @@ class SparsePSService(VanService):
         if not todo:
             # push_pull with no rows for this server: nothing applied
             return None, False
+        # tiered prefetch (README "Tiered embedding storage"): stage the
+        # cold-tier DRAM gather BEFORE taking the apply lock, so it
+        # overlaps whatever apply currently holds it — the generation
+        # tag discards the slab if that apply moves rows first
+        for name, ids, _g in todo:
+            pf = getattr(self._tables[name], "prefetch", None)
+            if pf is not None:
+                pf(ids)
         # per-step breakdown phase tagging (ps_tpu/obs/breakdown.py):
         # the apply — lock wait included — lands in the always-on
         # ps_server_apply_seconds histogram; a traced request also gets
@@ -410,13 +418,22 @@ class SparsePSService(VanService):
             self.transport.record_sparse_apply(
                 rows, _ptime.perf_counter() - t_rows)
             self._rows_counter.inc(rows)
+            # tiered tables: harvest this push's admission/eviction log
+            # for the replication stream (the backup replays it verbatim
+            # — tier placement is part of the replicated state) and feed
+            # the cold-path latency into its histogram family
+            tier_moves = self._pop_tier_moves(todo)
             # invalidation-on-apply (README "Read path"), PER KEY: only
             # cached id-sets intersecting the applied rows drop (their
             # bytes changed); disjoint hot sets keep serving natively.
             # The generation floor still rises for everyone, so an
-            # in-flight pre-apply publish is refused either way.
+            # in-flight pre-apply publish is refused either way. A tier
+            # move IS a state change: rows it touched beyond the push's
+            # own id-set (TTL/CLOCK demotion victims) join the tag set.
             self._invalidate_reads(
-                tags=self._tags_for(per_table, APPLY_TAG_CAP))
+                tags=self._move_tags(
+                    self._tags_for(per_table, APPLY_TAG_CAP),
+                    tier_moves))
             apply_s = _ptime.perf_counter() - t_apply
             if pseq is not None:
                 self._applied_pseq[worker] = (pnonce, int(pseq),
@@ -430,6 +447,7 @@ class SparsePSService(VanService):
                 self.apply_log.append(worker)
             rseq = self._replicate("push", worker, wire, {  # pslint: disable=PSL101 -- deliberate backpressure: a full ack window MUST stall commits under the apply lock (that IS the bounded-lag contract), and stall_timeout degrades a corpse instead of wedging
                 "pseq": pseq, "pnonce": pnonce, "pfan": pfan,
+                "tier_moves": tier_moves or None,
             })
         if apply_s is not None:
             self.transport.record_apply(apply_s)
@@ -536,6 +554,44 @@ class SparsePSService(VanService):
                 # shrinks), kept as the hard bound
         return sorted(tags) if tags else None
 
+    def _pop_tier_moves(self, todo) -> Dict[str, dict]:
+        """Harvest tiered tables' admission/eviction logs for this push
+        (README "Tiered embedding storage") and drain their cold-path
+        latencies into ``ps_embed_cold_gather_seconds``. Empty logs are
+        dropped from the wire — the backup replays an empty log for an
+        absent entry, it NEVER plans moves of its own."""
+        tier_moves: Dict[str, dict] = {}
+        for name, _ids, _g in todo:
+            emb = self._tables[name]
+            pop = getattr(emb, "pop_moves", None)
+            if pop is None:
+                continue
+            mv = pop()
+            if mv.get("ops"):
+                tier_moves[name] = mv
+            for s in emb.drain_cold_gather():
+                self.transport.record_cold_gather(s)
+        return tier_moves
+
+    def _move_tags(self, tags, tier_moves: Dict[str, dict]):
+        """Union apply tags with the tags of rows a tier move touched —
+        TTL/CLOCK demotion victims are OUTSIDE the push's id-set, and a
+        cached read pinned to them must drop like any other applied row.
+        ``tags`` None (already degraded to full invalidation) stays
+        None; past the cap the union degrades the same way."""
+        if tags is None or not tier_moves:
+            return tags
+        out = set(tags)
+        for name, mv in tier_moves.items():
+            lo = self._meta[name]["lo"]
+            moved = np.asarray([rid for kind, rid, _s in mv["ops"]
+                                if kind != "r"], np.int64) + lo
+            if moved.size:
+                out |= _row_tags(self._tbl_hash(name), moved)
+            if len(out) > APPLY_TAG_CAP:
+                return None
+        return sorted(out)
+
     @staticmethod
     def _vsum(versions) -> int:
         return int(sum(int(v) for v in versions.values()))
@@ -612,6 +668,14 @@ class SparsePSService(VanService):
                 "fused": {
                     "tiers": dict(self.fused_tiers),
                     "rows_applied": sum(self.rows_applied.values()),
+                },
+                # tiered-storage view (README "Tiered embedding
+                # storage"): per-table hot-set residency, hit rate and
+                # promotion/eviction churn — ps_top's hot%/evict columns
+                "tier": {
+                    n: emb.tier_stats()
+                    for n, emb in self._tables.items()
+                    if hasattr(emb, "tier_stats")
                 },
                 "apply_log": log,
                 "apply_log_total": log_total,
@@ -795,12 +859,25 @@ class SparsePSService(VanService):
         tree = decode_tree(dict(tensors), extra.get("enc"),
                            stats=self.transport)
         split = self._split(tree)
+        moves = extra.get("tier_moves") or {}
         t_rows = _ptime.perf_counter()
         rows = 0
         for name, t in split.items():
             ids = self._localize(name, np.array(t["ids"]))
             grads = np.array(t["grads"])  # own memory past the frame
-            self._tables[name].push(ids, grads)
+            emb = self._tables[name]
+            if hasattr(emb, "pop_moves"):
+                # tiered table: REPLAY the primary's recorded
+                # admission/eviction log verbatim (an absent entry is an
+                # empty log) — the backup never plans moves itself, so
+                # its directory stays bitwise-equal to the primary's and
+                # a promoted backup's fused applies cannot diverge
+                emb.push(ids, grads,
+                         moves=moves.get(name) or {"ops": [],
+                                                   "hand": None})
+                emb.pop_moves()  # a backup replicates nowhere further
+            else:
+                emb.push(ids, grads)
             self.versions[name] += 1
             self.rows_applied[name] += int(ids.size)
             rows += int(ids.size)
@@ -811,9 +888,16 @@ class SparsePSService(VanService):
         self.transport.record_sparse_apply(
             rows, _ptime.perf_counter() - t_rows)
         self._rows_counter.inc(rows)
+        for name in split:
+            drain = getattr(self._tables[name], "drain_cold_gather", None)
+            if drain is not None:
+                for s in drain():
+                    self.transport.record_cold_gather(s)
         # per-key, like the primary's apply: a backup's cached reads for
-        # disjoint id-sets stay valid across this replicated row apply
-        self._invalidate_reads(tags=self._tags_for(split, APPLY_TAG_CAP))
+        # disjoint id-sets stay valid across this replicated row apply,
+        # with the replayed tier moves' rows joining the tag set
+        self._invalidate_reads(tags=self._move_tags(
+            self._tags_for(split, APPLY_TAG_CAP), moves))
         if extra.get("pseq") is not None:
             self._applied_pseq[worker] = (extra.get("pnonce"),
                                           int(extra["pseq"]),

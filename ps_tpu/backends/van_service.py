@@ -628,7 +628,11 @@ class VanService:
     def _invalidate_reads(self, tags=None) -> None:
         """Invalidation-on-apply: call after ANY committed state change a
         cached READ reply could observe (engine applies, replica-stream
-        applies, migration cutovers, promotion, drain). ``tags``
+        applies, migration cutovers, promotion, drain — and tiered-
+        embedding tier moves, whose demotion victims fall OUTSIDE the
+        triggering push's id-set: the sparse service unions their row
+        tags in before calling here, because a tier move IS a state
+        change under this contract). ``tags``
         optionally names the touched state slice (the sparse service's
         per-(table, row) hashes): the publish floor still rises — an
         in-flight pre-apply publish is refused either way — but only

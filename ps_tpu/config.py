@@ -98,6 +98,18 @@ Environment variables honored by :meth:`Config.from_env`:
   batch-sized gather→apply→scatter in pure JAX, 'pallas' = the fused
   one-HBM-pass TPU kernel, 'auto' (default) = pallas on TPU, jax
   elsewhere
+- ``PS_EMBED_DEVICE_ROWS``  — tiered embedding device budget (README
+  "Tiered embedding storage"): tables with more rows than this keep a
+  device-HBM hot set of this many slots and spill the rest to a
+  host-DRAM arena; 0 (default) = unlimited = every table fully on
+  device, today's behavior byte-for-byte
+- ``PS_EMBED_ADMIT_FREQ``   — touch count at which a cold row promotes
+  into the hot set (default 2)
+- ``PS_EMBED_EVICT_TTL_MS`` — demote hot rows idle this many ms
+  (default 0 = TTL off; CLOCK still evicts on slot pressure)
+- ``PS_EMBED_PREFETCH``     — stage tiered cold-tier DRAM gathers on a
+  background thread, overlapping them with the previous apply
+  (default off)
 - ``PS_CKPT_ROOT``          — server side: confine CHECKPOINT saves under
   this root (client paths relative-only, ``..`` refused)
 - ``PS_REPLICAS``           — replica-set size per shard (1 = no
@@ -376,6 +388,20 @@ class Config:
         backend platform — pallas on TPU, jax anywhere else. Numerics
         are pinned to the 'off' path by the parity drill
         (tests/test_sparse_apply.py).
+      embed_device_rows: tiered embedding device budget (README "Tiered
+        embedding storage"; ps_tpu/kv/tiered.py): a table with more
+        rows than this fronts a device-HBM hot set of this many slots
+        (rows + per-row optimizer state together) over a host-DRAM
+        cold arena, split per push/read by the row directory. 0
+        (default) = unlimited — every table stays fully on device,
+        today's behavior byte-for-byte.
+      embed_admit_freq: touch count at which a cold row promotes into
+        the hot set (frequency admission; default 2).
+      embed_evict_ttl_ms: demote hot rows idle this many milliseconds
+        (0 = TTL off — CLOCK second-chance eviction still runs on slot
+        pressure; eviction is a demotion, never a drop).
+      embed_prefetch: stage the cold tier's DRAM gather on a background
+        thread so it overlaps the previous apply (default off).
       connect_max_wait_ms: total sleep budget of one Channel.connect
         dial's retry backoff (the boot patience). Read-path failover
         tuning turns it down; 15 s default preserved.
@@ -545,6 +571,14 @@ class Config:
     # — 'off' (legacy masked full-table), 'jax' (batch-sized fallback),
     # 'pallas' (fused one-HBM-pass kernel), 'auto' (by backend platform)
     fused_apply: str = "auto"
+    # tiered embedding storage (ps_tpu/kv/tiered.py, README "Tiered
+    # embedding storage"): device-HBM hot-slot budget (0 = unlimited =
+    # untiered), frequency-admission threshold, idle-TTL demotion
+    # horizon (0 = off), and the background cold-gather prefetch stage
+    embed_device_rows: int = 0
+    embed_admit_freq: int = 2
+    embed_evict_ttl_ms: int = 0
+    embed_prefetch: bool = False
     # dial budgets (previously hardcoded): Channel.connect's total
     # retry-sleep budget and the discovered-aggregator liveness probe's
     connect_max_wait_ms: int = 15_000
@@ -710,6 +744,14 @@ class Config:
                 f"unknown fused_apply tier {self.fused_apply!r}; use "
                 "'off', 'jax', 'pallas' or 'auto'"
             )
+        if self.embed_device_rows < 0:
+            raise ValueError("embed_device_rows must be >= 0 (0 = "
+                             "unlimited, no tiering)")
+        if self.embed_admit_freq < 1:
+            raise ValueError("embed_admit_freq must be >= 1")
+        if self.embed_evict_ttl_ms < 0:
+            raise ValueError("embed_evict_ttl_ms must be >= 0 (0 = "
+                             "TTL off)")
         if self.connect_max_wait_ms < 0:
             raise ValueError("connect_max_wait_ms must be >= 0")
         if self.agg_probe_max_wait_ms < 0:
@@ -880,6 +922,17 @@ class Config:
         if "PS_FUSED_APPLY" in env:
             # "" explicitly selects the auto detection
             kwargs["fused_apply"] = env["PS_FUSED_APPLY"].strip() or "auto"
+        if "PS_EMBED_DEVICE_ROWS" in env:
+            kwargs["embed_device_rows"] = env_int(
+                "PS_EMBED_DEVICE_ROWS", 0, lo=0)
+        if "PS_EMBED_ADMIT_FREQ" in env:
+            kwargs["embed_admit_freq"] = env_int(
+                "PS_EMBED_ADMIT_FREQ", 2, lo=1)
+        if "PS_EMBED_EVICT_TTL_MS" in env:
+            kwargs["embed_evict_ttl_ms"] = env_int(
+                "PS_EMBED_EVICT_TTL_MS", 0, lo=0)
+        if "PS_EMBED_PREFETCH" in env:
+            kwargs["embed_prefetch"] = env_flag("PS_EMBED_PREFETCH", False)
         if "PS_CONNECT_MAX_WAIT_MS" in env:
             kwargs["connect_max_wait_ms"] = int(env["PS_CONNECT_MAX_WAIT_MS"])
         if "PS_AGG_PROBE_MAX_WAIT_MS" in env:

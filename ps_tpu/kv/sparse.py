@@ -346,6 +346,41 @@ class SparseEmbedding:
     def state(self):
         return self._state
 
+    # -- tiered row movement (ps_tpu/kv/tiered.py) ---------------------------
+
+    def export_rows(self, slots) -> Tuple[np.ndarray, list]:
+        """Copy ``slots``' rows AND their per-row optimizer state out to
+        host memory — the demotion half of the what-moves-with-a-row
+        contract (README "Tiered embedding storage"): a row never travels
+        without its state. Returns ``(rows [n, D], state_leaves)`` with
+        the leaves in ``jax.tree_util`` order, each sliced to ``slots``."""
+        slots = jnp.asarray(slots, jnp.int32)
+        rows = np.asarray(jnp.take(self.table, slots, axis=0))
+        leaves = [np.asarray(jnp.take(leaf, slots, axis=0))
+                  for leaf in jax.tree_util.tree_leaves(self._state)]
+        return rows, leaves
+
+    def adopt_rows(self, slots, rows, state_leaves) -> None:
+        """Scatter host rows + their per-row optimizer state into
+        ``slots`` — the promotion half of :meth:`export_rows`. The slab
+        is batch-sized, so a promotion costs O(moved rows), not a table
+        pass."""
+        slots = jnp.asarray(slots, jnp.int32)
+        self._table = self.table.at[slots].set(
+            jnp.asarray(rows, self.dtype))
+        flat, treedef = jax.tree_util.tree_flatten(self._state)
+        flat = [leaf.at[slots].set(jnp.asarray(v, leaf.dtype))
+                for leaf, v in zip(flat, state_leaves)]
+        self._state = jax.tree_util.tree_unflatten(treedef, flat)
+
+    def adopt_state(self, table: jax.Array, state: Any) -> None:
+        """Adopt an externally restored (table, state) pair — the tiered
+        checkpoint path restores both tiers from ONE atomic snapshot and
+        hands the hot tier back through here."""
+        if self._table is None:
+            raise RuntimeError("SparseEmbedding.init must precede adopt_state")
+        self._table, self._state = table, state
+
     # -- checkpoint/resume ---------------------------------------------------
 
     def save(self, path: str) -> None:

@@ -1,0 +1,753 @@
+"""Tiered embedding storage: a device-HBM hot set over a host-DRAM cold
+store (ROADMAP item 1; README "Tiered embedding storage").
+
+Production embedding tables are billions of rows — far past device
+memory — while the touch distribution is zipf-skewed: a small hot set
+takes almost every push. The ps-lite/BytePS lineage this repo reproduces
+pairs its sharded PS with exactly this split (the HugeCTR-HPS /
+Persia-style hierarchy): keep the hot rows + their per-row optimizer
+state on the device, keep everything else in a host-DRAM arena, and move
+rows between the tiers by observed frequency.
+
+:class:`TieredTable` fronts a :class:`~ps_tpu.kv.sparse.SparseEmbedding`
+whose logical row count exceeds the device budget
+(``PS_EMBED_DEVICE_ROWS`` / ``Config.embed_device_rows``; the
+:func:`tiered_embedding` factory returns a plain ``SparseEmbedding`` —
+today's behavior byte-for-byte — when the budget is 0/unlimited or the
+table fits). The pieces:
+
+- **device tier** — a ``SparseEmbedding`` of ``device_rows`` SLOTS (rows
+  + per-row optimizer state, moving together; the optimizer's
+  ``state_scalars_per_row`` is what sizes the slab). A push's hot ids
+  are slot-mapped and ride PR 14's fused gather→apply→scatter UNCHANGED
+  — the all-hot path is bitwise-identical to an untiered table on the
+  same id stream (the non-negotiable, asserted by ``bench.py --model
+  tiered`` and tests/test_tiered.py).
+- **host tier** — a numpy arena ``[num_rows, D]`` plus same-length
+  per-row optimizer-state arrays. Cold ids are deduped by the SAME
+  reduction discipline as the device path
+  (:func:`~ps_tpu.ops.sparse_apply.segment_sum_np`), gathered into a
+  batch-sized slab, applied by the ONE dense-rows rule
+  (``RowwiseOptimizer.apply_rows``, jitted), and scattered back.
+- **row directory** — id → (tier, slot, freq, CLOCK ref bit, last-touch
+  ms). The ONLY authority on residency; a push/read's id set splits by
+  it.
+- **admission / eviction** — a cold row whose touch count crosses
+  ``admit_freq`` promotes; slots free by CLOCK second-chance sweep (ref
+  bit set on touch, the hand clears and advances, an unreferenced slot
+  evicts), plus optional TTL demotion of idle hot rows
+  (``evict_ttl_ms``). Eviction is a DEMOTION, never a drop: the row and
+  its optimizer state travel back to the arena
+  (``SparseEmbedding.export_rows``), exactly as a promotion carries
+  both up (``adopt_rows``). Zero rows are ever lost to churn — the
+  bench's row-sum conservation check.
+- **replica determinism** — the primary PLANS moves (the only wall-clock
+  consumer) and records them as a move log
+  (:attr:`TieredTable.pop_moves`); the service ships the log on the
+  existing replication stream and the backup replays it verbatim
+  (``push(..., moves=...)``) plus the same deterministic freq/ref
+  updates — so a promoted backup's directory matches the dead primary's
+  bitwise and its fused applies cannot diverge.
+- **checkpoint** — :meth:`TieredTable.save` writes BOTH tiers + the
+  directory as ONE atomic snapshot (one ``ckpt.save`` commit), called
+  under the service lock during the coordinated pause — a push landing
+  mid-pause parks on the pause condition, so a promotion can never
+  split across the snapshot.
+- **prefetch** — :meth:`TieredTable.prefetch` stages the cold slab
+  gather on a background thread so the DRAM gather overlaps the
+  previous apply (``PS_EMBED_PREFETCH``); a staged slab is generation-
+  tagged and discarded if any apply or tier move lands first.
+
+Counters (README "Observability"): ``ps_embed_hot_hits_total`` /
+``ps_embed_misses_total`` / ``ps_embed_promotions_total`` /
+``ps_embed_evictions_total`` ride the process registry; the cold-gather
+latency histogram (``ps_embed_cold_gather_seconds``) rides
+``TransportStats`` via the serving layer (backends/remote_sparse.py).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ps_tpu import obs
+from ps_tpu.kv.sparse import SparseEmbedding
+from ps_tpu.ops.sparse_apply import segment_sum_np
+from ps_tpu.parallel.mesh import DATA_AXIS
+
+#: one CLOCK sweep may visit each slot at most twice (clear pass + evict
+#: pass) before force-evicting — the hand can never spin forever even
+#: when every resident row was touched this push
+_CLOCK_MAX_SWEEPS = 2
+
+
+def _pad_pow2(idx: np.ndarray) -> np.ndarray:
+    """Pad an index vector to the next power of two by REPEATING its
+    last element. Device gathers/scatters compile one executable per
+    input shape, so unpadded move batches would recompile on every
+    distinct promotion/demotion count; the duplicate indices are
+    harmless — a gather reads the same row twice, a scatter writes the
+    same (slot, row) pair twice."""
+    n = idx.size
+    p = 1 << (n - 1).bit_length() if n > 1 else 1
+    if p == n:
+        return idx
+    return np.concatenate([idx, np.full((p - n,), idx[-1], idx.dtype)])
+
+
+def tiered_embedding(num_rows: int, dim: int, optimizer="adagrad",
+                     device_rows: Optional[int] = None,
+                     admit_freq: Optional[int] = None,
+                     evict_ttl_ms: Optional[int] = None,
+                     prefetch: Optional[bool] = None,
+                     **kwargs):
+    """Build the right table for ``num_rows`` under the device budget.
+
+    The factory the serving/bench layers construct tables through:
+    budget 0 (unlimited) or a table that fits returns a plain
+    :class:`SparseEmbedding` — today's behavior byte-for-byte — and only
+    a table EXCEEDING the budget pays for tiering. ``None`` knobs
+    resolve from the environment through the validated readers
+    (``PS_EMBED_DEVICE_ROWS`` / ``PS_EMBED_ADMIT_FREQ`` /
+    ``PS_EMBED_EVICT_TTL_MS`` / ``PS_EMBED_PREFETCH``)."""
+    from ps_tpu.config import env_flag, env_int
+
+    if device_rows is None:
+        device_rows = env_int("PS_EMBED_DEVICE_ROWS", 0, lo=0)
+    if device_rows <= 0 or device_rows >= num_rows:
+        return SparseEmbedding(num_rows, dim, optimizer, **kwargs)
+    if admit_freq is None:
+        admit_freq = env_int("PS_EMBED_ADMIT_FREQ", 2, lo=1)
+    if evict_ttl_ms is None:
+        evict_ttl_ms = env_int("PS_EMBED_EVICT_TTL_MS", 0, lo=0)
+    if prefetch is None:
+        prefetch = env_flag("PS_EMBED_PREFETCH", False)
+    return TieredTable(num_rows, dim, optimizer,
+                       device_rows=device_rows, admit_freq=admit_freq,
+                       evict_ttl_ms=evict_ttl_ms, prefetch=prefetch,
+                       **kwargs)
+
+
+class TieredTable:
+    """A device-budgeted embedding table: hot slots on device, the rest
+    in a host-DRAM arena, split per push/read by the row directory.
+
+    API-compatible with :class:`SparseEmbedding` where the serving layer
+    touches it (``init``/``push``/``pull``/``save``/``restore``,
+    ``table``, the counter attributes), plus the tier surface:
+    ``push(..., moves=...)`` for replica replay, :meth:`pop_moves`,
+    :meth:`prefetch`, :meth:`tier_stats`, :meth:`drain_cold_gather`.
+
+    Args:
+      num_rows: logical vocabulary size (the arena's row count).
+      dim: embedding dimension.
+      optimizer: as ``SparseEmbedding`` — ONE rule governs both tiers.
+      device_rows: hot-slot budget; must be in (0, num_rows) — the
+        factory handles the degenerate cases.
+      admit_freq: touch count at which a cold row promotes.
+      evict_ttl_ms: demote hot rows idle this long (0 = TTL off; CLOCK
+        still evicts on slot pressure).
+      prefetch: stage cold gathers on a background thread
+        (:meth:`prefetch`).
+    """
+
+    def __init__(self, num_rows: int, dim: int, optimizer="adagrad",
+                 device_rows: int = 0, admit_freq: int = 2,
+                 evict_ttl_ms: int = 0, prefetch: bool = False,
+                 dtype=jnp.float32, mesh=None, axis: str = DATA_AXIS,
+                 fused_apply: Optional[str] = None, **opt_kwargs):
+        if not (0 < device_rows < num_rows):
+            raise ValueError(
+                f"device_rows {device_rows} outside (0, {num_rows}) — "
+                f"use tiered_embedding(), which returns a plain "
+                f"SparseEmbedding for the degenerate budgets")
+        if admit_freq < 1:
+            raise ValueError("admit_freq must be >= 1")
+        if evict_ttl_ms < 0:
+            raise ValueError("evict_ttl_ms must be >= 0 (0 = TTL off)")
+        # the hot tier IS a SparseEmbedding over SLOTS: its fused
+        # gather→apply→scatter, its per-row state, its dedupe — the
+        # bitwise hot-path parity rests on changing nothing here
+        self.hot = SparseEmbedding(device_rows, dim, optimizer,
+                                   dtype=dtype, mesh=mesh, axis=axis,
+                                   fused_apply=fused_apply, **opt_kwargs)
+        self.num_rows = num_rows
+        self.device_rows = device_rows
+        self.dim = dim
+        self.dtype = dtype
+        self.admit_freq = admit_freq
+        self.evict_ttl_ms = evict_ttl_ms
+        self.prefetch_enabled = bool(prefetch)
+        self._opt = self.hot._opt
+        self.fused_tier = self.hot.fused_tier
+
+        # row directory: the one authority on residency
+        self.tier = np.zeros((num_rows,), np.uint8)    # 0 cold, 1 hot
+        self.slot = np.full((num_rows,), -1, np.int32)
+        self.freq = np.zeros((num_rows,), np.int64)
+        self.ref = np.zeros((num_rows,), np.uint8)     # CLOCK bit
+        self.last_ms = np.zeros((num_rows,), np.int64)
+        self.slot_to_id = np.full((device_rows,), -1, np.int32)
+        self.hand = 0
+        #: bumped on every tier move — prefetch staleness + STATS
+        self.dir_gen = 0
+
+        # host tier: arena + per-row optimizer state (row i's slice is
+        # authoritative only while tier[i] == 0)
+        self.arena: Optional[np.ndarray] = None
+        self.cold_state: list = []
+        self._cold_apply = jax.jit(self._opt.apply_rows)
+        #: bumped on every cold scatter (and restore) — validates staged
+        #: slabs; tier moves invalidate by overlap instead (only
+        #: demotions write the arena, and never to a staged-cold id)
+        self._cold_gen = 0
+
+        # prefetch staging (one slab; the service calls prefetch once
+        # per in-flight push)
+        self._stage_lock = threading.Lock()
+        self._staged: Optional[tuple] = None
+        self._prefetch_pool = None
+
+        # counters: local ints for STATS + the process-registry families
+        # (counter() returns the existing instrument on re-register, so
+        # several tables share one family — the _rows_counter pattern)
+        self.hot_hits = 0
+        self.misses = 0
+        self.promotions = 0
+        self.evictions = 0
+        self.prefetch_hits = 0
+        reg = obs.default_registry()
+        self._c_hits = reg.counter(
+            "ps_embed_hot_hits_total",
+            "tiered embedding ids served from the device hot set")
+        self._c_miss = reg.counter(
+            "ps_embed_misses_total",
+            "tiered embedding ids that went to the host cold arena")
+        self._c_promo = reg.counter(
+            "ps_embed_promotions_total",
+            "tiered embedding rows promoted cold -> hot (state moved)")
+        self._c_evict = reg.counter(
+            "ps_embed_evictions_total",
+            "tiered embedding rows demoted hot -> cold (state moved)")
+        self._cold_gather_s: list = []
+        self.last_moves: dict = {"ops": [], "hand": 0}
+
+        # SparseEmbedding-compatible accounting (the service seeds its
+        # versions/rows from these)
+        self.bytes_pushed = 0
+        self.bytes_pulled = 0
+        self.collective_bytes = 0
+        self.push_count = 0
+        self.rows_pushed = 0
+        self.dropped_rows = 0
+
+    # -- placement -----------------------------------------------------------
+
+    def init(self, rng_or_table, scale: float = 0.01) -> jax.Array:
+        """Create (or adopt) the full logical table, place the first
+        ``device_rows`` ids hot (slot order = id order) and the rest in
+        the arena. Returns the HOT tier's placed table."""
+        if self.arena is not None:
+            raise RuntimeError("TieredTable.init already called")
+        is_key = isinstance(rng_or_table, jax.Array) and jnp.issubdtype(
+            rng_or_table.dtype, jax.dtypes.prng_key)
+        if not is_key and isinstance(rng_or_table, (jax.Array, np.ndarray)):
+            full = np.asarray(rng_or_table)
+            if full.shape != (self.num_rows, self.dim):
+                raise ValueError(
+                    f"table shape {full.shape} != "
+                    f"({self.num_rows}, {self.dim})")
+        else:
+            full = np.asarray(scale * jax.random.normal(
+                rng_or_table, (self.num_rows, self.dim), self.dtype))
+        full = full.astype(np.dtype(jnp.dtype(self.dtype).name))
+        self.arena = np.ascontiguousarray(full)
+        # per-row cold optimizer state, leaf structure probed from the
+        # one rule (fresh state == what an untiered init would hold)
+        probe = jax.tree_util.tree_leaves(
+            self._opt.init(jnp.zeros((1, self.dim), self.dtype)))
+        self.cold_state = [
+            np.zeros((self.num_rows,) + tuple(leaf.shape[1:]),
+                     np.dtype(jnp.dtype(leaf.dtype).name))
+            for leaf in probe
+        ]
+        hot_ids = np.arange(self.device_rows, dtype=np.int32)
+        self.tier[hot_ids] = 1
+        self.slot[hot_ids] = hot_ids
+        self.slot_to_id[:] = hot_ids
+        return self.hot.init(full[:self.device_rows])
+
+    @property
+    def table(self) -> jax.Array:
+        """The hot tier's device table (the serving layer's sync point)."""
+        return self.hot.table
+
+    def state(self):
+        return self.hot.state()
+
+    # -- push: split by directory, one apply rule on both tiers --------------
+
+    def push(self, ids, row_grads, moves: Optional[dict] = None) -> None:
+        """Apply one push across both tiers.
+
+        ``moves=None`` (the primary) plans admission/eviction for this
+        push and records the decisions in :meth:`pop_moves` for the
+        replication stream; a dict (the backup) replays exactly those
+        recorded moves — the wall clock never consults twice, so the
+        directories stay bitwise-equal. Hot ids ride the device tier's
+        fused apply unchanged; cold ids are deduped, gathered from the
+        arena, applied by the same ``apply_rows`` rule, and scattered
+        back."""
+        if self.arena is None:
+            raise RuntimeError("TieredTable.init not called")
+        ids = np.asarray(ids, np.int32).reshape(-1)
+        grads = np.asarray(row_grads)
+        if grads.shape != (ids.shape[0], self.dim):
+            raise ValueError(
+                f"row_grads shape {grads.shape} != "
+                f"({ids.shape[0]}, {self.dim})")
+        now_ms = int(time.time() * 1000)
+        uids, ucnt = np.unique(ids, return_counts=True)
+        real = uids >= 0
+        uids, ucnt = uids[real], ucnt[real]
+        # deterministic touch accounting (identical on primary and
+        # backup): freq advances by duplicate count, hot touches set
+        # their CLOCK ref bit
+        self.freq[uids] += ucnt
+        touched_hot = uids[self.tier[uids] == 1]
+        self.ref[touched_hot] = 1
+        if moves is None:
+            moves = self._plan_moves(uids, now_ms)
+        self._apply_moves(moves)
+        self.last_moves = moves
+        self.last_ms[uids] = now_ms
+
+        # split by the post-move directory — keeping the FULL batch
+        # shape on both sides (the other tier's positions masked to the
+        # -1 filler both dedupe paths already drop) so the jitted
+        # applies see ONE shape per batch size instead of recompiling
+        # on every hot/cold split. Filler is shape-invisible to the
+        # math: the stable segment sort groups the -1s apart and each
+        # real row's duplicates still merge in arrival order, so the
+        # hot rows' updates stay bitwise-identical to an untiered push
+        # of the same stream.
+        valid = ids >= 0
+        hot_mask = valid & (self.tier[np.clip(ids, 0, None)] == 1)
+        cold_mask = valid & ~hot_mask
+        n_hot = int(np.count_nonzero(hot_mask))
+        n_cold = int(np.count_nonzero(cold_mask))
+        if n_hot:
+            # RAW stream, slot-mapped: the hot tier's own dedupe merges
+            # duplicates in arrival order exactly as an untiered push
+            # would — the hot rows' math is bitwise-identical
+            self.hot.push(np.where(hot_mask, self.slot[np.clip(ids, 0, None)],
+                                   np.int32(-1)), grads)
+        if n_cold:
+            self._push_cold(np.where(cold_mask, ids, np.int32(-1)), grads)
+        self.hot_hits += n_hot
+        self.misses += n_cold
+        self._c_hits.inc(n_hot)
+        self._c_miss.inc(n_cold)
+        self.bytes_pushed += grads.size * grads.dtype.itemsize
+        self.push_count += 1
+        self.rows_pushed += int(valid.sum())
+
+    def _push_cold(self, ids: np.ndarray, grads: np.ndarray) -> None:
+        """Dedupe → arena gather (staged if prefetched) → ``apply_rows``
+        → scatter back. Batch-sized end to end."""
+        t0 = time.perf_counter()
+        uids, gsum, cnt = segment_sum_np(ids, grads)
+        staged = self._take_staged(uids)
+        if staged is not None:
+            rows, leaves = staged
+            self.prefetch_hits += 1
+        else:
+            rows = self.arena[uids]
+            leaves = [s[uids] for s in self.cold_state]
+        # pad the slab to the next power of two (cnt=0 filler rows pass
+        # through apply_rows untouched): the jitted apply compiles once
+        # per size BUCKET, not once per distinct unique-id count
+        u = uids.size
+        p = 1 << (u - 1).bit_length() if u > 1 else 1
+        if p > u:
+            pad = ((0, p - u), (0, 0))
+            rows = np.pad(rows, pad)
+            gsum = np.pad(gsum, pad)
+            cnt = np.pad(cnt, ((0, p - u),))
+            leaves = [np.pad(v, ((0, p - u),) + ((0, 0),) * (v.ndim - 1))
+                      for v in leaves]
+        state = jax.tree_util.tree_unflatten(
+            self._state_treedef(), [jnp.asarray(v) for v in leaves])
+        new_rows, new_state = self._cold_apply(
+            jnp.asarray(rows), state, jnp.asarray(gsum),
+            jnp.asarray(cnt))
+        self.arena[uids] = np.asarray(new_rows, self.arena.dtype)[:u]
+        for dst, leaf in zip(self.cold_state,
+                             jax.tree_util.tree_leaves(new_state)):
+            dst[uids] = np.asarray(leaf, dst.dtype)[:u]
+        self._cold_gen += 1
+        self._cold_gather_s.append(time.perf_counter() - t0)
+
+    def _state_treedef(self):
+        td = getattr(self, "_treedef", None)
+        if td is None:
+            probe = self._opt.init(jnp.zeros((1, self.dim), self.dtype))
+            td = self._treedef = jax.tree_util.tree_structure(probe)
+        return td
+
+    # -- admission / eviction -------------------------------------------------
+
+    def _plan_moves(self, uids: np.ndarray, now_ms: int) -> dict:
+        """Decide this push's tier moves (primary only — the one place
+        the wall clock is read). Returns the replayable move log:
+        ``{"ops": [[kind, id, slot], ...], "hand": int}`` with kind
+        ``"r"`` (CLOCK ref clear), ``"d"`` (demote), ``"p"`` (promote)
+        — applied strictly in order by :meth:`_apply_moves` on primary
+        and backup alike."""
+        ops: list = []
+        free: list = []
+        touched = set(uids.tolist())
+        # TTL eviction: demote hot rows idle past the horizon (never one
+        # touched by this very push)
+        if self.evict_ttl_ms:
+            resident = self.slot_to_id[self.slot_to_id >= 0]
+            idle = resident[(now_ms - self.last_ms[resident])
+                            >= self.evict_ttl_ms]
+            for i in idle.tolist():
+                if i in touched:
+                    continue
+                ops.append(["d", int(i), int(self.slot[i])])
+                free.append(int(self.slot[i]))
+        # admission: cold rows whose touch count crossed the threshold
+        cand = uids[(self.tier[uids] == 0)
+                    & (self.freq[uids] >= self.admit_freq)]
+        hand = self.hand
+        promoted: set = set()
+        demoted = {op[1] for op in ops}
+        for i in cand.tolist():
+            if free:
+                s = free.pop()
+            else:
+                s, hand, clock_ops = self._clock_scan(
+                    hand, promoted, demoted)
+                if s is None:
+                    break  # every slot pinned by this push: admit later
+                ops.extend(clock_ops)
+                ops.append(["d", int(self.slot_to_id[s]), int(s)])
+                demoted.add(int(self.slot_to_id[s]))
+            ops.append(["p", int(i), int(s)])
+            promoted.add(int(i))
+        return {"ops": ops, "hand": int(hand)}
+
+    def _clock_scan(self, hand: int, promoted: set, demoted: set):
+        """Second-chance sweep from ``hand``: clear ref bits until an
+        unreferenced victim slot turns up (recorded as ``"r"`` ops so the
+        backup's ref bits track the primary's). Rows promoted/demoted
+        earlier in this same plan are skipped; after the bounded sweeps
+        the current candidate is force-evicted."""
+        n = self.device_rows
+        clock_ops: list = []
+        for step in range(_CLOCK_MAX_SWEEPS * n):
+            s = hand
+            hand = (hand + 1) % n
+            rid = int(self.slot_to_id[s])
+            if rid < 0 or rid in promoted or rid in demoted:
+                continue
+            if self.ref[rid] and step < n:
+                clock_ops.append(["r", rid, s])
+                self.ref[rid] = 0  # plan-time clear; replayed via ops
+                continue
+            return s, hand, clock_ops
+        return None, hand, clock_ops
+
+    def _apply_moves(self, moves: dict) -> None:
+        """Replay one move log against the directory and both tiers —
+        ref clears, then batched demotions (device → arena, state
+        included), then batched promotions (arena → device). The plan
+        orders ops so a promotion's slot is free by the time it lands."""
+        ops = moves.get("ops") or []
+        if not ops:
+            return
+        for kind, rid, _s in ops:
+            if kind == "r":
+                self.ref[rid] = 0
+        dem = [(rid, s) for kind, rid, s in ops if kind == "d"]
+        if dem:
+            d_ids = np.asarray([r for r, _ in dem], np.int32)
+            d_slots = np.asarray([s for _, s in dem], np.int32)
+            rows, leaves = self.hot.export_rows(_pad_pow2(d_slots))
+            n = d_ids.size
+            self.arena[d_ids] = rows[:n].astype(self.arena.dtype)
+            for dst, leaf in zip(self.cold_state, leaves):
+                dst[d_ids] = leaf[:n].astype(dst.dtype)
+            self.tier[d_ids] = 0
+            self.slot[d_ids] = -1
+            self.slot_to_id[d_slots] = -1
+            self.ref[d_ids] = 0
+            self.evictions += len(dem)
+            self._c_evict.inc(len(dem))
+        pro = [(rid, s) for kind, rid, s in ops if kind == "p"]
+        if pro:
+            p_ids = np.asarray([r for r, _ in pro], np.int32)
+            p_slots = np.asarray([s for _, s in pro], np.int32)
+            pid_p = _pad_pow2(p_ids)
+            self.hot.adopt_rows(_pad_pow2(p_slots), self.arena[pid_p],
+                                [s[pid_p] for s in self.cold_state])
+            self.tier[p_ids] = 1
+            self.slot[p_ids] = p_slots
+            self.slot_to_id[p_slots] = p_ids
+            self.ref[p_ids] = 1
+            self.promotions += len(pro)
+            self._c_promo.inc(len(pro))
+        if moves.get("hand") is not None:
+            self.hand = int(moves["hand"])
+        self.dir_gen += 1
+        # a demotion WRITES arena rows, so a staged slab that holds one
+        # of them is stale — drop it. Promotions only READ the arena:
+        # a slab staged for this very push stays valid, and
+        # ``_take_staged`` subsets away the now-hot ids.
+        if dem:
+            with self._stage_lock:
+                if self._staged is not None and np.intersect1d(
+                        self._staged[1], d_ids).size:
+                    self._staged = None
+
+    def pop_moves(self) -> dict:
+        """This push's move log (then cleared) — what the serving layer
+        ships to the backup so tier placement replicates."""
+        mv, self.last_moves = self.last_moves, {"ops": [], "hand": None}
+        return mv
+
+    # -- read: split gather, no directory mutation ---------------------------
+
+    def pull(self, ids) -> jax.Array:
+        """Gather current rows for ids across both tiers, in id order.
+        Side-effect-free on table state and the directory (reads must
+        stay cacheable by the native read path); only counters move."""
+        if self.arena is None:
+            raise RuntimeError("TieredTable.init not called")
+        ids = np.asarray(ids, np.int32).reshape(-1)
+        out = np.empty((ids.shape[0], self.dim), self.arena.dtype)
+        hot_mask = self.tier[ids] == 1
+        n_hot = int(np.count_nonzero(hot_mask))
+        if n_hot:
+            out[hot_mask] = np.asarray(
+                self.hot.pull(self.slot[ids[hot_mask]]))
+        if n_hot < ids.shape[0]:
+            out[~hot_mask] = self.arena[ids[~hot_mask]]
+        self.hot_hits += n_hot
+        self.misses += ids.shape[0] - n_hot
+        self._c_hits.inc(n_hot)
+        self._c_miss.inc(ids.shape[0] - n_hot)
+        self.bytes_pulled += out.size * out.dtype.itemsize
+        return jnp.asarray(out)
+
+    # -- prefetch: overlap the DRAM gather with the previous apply -----------
+
+    def prefetch(self, ids) -> None:
+        """Stage the cold slab for an upcoming push of ``ids`` on a
+        background thread. Generation-tagged: any apply or tier move
+        landing before the push invalidates the slab (it is discarded,
+        never served stale). No-op unless ``prefetch`` was enabled."""
+        if not self.prefetch_enabled or self.arena is None:
+            return
+        ids = np.asarray(ids, np.int32).reshape(-1)
+        cold = ids[(ids >= 0) & (self.tier[np.clip(ids, 0, None)] == 0)]
+        if cold.size == 0:
+            return
+        uids = np.unique(cold)
+        if self._prefetch_pool is None:
+            from concurrent.futures import ThreadPoolExecutor
+
+            self._prefetch_pool = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="ps-embed-prefetch")
+        self._prefetch_pool.submit(self._stage, uids)
+
+    def _stage(self, uids: np.ndarray) -> None:
+        gen = self._cold_gen
+        rows = self.arena[uids].copy()
+        leaves = [s[uids].copy() for s in self.cold_state]
+        if gen != self._cold_gen:
+            return  # an apply raced the gather: the slab may be torn
+        with self._stage_lock:
+            self._staged = (gen, uids, rows, leaves)
+
+    def _take_staged(self, uids: np.ndarray):
+        with self._stage_lock:
+            staged, self._staged = self._staged, None
+        if staged is None:
+            return None
+        gen, s_uids, rows, leaves = staged
+        if gen != self._cold_gen:
+            return None
+        if np.array_equal(s_uids, uids):
+            return rows, leaves
+        # ids promoted between staging and the push left the cold set:
+        # serve the surviving subset (both vectors are sorted-unique)
+        pos = np.searchsorted(s_uids, uids)
+        if np.any(pos >= s_uids.size) or \
+                not np.array_equal(s_uids[np.minimum(pos, s_uids.size - 1)],
+                                   uids):
+            return None
+        return rows[pos], [v[pos] for v in leaves]
+
+    # -- observability --------------------------------------------------------
+
+    def tier_stats(self) -> dict:
+        """The STATS ``tier`` entry for this table (ps_top's hot%/evict
+        columns read these)."""
+        total = self.hot_hits + self.misses
+        return {
+            "device_rows": self.device_rows,
+            "total_rows": self.num_rows,
+            "hot_rows": int(np.count_nonzero(self.slot_to_id >= 0)),
+            "hot_hits": self.hot_hits,
+            "misses": self.misses,
+            "hit_rate": round(self.hot_hits / total, 4) if total else None,
+            "promotions": self.promotions,
+            "evictions": self.evictions,
+            "prefetch_hits": self.prefetch_hits,
+            "dir_gen": self.dir_gen,
+        }
+
+    def drain_cold_gather(self) -> list:
+        """Pending cold gather→apply latencies (seconds), cleared — the
+        serving layer feeds them to ``ps_embed_cold_gather_seconds``."""
+        out, self._cold_gather_s = self._cold_gather_s, []
+        return out
+
+    # -- checkpoint/resume: both tiers, ONE atomic snapshot ------------------
+
+    def save(self, path: str) -> None:
+        """Checkpoint both tiers + the directory as one atomic commit
+        (ckpt.save's generation-numbered write + meta.json swap): the
+        hot table and its per-row state, the arena and ITS per-row
+        state, and every directory array. Restore reproduces exact
+        placement — a promotion is on both sides of the snapshot or
+        neither."""
+        from ps_tpu import checkpoint as ckpt
+
+        arrays = {
+            "hot_table": self.hot.table,
+            "hot_opt": ckpt.flatten_leaves(self.hot.state()),
+            "arena": self.arena,
+            "cold_opt": {f"{i:05d}": leaf
+                         for i, leaf in enumerate(self.cold_state)},
+            "dir_tier": self.tier,
+            "dir_slot": self.slot,
+            "dir_freq": self.freq,
+            "dir_ref": self.ref,
+            "dir_last_ms": self.last_ms,
+            "slot_to_id": self.slot_to_id,
+        }
+        meta = {
+            "engine": "tiered",
+            "num_rows": self.num_rows,
+            "dim": self.dim,
+            "dtype": jnp.dtype(self.dtype).name,
+            "device_rows": self.device_rows,
+            "hand": self.hand,
+            "dir_gen": self.dir_gen,
+            "push_count": self.push_count,
+            "rows_pushed": self.rows_pushed,
+            "bytes_pushed": self.bytes_pushed,
+            "bytes_pulled": self.bytes_pulled,
+            "collective_bytes": self.collective_bytes,
+            "hot_hits": self.hot_hits,
+            "misses": self.misses,
+            "promotions": self.promotions,
+            "evictions": self.evictions,
+        }
+        ckpt.save(path, arrays, meta)
+
+    def restore(self, path: str) -> jax.Array:
+        """Restore a :meth:`save` snapshot. Call after ``init`` (same
+        geometry/optimizer/mesh); reproduces the exact directory and
+        both arenas. Returns the restored hot table."""
+        from ps_tpu import checkpoint as ckpt
+
+        if self.arena is None:
+            raise RuntimeError("TieredTable.init must precede restore")
+        meta = ckpt.read_meta(path)
+        if meta.get("engine") != "tiered":
+            raise ValueError(
+                f"checkpoint was written by engine {meta.get('engine')!r},"
+                f" not a tiered table")
+        if (meta["num_rows"], meta["dim"], meta["device_rows"]) != \
+                (self.num_rows, self.dim, self.device_rows):
+            raise ValueError(
+                f"checkpoint geometry ({meta['num_rows']}, {meta['dim']},"
+                f" budget {meta['device_rows']}) != this table "
+                f"({self.num_rows}, {self.dim}, {self.device_rows})")
+        if meta["dtype"] != jnp.dtype(self.dtype).name:
+            raise ValueError(
+                f"checkpoint dtype {meta['dtype']} != "
+                f"{jnp.dtype(self.dtype).name} — restore would cast")
+        hot_state = self.hot.state()
+        abstract = {
+            "hot_table": ckpt.abstract_like(self.hot.table),
+            "hot_opt": ckpt.abstract_like(ckpt.flatten_leaves(hot_state)),
+            "arena": ckpt.abstract_like(self.arena),
+            "cold_opt": {f"{i:05d}": ckpt.abstract_like(leaf)
+                         for i, leaf in enumerate(self.cold_state)},
+            "dir_tier": ckpt.abstract_like(self.tier),
+            "dir_slot": ckpt.abstract_like(self.slot),
+            "dir_freq": ckpt.abstract_like(self.freq),
+            "dir_ref": ckpt.abstract_like(self.ref),
+            "dir_last_ms": ckpt.abstract_like(self.last_ms),
+            "slot_to_id": ckpt.abstract_like(self.slot_to_id),
+        }
+        arrays = ckpt.restore(path, abstract, meta)
+        self.hot.adopt_state(
+            arrays["hot_table"],
+            ckpt.unflatten_like(hot_state, arrays["hot_opt"]))
+        self.arena = np.ascontiguousarray(np.asarray(arrays["arena"]))
+        self.cold_state = [
+            np.ascontiguousarray(np.asarray(arrays["cold_opt"][f"{i:05d}"]))
+            for i in range(len(self.cold_state))
+        ]
+        self.tier = np.asarray(arrays["dir_tier"], np.uint8).copy()
+        self.slot = np.asarray(arrays["dir_slot"], np.int32).copy()
+        self.freq = np.asarray(arrays["dir_freq"], np.int64).copy()
+        self.ref = np.asarray(arrays["dir_ref"], np.uint8).copy()
+        self.last_ms = np.asarray(arrays["dir_last_ms"], np.int64).copy()
+        self.slot_to_id = np.asarray(arrays["slot_to_id"],
+                                     np.int32).copy()
+        self.hand = int(meta["hand"])
+        self.dir_gen = int(meta["dir_gen"])
+        self.push_count = int(meta["push_count"])
+        self.rows_pushed = int(meta["rows_pushed"])
+        self.bytes_pushed = int(meta["bytes_pushed"])
+        self.bytes_pulled = int(meta["bytes_pulled"])
+        self.collective_bytes = int(meta["collective_bytes"])
+        self.hot_hits = int(meta["hot_hits"])
+        self.misses = int(meta["misses"])
+        self.promotions = int(meta["promotions"])
+        self.evictions = int(meta["evictions"])
+        self._cold_gen += 1  # staged slabs predate the restore
+        self._staged = None
+        # the hot SparseEmbedding's own counters resume too, so a
+        # service re-seeding versions from push_count agrees either way
+        self.hot.push_count = self.push_count
+        self.hot.rows_pushed = self.rows_pushed
+        return self.hot.table
+
+    # -- conservation audit (the bench's zero-rows-lost check) ---------------
+
+    def row_sum(self) -> float:
+        """f64 sum over every logical row, wherever it lives — churn
+        moves rows between tiers but must never lose or double-count
+        one (demotion overwrites the arena copy; a hot row's arena
+        slice is excluded here because the device copy is the
+        authority)."""
+        hot_ids = self.slot_to_id[self.slot_to_id >= 0]
+        hot_rows = np.asarray(self.hot.pull(self.slot[hot_ids]),
+                              np.float64)
+        cold_mask = self.tier == 0
+        return float(hot_rows.sum()
+                     + self.arena[cold_mask].astype(np.float64).sum())

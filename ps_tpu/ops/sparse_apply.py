@@ -108,6 +108,31 @@ def batch_segment_sum(ids: jax.Array, grads: jax.Array
     return uids, gsum, cnt
 
 
+def segment_sum_np(ids, grads):
+    """Host twin of :func:`batch_segment_sum` for the tiered cold path
+    (ps_tpu/kv/tiered.py): dedupe a push's (ids, grads) on the CPU before
+    gathering the touched rows from the DRAM arena. Same reduction
+    discipline — duplicates sum in f32 in arrival order (``np.add.at``
+    accumulates sequentially) — so a row's gsum is the number the device
+    paths would have produced. Returns compact ``(uids [U], gsum [U, D]
+    f32, cnt [U])`` with filler (-1) ids dropped entirely: the cold slab
+    is sized by unique touched rows, nothing else."""
+    import numpy as np
+
+    ids = np.asarray(ids, np.int32).reshape(-1)
+    grads = np.asarray(grads).reshape(ids.shape[0], -1)
+    real = ids >= 0
+    ids, grads = ids[real], grads[real]
+    if ids.size == 0:
+        return (ids, np.zeros((0, grads.shape[1]), np.float32),
+                np.zeros((0,), np.int32))
+    uids, inv, cnt = np.unique(ids, return_inverse=True,
+                               return_counts=True)
+    gsum = np.zeros((uids.size, grads.shape[1]), np.float32)
+    np.add.at(gsum, inv, grads.astype(np.float32))
+    return uids, gsum, cnt.astype(np.int32)
+
+
 def fused_sparse_apply(table: jax.Array, state: Any, ids: jax.Array,
                        grads: jax.Array, opt, tier: str,
                        interpret: Optional[bool] = None

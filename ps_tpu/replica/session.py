@@ -107,7 +107,14 @@ class BackupSession:
                 meta: dict) -> int:
         """Append one committed event (call under the service's apply lock
         — log order must be engine order). Blocks when the ack window is
-        full; returns the entry's seq for :meth:`wait_acked`."""
+        full; returns the entry's seq for :meth:`wait_acked`.
+
+        ``meta`` rides the wire verbatim (JSON): besides the cycle token
+        it carries side decisions the backup must REPLAY rather than
+        re-derive — the sparse service's tiered admission/eviction log
+        (``tier_moves``) is the canonical case, since a backup planning
+        its own moves against its own wall clock would diverge from the
+        primary's tier placement and corrupt a later failover."""
         return self.log.append(op, worker, tensors, meta)
 
     def wait_acked(self, seq: int, timeout: Optional[float] = None) -> bool:

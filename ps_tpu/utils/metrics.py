@@ -125,6 +125,14 @@ class TransportStats:
         ("nl_flush_s", "ps_nl_flush_seconds",
          "native loop staged-tail EPOLLOUT flush latency (writev "
          "stall to drain complete)"),
+        # tiered embedding cold path (README "Tiered embedding
+        # storage"): one push's host-arena dedupe→gather→apply→scatter,
+        # end to end. Its own family because the tier hop is the sparse
+        # path's dominant added latency — a fleet view watches this
+        # distribution against sparse_apply_s to see DRAM misses, not
+        # device applies, eating the budget.
+        ("cold_gather_s", "ps_embed_cold_gather_seconds",
+         "tiered embedding cold-tier gather->apply->scatter, per push"),
     )
 
     def __init__(self, window: int = 256):
@@ -377,6 +385,12 @@ class TransportStats:
         self.hist["sparse_apply_s"].record(seconds)
         with self._lock:
             self.sparse_rows_applied += int(rows)
+
+    def record_cold_gather(self, seconds: float) -> None:
+        """One tiered-table cold-path pass (dedupe → DRAM gather →
+        apply_rows → scatter back), drained from the table after the
+        push commits (TieredTable.drain_cold_gather)."""
+        self.hist["cold_gather_s"].record(seconds)
 
     def record_read_served(self) -> None:
         """Server side: one READ answered in Python (the pump path — a

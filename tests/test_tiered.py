@@ -1,0 +1,448 @@
+"""Tiered embedding storage (ROADMAP item 1; README "Tiered embedding
+storage") — the TieredTable's contracts and its two integration seams.
+
+Core contracts:
+- the factory returns a plain SparseEmbedding for degenerate budgets
+  (0 = unlimited, or the table fits) — today's behavior byte-for-byte;
+- a stream confined to the resident hot set leaves the device tier
+  BITWISE-equal to an untiered table on the same stream (the hot path
+  rides the fused apply unchanged);
+- a mixed hot/cold stream reproduces the untiered oracle (one apply
+  rule on both tiers), and per-row optimizer state travels with every
+  promotion/demotion — churn loses nothing;
+- reads split by the directory without mutating it (READ stays
+  side-effect-free).
+
+Integration seams (the ISSUE's two drills):
+- checkpoint: BOTH tiers + the directory are one atomic snapshot taken
+  under the coordinated pause — a push landing mid-pause PARKS, so a
+  promotion is on both sides of the snapshot or neither, and restore
+  reproduces the exact directory + both arenas;
+- replication: the primary's recorded admission/eviction log replayed
+  through the existing stream leaves the backup's tier directory
+  bitwise-equal to the primary's — a promoted backup cannot diverge.
+"""
+
+import threading
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import ps_tpu as ps
+from ps_tpu.control import tensor_van as tv
+from ps_tpu.kv.sparse import SparseEmbedding
+from ps_tpu.kv.tiered import TieredTable, tiered_embedding
+
+V, D, BUDGET = 96, 4, 24
+
+
+def _table0(rows=V):
+    return np.random.default_rng(0).normal(
+        size=(rows, D)).astype(np.float32)
+
+
+def _init():
+    if not ps.is_initialized():
+        ps.init(backend="tpu")
+
+
+def _make(optimizer="adagrad", budget=BUDGET, **kw):
+    _init()
+    t = TieredTable(V, D, optimizer=optimizer, device_rows=budget, **kw)
+    t.init(_table0())
+    return t
+
+
+def _make_untiered(optimizer="adagrad", rows=V, **kw):
+    _init()
+    emb = SparseEmbedding(rows, D, optimizer=optimizer, **kw)
+    emb.init(_table0(rows))
+    return emb
+
+
+def _stream(n_push, batch=16, lo=0, hi=V, seed=1):
+    rng = np.random.default_rng(seed)
+    return [(rng.integers(lo, hi, size=batch).astype(np.int32),
+             rng.normal(size=(batch, D)).astype(np.float32) * 0.1)
+            for _ in range(n_push)]
+
+
+# -- factory + knobs ----------------------------------------------------------
+
+
+def test_factory_degenerate_budgets_stay_untiered():
+    _init()
+    assert isinstance(tiered_embedding(V, D, device_rows=0),
+                      SparseEmbedding)
+    assert isinstance(tiered_embedding(V, D, device_rows=V),
+                      SparseEmbedding)
+    assert isinstance(tiered_embedding(V, D, device_rows=V + 7),
+                      SparseEmbedding)
+    t = tiered_embedding(V, D, device_rows=BUDGET)
+    assert isinstance(t, TieredTable)
+    assert t.device_rows == BUDGET
+
+
+def test_factory_resolves_env_knobs(monkeypatch):
+    _init()
+    monkeypatch.setenv("PS_EMBED_DEVICE_ROWS", str(BUDGET))
+    monkeypatch.setenv("PS_EMBED_ADMIT_FREQ", "5")
+    monkeypatch.setenv("PS_EMBED_EVICT_TTL_MS", "1234")
+    monkeypatch.setenv("PS_EMBED_PREFETCH", "1")
+    t = tiered_embedding(V, D)
+    assert isinstance(t, TieredTable)
+    assert (t.device_rows, t.admit_freq, t.evict_ttl_ms,
+            t.prefetch_enabled) == (BUDGET, 5, 1234, True)
+    monkeypatch.setenv("PS_EMBED_DEVICE_ROWS", "0")
+    assert isinstance(tiered_embedding(V, D), SparseEmbedding)
+
+
+def test_config_carries_tier_knobs(monkeypatch):
+    from ps_tpu.config import Config
+
+    monkeypatch.setenv("PS_EMBED_DEVICE_ROWS", "512")
+    monkeypatch.setenv("PS_EMBED_ADMIT_FREQ", "3")
+    monkeypatch.setenv("PS_EMBED_EVICT_TTL_MS", "9000")
+    monkeypatch.setenv("PS_EMBED_PREFETCH", "true")
+    cfg = Config.from_env()
+    assert (cfg.embed_device_rows, cfg.embed_admit_freq,
+            cfg.embed_evict_ttl_ms, cfg.embed_prefetch) == (512, 3, 9000,
+                                                            True)
+    with pytest.raises(ValueError):
+        Config(embed_device_rows=-1)
+    with pytest.raises(ValueError):
+        Config(embed_admit_freq=0)
+    with pytest.raises(ValueError):
+        Config(embed_evict_ttl_ms=-5)
+
+
+# -- core contracts -----------------------------------------------------------
+
+
+def test_all_hot_stream_bitwise_parity():
+    """A stream confined to the resident hot set (admission never
+    fires): the device tier must be BITWISE what an untiered table of
+    the same rows computes — the non-negotiable."""
+    t = _make(admit_freq=1 << 30)
+    u = _make_untiered(rows=BUDGET)
+    for ids, grads in _stream(12, hi=BUDGET):
+        t.push(ids, grads)
+        u.push(ids, grads)
+    np.testing.assert_array_equal(np.asarray(t.hot.table),
+                                  np.asarray(u.table))
+    assert t.promotions == 0 and t.evictions == 0
+
+
+def test_mixed_stream_matches_untiered_oracle():
+    """Hot and cold ids interleaved with admission/eviction churn: every
+    logical row must end at the value the all-on-device run computes
+    from the identical stream (one apply rule on both tiers), with the
+    hot rows bitwise."""
+    t = _make(admit_freq=2)
+    u = _make_untiered()
+    for ids, grads in _stream(20):
+        t.push(ids, grads)
+        u.push(ids, grads)
+    assert t.promotions > 0 and t.evictions > 0  # churn actually ran
+    got = np.asarray(t.pull(np.arange(V, dtype=np.int32)))
+    exp = np.asarray(u.table)[:V]
+    hot_ids = t.slot_to_id[t.slot_to_id >= 0]
+    np.testing.assert_array_equal(got[hot_ids], exp[hot_ids])
+    np.testing.assert_allclose(got, exp, rtol=1e-6, atol=1e-7)
+
+
+@pytest.mark.parametrize("optimizer", ["sgd", "adagrad", "adam"])
+def test_state_travels_with_row_both_directions(optimizer):
+    """The what-moves-with-a-row contract: per-row optimizer state rides
+    every promotion and demotion. If a move dropped state, a stateful
+    rule (adagrad/adam) would diverge from the untiered oracle on the
+    rows that churned."""
+    t = _make(optimizer, admit_freq=2, learning_rate=0.1)
+    u = _make_untiered(optimizer, learning_rate=0.1)
+    # hammer one cold id so it accumulates state, promotes, keeps
+    # accumulating, then gets demoted by pressure from other admissions
+    hot_id = np.int32(BUDGET + 1)
+    for step, (ids, grads) in enumerate(_stream(24)):
+        if step % 2:
+            ids = ids.copy()
+            ids[0] = hot_id
+        t.push(ids, grads)
+        u.push(ids, grads)
+    got = np.asarray(t.pull(np.arange(V, dtype=np.int32)))
+    exp = np.asarray(u.table)[:V]
+    np.testing.assert_allclose(got, exp, rtol=1e-5, atol=1e-6)
+
+
+def test_row_sum_conservation_under_ttl_churn():
+    """TTL demotion + CLOCK eviction: zero rows lost — the f64 row sum
+    over both tiers tracks the untiered oracle exactly."""
+    t = _make(admit_freq=1, evict_ttl_ms=1)
+    u = _make_untiered()
+    for ids, grads in _stream(16):
+        t.push(ids, grads)
+        u.push(ids, grads)
+        time.sleep(0.002)  # age resident rows past the TTL horizon
+    assert t.evictions > 0
+    ref = float(np.asarray(u.table)[:V].astype(np.float64).sum())
+    assert np.isclose(t.row_sum(), ref, rtol=1e-9, atol=1e-6)
+
+
+def test_pull_splits_without_directory_mutation():
+    t = _make()
+    before = (t.tier.copy(), t.slot.copy(), t.freq.copy(), t.ref.copy())
+    ids = np.array([0, BUDGET + 3, 5, V - 1, 0], np.int32)
+    rows = np.asarray(t.pull(ids))
+    full = _table0()
+    np.testing.assert_allclose(rows, full[ids], rtol=1e-6)
+    for a, b in zip(before, (t.tier, t.slot, t.freq, t.ref)):
+        np.testing.assert_array_equal(a, b)  # READ stays side-effect-free
+    assert t.hot_hits == 3 and t.misses == 2
+
+
+def test_prefetch_staged_slab_matches_inline_path():
+    """The prefetch overlap must be invisible to the math: a staged
+    DRAM gather consumed by the next push yields the same table as the
+    inline gather, and a stale slab (tier moves landed first) is
+    discarded, never served."""
+    t = _make(prefetch=True)
+    u = _make(prefetch=False)
+    for ids, grads in _stream(10):
+        t.prefetch(ids)
+        t._prefetch_pool.shutdown(wait=True)  # deterministic: gather done
+        t._prefetch_pool = None
+        t.push(ids, grads)
+        u.push(ids, grads)
+    got = np.asarray(t.pull(np.arange(V, dtype=np.int32)))
+    exp = np.asarray(u.pull(np.arange(V, dtype=np.int32)))
+    np.testing.assert_array_equal(got, exp)
+    assert t.prefetch_hits > 0
+
+
+def test_tier_stats_shape():
+    t = _make()
+    for ids, grads in _stream(6):
+        t.push(ids, grads)
+    st = t.tier_stats()
+    assert st["device_rows"] == BUDGET and st["total_rows"] == V
+    assert st["hot_rows"] == BUDGET
+    assert st["hot_hits"] + st["misses"] > 0
+    assert 0.0 <= st["hit_rate"] <= 1.0
+    assert st["promotions"] == t.promotions
+    assert len(t.drain_cold_gather()) > 0
+    assert t.drain_cold_gather() == []  # drained
+
+
+# -- seam 1: replication — move-log replay is bitwise ------------------------
+
+
+def test_move_log_replay_reproduces_directory_bitwise():
+    """The replica determinism contract at the table level: a backup
+    replaying the primary's recorded move log (never planning its own)
+    ends with a bitwise-identical directory AND hot table."""
+    prim = _make(admit_freq=2)
+    back = _make(admit_freq=2)
+    for ids, grads in _stream(20):
+        prim.push(ids, grads)
+        back.push(ids, grads, moves=prim.pop_moves())
+    assert prim.promotions > 0
+    for attr in ("tier", "slot", "freq", "ref", "slot_to_id"):
+        np.testing.assert_array_equal(
+            getattr(prim, attr), getattr(back, attr), err_msg=attr)
+    assert prim.hand == back.hand
+    np.testing.assert_array_equal(np.asarray(prim.hot.table),
+                                  np.asarray(back.hot.table))
+    np.testing.assert_array_equal(prim.arena, back.arena)
+
+
+def test_failover_drill_backup_directory_matches_primary():
+    """The seam through the service: the primary's _apply_push ships its
+    tier-move log on the replication stream; the backup's
+    _replica_apply replays it. After the drill the (promoted) backup's
+    tier directory is bitwise the dead primary's."""
+    from ps_tpu.backends.remote_sparse import SparsePSService
+
+    _init()
+
+    def mk():
+        t = TieredTable(V, D, optimizer="adagrad", device_rows=BUDGET,
+                        admit_freq=2)
+        t.init(_table0())
+        return t
+
+    prim_svc = SparsePSService({"emb": mk()}, bind="127.0.0.1")
+    back_svc = SparsePSService({"emb": mk()}, bind="127.0.0.1")
+    shipped = []
+    prim_svc._replicate = lambda op, w, tensors, meta: (
+        shipped.append((op, w, dict(tensors), dict(meta))) or None)
+    try:
+        for i, (ids, grads) in enumerate(_stream(15)):
+            prim_svc._apply_push(
+                0, {"emb": {"ids": ids, "grads": grads}},
+                extra={"pseq": i + 1, "pnonce": "n0", "pfan": [0]})
+        # replay the stream into the backup exactly as the replica
+        # dispatcher would (lock held, then promote)
+        for op, w, tensors, meta in shipped:
+            with back_svc._lock:
+                back_svc._replica_apply(op, w, tensors, meta)
+        prim, back = prim_svc._tables["emb"], back_svc._tables["emb"]
+        assert prim.promotions > 0  # the drill exercised admission
+        for attr in ("tier", "slot", "freq", "ref", "slot_to_id"):
+            np.testing.assert_array_equal(
+                getattr(prim, attr), getattr(back, attr), err_msg=attr)
+        assert prim.hand == back.hand
+        np.testing.assert_array_equal(np.asarray(prim.hot.table),
+                                      np.asarray(back.hot.table))
+        np.testing.assert_array_equal(prim.arena, back.arena)
+        assert back_svc.versions == prim_svc.versions
+    finally:
+        prim_svc.stop()
+        back_svc.stop()
+
+
+# -- seam 2: checkpoint — both tiers, one atomic snapshot --------------------
+
+
+def test_save_restore_reproduces_directory_and_both_arenas(tmp_path):
+    t = _make(admit_freq=2)
+    for ids, grads in _stream(14):
+        t.push(ids, grads)
+    assert t.promotions > 0
+    t.save(str(tmp_path / "ck"))
+    ref_rows = np.asarray(t.pull(np.arange(V, dtype=np.int32)))
+
+    t2 = _make(admit_freq=2)  # fresh placement, then restore over it
+    t2.restore(str(tmp_path / "ck"))
+    for attr in ("tier", "slot", "freq", "ref", "last_ms",
+                 "slot_to_id"):
+        np.testing.assert_array_equal(
+            getattr(t, attr), getattr(t2, attr), err_msg=attr)
+    assert (t2.hand, t2.dir_gen) == (t.hand, t.dir_gen)
+    assert t2.push_count == t.push_count  # version streams resume
+    np.testing.assert_array_equal(np.asarray(t.hot.table),
+                                  np.asarray(t2.hot.table))
+    np.testing.assert_array_equal(t.arena, t2.arena)
+    for a, b in zip(t.cold_state, t2.cold_state):
+        np.testing.assert_array_equal(a, b)  # cold optimizer state too
+    np.testing.assert_array_equal(
+        ref_rows, np.asarray(t2.pull(np.arange(V, dtype=np.int32))))
+    # the restored table keeps training identically to the original
+    ids, grads = _stream(1, seed=9)[0]
+    t.push(ids, grads)
+    t2.push(ids, grads, moves=t.pop_moves())
+    np.testing.assert_array_equal(
+        np.asarray(t.pull(np.arange(V, dtype=np.int32))),
+        np.asarray(t2.pull(np.arange(V, dtype=np.int32))))
+
+
+def test_restore_rejects_mismatched_geometry(tmp_path):
+    t = _make()
+    t.save(str(tmp_path / "ck"))
+    _init()
+    other = TieredTable(V, D, optimizer="adagrad",
+                        device_rows=BUDGET * 2)
+    other.init(_table0())
+    with pytest.raises(ValueError, match="geometry"):
+        other.restore(str(tmp_path / "ck"))
+    u = _make_untiered()
+    u.save(str(tmp_path / "ck2"))
+    with pytest.raises(ValueError, match="engine"):
+        t.restore(str(tmp_path / "ck2"))
+
+
+def test_push_mid_pause_parks_promotion_never_splits_snapshot(tmp_path):
+    """The atomicity drill: a push (whose admission would promote a
+    row) lands while the coordinated pause holds — it must PARK until
+    resume, so the snapshot sees the pre-push directory on BOTH tiers
+    and the promotion happens wholly after."""
+    from ps_tpu.backends.remote_sparse import SparsePSService
+
+    _init()
+    t = TieredTable(V, D, optimizer="adagrad", device_rows=BUDGET,
+                    admit_freq=1)  # first touch of a cold id promotes
+    t.init(_table0())
+    svc = SparsePSService({"emb": t}, bind="127.0.0.1")
+    try:
+        warm = _stream(3)
+        for i, (ids, grads) in enumerate(warm):
+            svc._apply_push(0, {"emb": {"ids": ids, "grads": grads}},
+                            extra={"pseq": i + 1, "pnonce": "n0",
+                                   "pfan": [0]})
+        kind, _, _, ex = tv.decode(svc._checkpoint(0, {"phase": "pause"}))
+        assert kind == tv.OK
+        token = ex["token"]
+        pre = {a: getattr(t, a).copy()
+               for a in ("tier", "slot", "freq", "slot_to_id")}
+        pre_gen = t.dir_gen
+
+        cold_id = int(np.flatnonzero(t.tier == 0)[0])
+        applied = threading.Event()
+
+        def late_push():
+            svc._apply_push(
+                0, {"emb": {"ids": np.array([cold_id], np.int32),
+                            "grads": np.ones((1, D), np.float32)}},
+                extra={"pseq": len(warm) + 1, "pnonce": "n0",
+                       "pfan": [0]})
+            applied.set()
+
+        th = threading.Thread(target=late_push, daemon=True)
+        th.start()
+        assert not applied.wait(0.4)  # parked on the pause condition
+        assert t.dir_gen == pre_gen  # no half-promotion leaked in
+        kind, _, _, ex = tv.decode(svc._checkpoint(0, {
+            "phase": "save", "token": token,
+            "dir": str(tmp_path / "ck")}))
+        assert kind == tv.OK
+        kind, _, _, _ = tv.decode(svc._checkpoint(0, {
+            "phase": "resume", "token": token}))
+        assert kind == tv.OK
+        assert applied.wait(10.0)  # the parked push lands after resume
+        th.join(10.0)
+        assert t.tier[cold_id] == 1  # ... and its promotion with it
+
+        # the snapshot holds the PRE-push state of both tiers + the
+        # directory: the promotion is wholly outside it
+        _init()
+        t2 = TieredTable(V, D, optimizer="adagrad", device_rows=BUDGET,
+                         admit_freq=1)
+        t2.init(_table0())
+        t2.restore(str(tmp_path / "ck" / "emb"))
+        for a, v in pre.items():
+            np.testing.assert_array_equal(v, getattr(t2, a), err_msg=a)
+        assert t2.tier[cold_id] == 0  # never split across the snapshot
+    finally:
+        svc.stop()
+
+
+# -- service surface ----------------------------------------------------------
+
+
+def test_service_stats_and_invalidation_carry_tier_state():
+    from ps_tpu.backends.remote_sparse import SparsePSService
+
+    _init()
+    t = TieredTable(V, D, optimizer="adagrad", device_rows=BUDGET,
+                    admit_freq=2)
+    t.init(_table0())
+    svc = SparsePSService({"emb": t}, bind="127.0.0.1")
+    try:
+        for i, (ids, grads) in enumerate(_stream(10)):
+            svc._apply_push(0, {"emb": {"ids": ids, "grads": grads}},
+                            extra={"pseq": i + 1, "pnonce": "n0",
+                                   "pfan": [0]})
+        kind, _, _, ex = tv.decode(svc._handle(tv.STATS, 0, {}, {}))
+        assert kind == tv.OK
+        st = ex["tier"]["emb"]
+        assert st["device_rows"] == BUDGET
+        assert st["promotions"] > 0
+        assert st["hit_rate"] is not None
+        # the cold-path histogram family got fed through the drain
+        quant = svc.transport.latency_quantiles()
+        assert quant["cold_gather_s"]["count"] > 0
+        # move logs were harvested per push, not left accumulating
+        assert t.last_moves == {"ops": [], "hand": None}
+    finally:
+        svc.stop()

@@ -464,3 +464,48 @@ print(f"  speedup {det['speedup_x']}x at table/batch "
       f"({m['ratio']}x)")
 print("sparse fused-apply smoke OK")
 EOF
+
+# 9. tiered embedding storage (<60 s): one Wide-&-Deep-shaped zipf
+# push/read stream against a TieredTable 4x its device budget vs the
+# identical stream untiered (README "Tiered embedding storage") —
+# asserts the two non-negotiables (all-hot-path bitwise parity, zero
+# rows lost across admission/eviction churn) plus a host-scaled
+# throughput floor. ROADMAP's >=70% is the TPU hardware acceptance;
+# the CI bar is looser because the 2-core host pays python directory
+# overhead per push that HBM/DRAM bandwidth asymmetry dwarfs on metal.
+out=$(timeout -k 10 180 env JAX_PLATFORMS=cpu python bench.py --model tiered --quick 2>/dev/null | tail -1)
+python - "$out" <<'EOF'
+import json
+import sys
+
+rec = json.loads(sys.argv[1])
+assert rec["metric"] == "tiered_rows_applied_per_s", rec["metric"]
+det = rec["detail"]
+# the non-negotiable: a stream confined to the resident hot set must
+# leave the device tier bitwise-equal to an untiered table
+assert det["allhot_parity_bitwise"], \
+    "tiered all-hot path diverged bitwise from the untiered table"
+# zero rows lost across promotion/demotion churn: every logical row
+# must match the untiered oracle's value (f64 row-sum audit)
+assert det["rowsum_conserved"], \
+    f"rows lost/corrupted across tier churn: rel err {det['rowsum_rel_err']}"
+assert det["table_to_budget_x"] == 4, det["table_to_budget_x"]
+# the host-scaled CI floor: measured ~1.3x on the 2-core host (the
+# tiered device table is 4x smaller, which CPU likes); 0.5 leaves
+# room for scheduler noise while still catching a serialized cold path
+assert det["throughput_ratio"] >= 0.5, \
+    f"tiered throughput {det['throughput_ratio']}x under the CI floor"
+assert det["hot_hit_rate"] and det["hot_hit_rate"] > 0.5, \
+    f"zipf stream should mostly hit the hot set: {det['hot_hit_rate']}"
+assert det["promotions_per_1k"] > 0, "admission never fired"
+assert det["evictions_per_1k"] > 0, "eviction never fired"
+for kind, rps in det["rows_applied_per_s"].items():
+    print(f"  {kind:>6}: {rps:>12,.0f} rows/s")
+print(f"  ratio {det['throughput_ratio']}x at table/budget "
+      f"{det['table_to_budget_x']}x; hot-hit {det['hot_hit_rate']}; "
+      f"promotions/1k {det['promotions_per_1k']}, evictions/1k "
+      f"{det['evictions_per_1k']}; all-hot bitwise="
+      f"{det['allhot_parity_bitwise']}, rows conserved="
+      f"{det['rowsum_conserved']}")
+print("tiered embedding smoke OK")
+EOF

@@ -58,6 +58,7 @@ COLS = [
     ("loop", 10), ("nlp99", 8), ("qw99", 8), ("padm%", 6), ("reads", 8),
     ("nhit%", 6),
     ("chit%", 6), ("rshare%", 7), ("tier", 6), ("rows", 9), ("sap99", 8),
+    ("hot%", 6), ("evict", 7),
 ]
 
 COORD_COLS = [
@@ -136,7 +137,8 @@ def render_row(st: dict) -> dict:
                 "ack_p99_ms": "-", "bkt_p99_ms": "-", "loop": "-",
                 "nlp99": "-", "qw99": "-", "padm%": "-",
                 "reads": "-", "nhit%": "-", "chit%": "-",
-                "rshare%": "-", "tier": "-", "rows": "-", "sap99": "-"}
+                "rshare%": "-", "tier": "-", "rows": "-", "sap99": "-",
+                "hot%": "-", "evict": "-"}
     repl = st.get("repl") or {}
     # a live session renders "<ack mode>@<acked seq>" so an operator sees
     # the stream advancing between refreshes; degraded wins the cell
@@ -206,7 +208,37 @@ def render_row(st: dict) -> dict:
         "rows": (st["fused"].get("rows_applied", "-")
                  if isinstance(st.get("fused"), dict) else "-"),
         "sap99": _opt(_p99_ms(st, "sparse_apply_s")),
+        # tiered embedding storage (README "Tiered embedding storage"):
+        # hot-set hit share across the shard's tiered tables and its
+        # promotion/eviction churn ("-" = every table fully on device)
+        "hot%": _hot_pct(st),
+        "evict": _tier_churn(st),
     }
+
+
+def _hot_pct(st: dict):
+    """Aggregate hot-hit share over the shard's tiered tables ("-" = no
+    tiered tables, or nothing pushed/read yet)."""
+    tier = st.get("tier")
+    if not isinstance(tier, dict) or not tier:
+        return "-"
+    hits = sum(t.get("hot_hits", 0) for t in tier.values())
+    total = hits + sum(t.get("misses", 0) for t in tier.values())
+    if not total:
+        return "-"
+    return f"{100.0 * hits / total:.1f}"
+
+
+def _tier_churn(st: dict):
+    """Promotion/eviction totals as ``<p>/<e>`` — the operator's glance
+    at admission churn (a figure climbing every refresh means the hot
+    set is thrashing and the budget or admit threshold is wrong)."""
+    tier = st.get("tier")
+    if not isinstance(tier, dict) or not tier:
+        return "-"
+    p = sum(t.get("promotions", 0) for t in tier.values())
+    e = sum(t.get("evictions", 0) for t in tier.values())
+    return f"{p}/{e}"
 
 
 def _fused_tier(st: dict):
