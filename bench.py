@@ -1338,6 +1338,7 @@ def bench_serve(args, retried: bool):
         "entries": cs["entries"], "bytes": cs["bytes"],
         "puts": cs["puts"], "rejects": cs["rejects"],
         "invalidations": cs["invalidations"], "floor": cs["floor"],
+        "cond_hits": cs["cond_hits"],
     }
     nmax = reader_counts[-1]
     detail["read_scaling"] = round(
@@ -1416,6 +1417,128 @@ def bench_serve(args, retried: bool):
     stop.set()
     pt.join(timeout=10)
     pusher.close()
+
+    # -- leg C: conditional & delta reads (README "Read path") ----------------
+    # zipfian sparse readers, each revalidating its own hot id-set while
+    # a background pusher churns a few rows: with PS_READ_CONDITIONAL off
+    # every warm read refetches the full row payload; on, warm reads are
+    # NOT_MODIFIED handshakes or row deltas (only the rows the pusher
+    # touched). Reported: bytes/read and reads/s off vs on, cold (first
+    # fetch — always the full payload) vs warm (repeats).
+    from ps_tpu.backends.remote_sparse import SparsePSService, connect_sparse
+    from ps_tpu.kv.sparse import SparseEmbedding
+
+    cV, cD = (2048, 32) if args.quick else (8192, 64)
+    cset = 192 if args.quick else 256
+    cwin = 1.5 if args.quick else 3.0
+    cn = reader_counts[0]
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:1]), ("data",))
+    cemb = SparseEmbedding(cV, cD, optimizer="sgd", learning_rate=0.1,
+                           mesh=mesh)
+    cemb.init(np.random.default_rng(0)
+              .normal(0, 0.02, (cV, cD)).astype(np.float32))
+    csvc = SparsePSService({"emb": cemb}, native_loop=True)
+    curi = f"127.0.0.1:{csvc.port}"
+    crng = np.random.default_rng(11)
+    # zipfian hot sets: readers share head ids, diverge in the tail
+    id_sets = [np.unique(np.minimum(crng.zipf(1.3, size=cset) - 1,
+                                    cV - 1)).astype(np.int32)
+               for _ in range(cn)]
+
+    def cpush_loop(stop):
+        w = connect_sparse(curi, 1, {"emb": (cV, cD)})
+        try:
+            prng = np.random.default_rng(13)
+            while not stop.is_set():
+                ids = prng.integers(0, cV, size=8).astype(np.int32)
+                w.push({"emb": (ids,
+                                prng.normal(size=(8, cD))
+                                .astype(np.float32) * 1e-3)})
+                stop.wait(0.1)
+        finally:
+            w.close()
+
+    def run_cond_leg(conditional):
+        old = os.environ.get("PS_READ_CONDITIONAL")
+        os.environ["PS_READ_CONDITIONAL"] = "1" if conditional else "0"
+        try:
+            readers = [connect_sparse(curi, 0, {"emb": (cV, cD)})
+                       for _ in range(cn)]
+        finally:
+            if old is None:
+                os.environ.pop("PS_READ_CONDITIONAL", None)
+            else:
+                os.environ["PS_READ_CONDITIONAL"] = old
+        stop = threading.Event()
+        pt = threading.Thread(target=cpush_loop, args=(stop,), daemon=True)
+        pt.start()
+        counts = [0] * cn
+        cold = [0] * cn
+        warm = [0] * cn
+        errs = []
+
+        def reader(j):
+            try:
+                w = readers[j]
+                req = {"emb": id_sets[j]}
+                b0 = w.bytes_pulled
+                w.read_rows(req)  # cold: always the full payload
+                cold[j] = w.bytes_pulled - b0
+                b1 = w.bytes_pulled
+                t_end = time.time() + cwin
+                while time.time() < t_end:
+                    w.read_rows(req)
+                    counts[j] += 1
+                warm[j] = w.bytes_pulled - b1
+            except BaseException as e:
+                errs.append(e)
+
+        threads = [threading.Thread(target=reader, args=(j,), daemon=True)
+                   for j in range(cn)]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=60)
+        stop.set()
+        pt.join(timeout=10)
+        for w in readers:
+            w.close()
+        if errs:
+            raise errs[0]
+        reads = sum(counts)
+        return {
+            "reads_per_s": round(reads / max(time.time() - t0, 1e-9), 1),
+            "cold_bytes_per_read": round(sum(cold) / cn, 1),
+            "warm_bytes_per_read": round(sum(warm) / max(reads, 1), 1),
+        }
+
+    cond_off = run_cond_leg(conditional=False)
+    cond_on = run_cond_leg(conditional=True)
+    # parity: the revalidated view IS the full pull, bitwise
+    os.environ["PS_READ_CONDITIONAL"] = "1"
+    try:
+        pw = connect_sparse(curi, 0, {"emb": (cV, cD)})
+        try:
+            got = pw.read_rows({"emb": id_sets[0]})
+            got = pw.read_rows({"emb": id_sets[0]})  # revalidated
+            want = pw.pull({"emb": id_sets[0]})
+            parity = bool(np.array_equal(np.asarray(got["emb"]),
+                                         np.asarray(want["emb"])))
+        finally:
+            pw.close()
+    finally:
+        os.environ.pop("PS_READ_CONDITIONAL", None)
+    crd = csvc.replica_state().get("read") or {}
+    detail["conditional_read"] = {
+        "off": cond_off, "on": cond_on, "parity": parity,
+        "warm_bytes_ratio": round(
+            cond_off["warm_bytes_per_read"]
+            / max(cond_on["warm_bytes_per_read"], 1e-9), 2),
+        "not_modified": crd["nm"],
+        "delta_rows": crd["delta_rows"],
+    }
+    csvc.stop()
 
     # -- staleness drill: a replica beyond the bound serves NOTHING -----------
     # the unattached backup froze at version 0; the primary is versions

@@ -440,11 +440,22 @@ class AggregatorService(VanService):
                 # a snapshot stamped newer than its bytes would park
                 # stale rows in members' version-keyed caches
                 params, version = self._client.read_all_versioned()
-                kv, _ = keymod.flatten_with_keys(params)
-                snap = {"round": rid,
-                        "kv": {k: np.ascontiguousarray(np.asarray(v))
-                               for k, v in kv.items()},
-                        "version": version}
+                with self._pcv:
+                    prev = self._pull_snap
+                if prev is not None \
+                        and int(prev["version"]) == int(version):
+                    # upstream unchanged since the held snapshot (the
+                    # client's conditional read proved it with a
+                    # NOT_MODIFIED handshake): re-stamp the round and
+                    # keep the bytes — no re-flatten, no tree copy
+                    snap = {"round": rid, "kv": prev["kv"],
+                            "version": int(version)}
+                else:
+                    kv, _ = keymod.flatten_with_keys(params)
+                    snap = {"round": rid,
+                            "kv": {k: np.ascontiguousarray(np.asarray(v))
+                                   for k, v in kv.items()},
+                            "version": version}
             except BaseException:
                 with self._pcv:
                     self._pull_fetching = False
@@ -458,14 +469,26 @@ class AggregatorService(VanService):
                 self._pcv.notify_all()
                 return self._pull_snap
 
-    def _read_payload(self) -> bytes:
+    def _read_payload(self, extra=None) -> bytes:
         """Member READs (README "Read path") serve the group's coalesced
         snapshot — one upstream fetch per round however many members
         read — and publish into the native read cache: the generation is
         captured BEFORE the fetch, so a merged round committing mid-read
-        refuses the stale publish at the floor."""
+        refuses the stale publish at the floor. A conditional READ
+        (``extra["cond"]``) at or past the snapshot's version gets a
+        NOT_MODIFIED stamp instead of the tree."""
         gen = self._read_gen_snapshot()
         snap = self._coalesced_pull()
+        cond = None
+        if isinstance(extra, dict) and extra.get("cond") is not None:
+            cond = int(extra["cond"])
+        if cond is not None and int(snap["version"]) <= cond:
+            reply = tv.encode(tv.NOT_MODIFIED, 0, None,
+                              extra={"version": int(snap["version"])})
+            self._note_read_snapshot(gen, int(snap["version"]))
+            self.transport.record_read_served()
+            self.transport.record_read_not_modified()
+            return reply
         reply = tv.encode(tv.OK, 0, snap["kv"],
                           extra={"version": snap["version"]})
         self._note_read_snapshot(gen, int(snap["version"]))
@@ -507,7 +530,7 @@ class AggregatorService(VanService):
         elif kind == tv.PULL:
             return self._params_reply(worker, self._coalesced_pull())
         elif kind == tv.READ:
-            return self._read_payload()
+            return self._read_payload(extra)
         elif kind == tv.PUSH:
             tree = self._decode_member_push(tensors, extra)
             r = self._agg_push(worker, tree, extra)

@@ -321,6 +321,33 @@ class AsyncPSService(VanService):
         self.transport.record_read_served()
         return reply
 
+    def _read_cond_reply(self, extra) -> bytes:
+        """Conditional READ front end (README "Read path"): when the
+        caller's known version (``extra["cond"]``) is current, a tiny
+        NOT_MODIFIED version stamp replaces the whole-subtree payload —
+        the steady-state revalidation of a read-mostly deployment.
+        Anything else (no cond, or a changed tree) delegates to
+        :meth:`_read_payload` unchanged. Deterministic like the full
+        path (fixed worker id 0), so byte-identical conditional requests
+        stay servable from the native read cache; the version floor the
+        native tier checks is exactly this comparison, compiled into the
+        cached entry at publish time."""
+        cond = None
+        if isinstance(extra, dict) and extra.get("cond") is not None:
+            cond = int(extra["cond"])
+        if cond is not None:
+            with self._engine._lock:
+                version = self._engine.version
+                gen = self._read_gen_snapshot()
+            if version <= cond:
+                reply = tv.encode(tv.NOT_MODIFIED, 0, None,
+                                  extra={"version": version})
+                self._note_read_snapshot(gen, version)
+                self.transport.record_read_served()
+                self.transport.record_read_not_modified()
+                return reply
+        return self._read_payload()
+
     def _read_version(self):
         return self._engine.version
 
@@ -750,7 +777,7 @@ class AsyncPSService(VanService):
         elif kind == tv.PULL:
             return self._params_payload(worker)
         elif kind == tv.READ:
-            return self._read_payload()
+            return self._read_cond_reply(extra)
         elif kind == tv.PUSH:
             rseq, dedup = self._apply_push(
                 worker, self._decode_push(tensors, extra), extra=extra)
@@ -2158,6 +2185,12 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         # plus the REPLICA_STATE probe on the heartbeat cadence.
         self.pull_cache = (env_flag("PS_PULL_CACHE", False)
                            if pull_cache is None else bool(pull_cache))
+        # revalidating cache: once a shard snapshot exists, refresh it
+        # with a CONDITIONAL read — the server answers NOT_MODIFIED
+        # (stamp only) when nothing changed since the snapshot, so a
+        # version-lag signal costs a handshake-sized reply instead of a
+        # full refetch. Off = every cache miss is a full READ.
+        self.read_conditional = env_flag("PS_READ_CONDITIONAL", True)
         self._read_cv = threading.Condition()
         # in-flight fetch records, one per shard: waiters hold the RECORD
         # and read the result out of it, so sharing needs no global
@@ -2324,7 +2357,18 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         and the rotation continues — the primary always qualifies, so a
         healthy shard can never fail the bound."""
         self.transport.record_read_cache(False)
-        payload = tv.encode(tv.READ, 0, None)
+        # revalidation: with a prior snapshot in hand, tell the server
+        # what we already have — an unchanged target answers
+        # NOT_MODIFIED (stamp only) and we keep our bytes
+        snap0 = None
+        if self.pull_cache and self.read_conditional:
+            with self._read_cv:
+                snap0 = self._read_snaps.get(i)
+        if snap0 is not None:
+            payload = tv.encode(tv.READ, 0, None,
+                                extra={"cond": int(snap0["version"])})
+        else:
+            payload = tv.encode(tv.READ, 0, None)
         members = self._replica_sets[i]
         primary = tuple(self._addrs[i])
         start = next(self._read_rr)
@@ -2348,6 +2392,28 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                 last = e
                 continue
             self._read_bad.pop(addr, None)
+            if kind == tv.NOT_MODIFIED and snap0 is not None:
+                # our snapshot is current as of the server's stamp; the
+                # BYTES we hold are at the snapshot version, which is at
+                # least the stamp (the server only answers NOT_MODIFIED
+                # when its version <= cond) — so the snapshot version is
+                # what the staleness predicate must judge
+                version = max(int(extra["version"]), int(snap0["version"]))
+                if addr != primary \
+                        and not self._read_fresh_enough(version, i):
+                    # a lagging replica's NOT_MODIFIED is refused exactly
+                    # like a lagging full reply would be
+                    self.transport.record_read_fallback()
+                    last = RuntimeError(
+                        f"replica {addr} NOT_MODIFIED at version "
+                        f"{version} exceeds the staleness bound "
+                        f"({self.versions[i]} known, "
+                        f"{self.read_staleness} allowed)")
+                    continue
+                if version > self.versions[i]:
+                    self.versions[i] = version
+                self.transport.record_read_route(replica=addr != primary)
+                return {"version": version, "kv": snap0["kv"]}
             if kind != tv.OK:
                 last = RuntimeError(str(extra.get("error")))
                 continue

@@ -504,25 +504,83 @@ class SparsePSService(VanService):
                                    extra={"versions": versions})
         return tv.encode(tv.OK, worker, out, extra={"versions": versions})
 
-    def _read_rows_payload(self, per_table) -> bytes:
+    def _read_rows_payload(self, per_table, extra=None) -> bytes:
         """Serve one READ (README "Read path"): side-effect-free row
         fetch, byte-deterministic for byte-identical requests (fixed
         worker id 0) — a hot id-set's reply is therefore shareable from
         the native read cache until any row apply invalidates it. The
         publish generation is captured under the table lock with the
-        rows, closing the publish-vs-apply race at the native floor."""
+        rows, closing the publish-vs-apply race at the native floor.
+
+        A conditional request (``extra["conds"]`` maps table -> the
+        caller's known per-table version) ships only changed bytes:
+        per table, the rows whose ``row_version`` stamp exceeds the
+        caller's version go out as a delta (``<table>/dids`` global ids
+        + ``<table>/drows``); when EVERY requested table is unchanged
+        for the caller the whole reply collapses to a NOT_MODIFIED
+        version stamp. A table the caller sent no cond for serves full
+        rows as before — mixed requests degrade per table, never
+        whole-request."""
+        conds = None
+        if isinstance(extra, dict) and isinstance(extra.get("conds"), dict):
+            conds = extra["conds"]
         out = {}
+        delta_rows = 0
         with self._lock:
-            for name, t in per_table.items():
-                ids = self._localize(name, t["ids"])
-                out[f"{name}/rows"] = np.asarray(self._tables[name].pull(ids))
             versions = dict(self.versions)
             gen = self._read_gen_snapshot()
+            for name, t in per_table.items():
+                v = conds.get(name) if conds is not None else None
+                if v is None:
+                    ids = self._localize(name, t["ids"])
+                    out[f"{name}/rows"] = np.asarray(
+                        self._tables[name].pull(ids))
+                    continue
+                v = int(v)
+                if int(versions[name]) <= v:
+                    continue  # provably unchanged: nothing to ship
+                emb = self._tables[name]
+                uids = np.unique(np.asarray(t["ids"], np.int64))
+                uids = uids[uids >= 0]
+                lids = self._localize(name, uids)
+                rv = getattr(emb, "row_version", None)
+                if rv is not None:
+                    changed = np.asarray(rv)[lids] > v
+                    uids, lids = uids[changed], lids[changed]
+                if uids.size == 0:
+                    continue  # stamp moved, the requested rows did not
+                out[f"{name}/dids"] = uids.astype(np.int64)
+                out[f"{name}/drows"] = np.asarray(emb.pull(lids))
+                delta_rows += int(uids.size)
+        vsum = self._vsum(versions)
+        if conds is not None and not out:
+            # every requested table unchanged for this caller: a tiny
+            # version-stamp frame — the steady-state revalidation reply
+            reply = tv.encode(tv.NOT_MODIFIED, 0, None,
+                              extra={"versions": versions,
+                                     "version": vsum})
+            self._note_read_snapshot(gen, vsum,
+                                     tags=self._tags_for(per_table,
+                                                         READ_TAG_CAP))
+            self.transport.record_read_served()
+            self.transport.record_read_not_modified()
+            return reply
+        if conds is not None:
+            reply = tv.encode(tv.OK, 0, out,
+                              extra={"versions": versions,
+                                     "version": vsum, "delta": 1})
+            self._note_read_snapshot(gen, vsum,
+                                     tags=self._tags_for(per_table,
+                                                         READ_TAG_CAP))
+            self.transport.record_read_served()
+            if delta_rows:
+                self.transport.record_read_delta_rows(delta_rows)
+            return reply
         reply = tv.encode(tv.OK, 0, out, extra={"versions": versions,
-                                                "version": self._vsum(versions)})
+                                                "version": vsum})
         # tag the publish with the id-set it covers, so a disjoint row
         # apply leaves the cached entry serving (per-key invalidation)
-        self._note_read_snapshot(gen, self._vsum(versions),
+        self._note_read_snapshot(gen, vsum,
                                  tags=self._tags_for(per_table,
                                                      READ_TAG_CAP))
         self.transport.record_read_served()
@@ -610,7 +668,7 @@ class SparsePSService(VanService):
         if kind == tv.HELLO:
             return tv.encode(tv.OK, worker, None, extra=self._hello_extra())
         elif kind == tv.READ:
-            return self._read_rows_payload(self._split(tensors))
+            return self._read_rows_payload(self._split(tensors), extra)
         elif kind == tv.ROW_PULL:
             return self._rows_payload(worker, self._split(tensors))
         elif kind == tv.ROW_PUSH:
@@ -1119,6 +1177,14 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         self.bytes_pulled = 0
         self.collective_bytes = 0
         self._bytes_lock = threading.Lock()
+        # revalidating read snapshots, ONE per server (README "Read
+        # path"): a repeat read_rows over the same id-set sends the
+        # versions it already holds and merges the server's row DELTA
+        # in place of a full refetch (NOT_MODIFIED = reuse as-is)
+        from ps_tpu.config import env_flag
+        self.read_conditional = env_flag("PS_READ_CONDITIONAL", True)
+        self._read_snaps: Dict[int, dict] = {}
+        self._read_lock = threading.Lock()
         spec = resolve_spec(compress)
         if spec is not None and spec.get("codec") == "topk":
             raise ValueError(
@@ -1320,16 +1386,106 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         hot id-sets are answered from the server's native read cache
         with zero upcalls on repeat (and by backup replicas, version-
         stamped for the staleness contract). Does not flush in-flight
-        cycles: a read observes whatever is committed when it lands."""
+        cycles: a read observes whatever is committed when it lands.
+
+        With ``PS_READ_CONDITIONAL`` (default on) a repeat read over
+        the same per-server id-set is CONDITIONAL: the request carries
+        the per-table versions of the rows already in hand, an
+        unchanged server answers NOT_MODIFIED (stamp only), and a
+        changed one ships a row DELTA — only rows whose per-row
+        version moved — merged into the held snapshot in place of a
+        full refetch."""
         reqs, routes = self._build_pull(requests)
         with self._op("read"):
             def once():
-                msgs = self._fanout({
-                    i: tv.encode(tv.READ, 0, t) for i, t in reqs.items()
-                })
-                return self._merge_rows(requests, routes, msgs)
+                payloads, snaps = {}, {}
+                for i, t in reqs.items():
+                    snap = None
+                    if self.read_conditional:
+                        sig = self._read_sig(t)
+                        with self._read_lock:
+                            cand = self._read_snaps.get(i)
+                        if cand is not None and cand["sig"] == sig:
+                            snap = cand
+                    if snap is not None:
+                        # "cond" LAST: the native loop's bounded tail
+                        # sniff keys the version-floor cache off the
+                        # final occurrence of the literal
+                        conds = {n: int(v)
+                                 for n, v in snap["conds"].items()}
+                        payloads[i] = tv.encode(
+                            tv.READ, 0, t,
+                            extra={"conds": conds,
+                                   "cond": int(sum(conds.values()))})
+                    else:
+                        payloads[i] = tv.encode(tv.READ, 0, t)
+                    snaps[i] = snap
+                msgs = self._fanout(payloads)
+                tensors = {i: self._revalidate(i, reqs[i], snaps[i], m)
+                           for i, m in msgs.items()}
+                return self._assemble_rows(requests, routes, tensors)
 
             return self._with_failover(once)
+
+    @staticmethod
+    def _read_sig(req: Dict[str, np.ndarray]) -> tuple:
+        """Hashable identity of one server's id-set: a snapshot only
+        revalidates the EXACT request it was built from."""
+        return tuple(sorted(
+            (k, np.asarray(v).tobytes()) for k, v in req.items()))
+
+    def _revalidate(self, i: int, req, snap, msg) -> Dict[str, np.ndarray]:
+        """Turn one server's conditional-read reply into full per-server
+        row tensors: NOT_MODIFIED reuses the snapshot, a delta reply
+        merges changed rows into a COPY of it (a concurrent reader of
+        the old snapshot never sees a torn merge), a full reply
+        replaces it. Updates the stored snapshot for the next read."""
+        kind, _, tensors, extra = tv.decode(msg)
+        if kind == tv.NOT_MODIFIED and snap is not None:
+            for name, v in (extra.get("versions") or {}).items():
+                self._versions[name][i] = int(v)
+            return snap["tensors"]
+        if kind != tv.OK:
+            raise self._reply_error(i, extra)
+        versions = extra.get("versions") or {}
+        for name, v in versions.items():
+            self._versions[name][i] = int(v)
+        out: Dict[str, np.ndarray] = {}
+        if extra.get("delta") and snap is not None:
+            for key in req:
+                name = key[: -len("/ids")]
+                rk = f"{name}/rows"
+                dk = f"{name}/dids"
+                if dk in tensors:
+                    ids = np.asarray(req[key], np.int64)
+                    dids = np.asarray(tensors[dk])  # unique, sorted
+                    drows = np.asarray(tensors[f"{name}/drows"])
+                    rows = np.array(snap["tensors"][rk])
+                    pos = np.nonzero(np.isin(ids, dids))[0]
+                    rows[pos] = drows[np.searchsorted(dids, ids[pos])]
+                    out[rk] = rows
+                elif rk in tensors:
+                    out[rk] = np.array(tensors[rk])
+                else:  # table unchanged since its cond: keep held rows
+                    out[rk] = snap["tensors"][rk]
+        else:
+            out = {k: np.array(v) for k, v in tensors.items()}
+        if self.read_conditional:
+            conds = {}
+            for key in req:
+                name = key[: -len("/ids")]
+                v = versions.get(name)
+                if v is None or f"{name}/rows" not in out:
+                    conds = None
+                    break
+                conds[name] = int(v)
+            if conds is not None:
+                with self._read_lock:
+                    self._read_snaps[i] = {
+                        "sig": self._read_sig(req),
+                        "conds": conds, "tensors": out,
+                    }
+        return out
 
     def _build_pull(self, requests):
         reqs: Dict[int, Dict[str, np.ndarray]] = {}
@@ -1343,6 +1499,10 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
 
     def _merge_rows(self, requests, routes, msgs) -> Dict[str, np.ndarray]:
         tensors = {i: self._check(i, m) for i, m in msgs.items()}
+        return self._assemble_rows(requests, routes, tensors)
+
+    def _assemble_rows(self, requests, routes, tensors
+                       ) -> Dict[str, np.ndarray]:
         out: Dict[str, np.ndarray] = {}
         for name, per_server in routes.items():
             n = int(np.asarray(requests[name]).reshape(-1).shape[0])

@@ -974,6 +974,12 @@ class VanService:
                 "native_hits": self.transport.read_native_hits,
                 "native_misses": self.transport.read_native_misses,
                 "entries": self.transport.read_cache_entries,
+                # conditional reads: NOT_MODIFIED replies served (pump),
+                # delta rows shipped, and native version-floor hits —
+                # ps_top's nm% column sums pump NMs + native cond hits
+                "nm": self.transport.read_not_modified,
+                "delta_rows": self.transport.read_delta_rows,
+                "native_cond_hits": self.transport.read_native_cond_hits,
             }
         if self._nloop is not None:
             # native event-loop serve path: live connections + frames
@@ -1318,7 +1324,7 @@ class VanService:
                     cs = nloop.cache_stats()
                     self.transport.set_read_cache_stats(
                         cs["hits"], cs["misses"], cs["entries"],
-                        cs["bytes"])
+                        cs["bytes"], cond_hits=cs.get("cond_hits", 0))
                     self._read_hits_gauge.set(cs["hits"])
                     self._read_miss_gauge.set(cs["misses"])
                     v = self._read_version()
@@ -1591,9 +1597,26 @@ class VanService:
                     # bytes — hit replies are bitwise identical to this
                     # pump reply BY CONSTRUCTION (the cache only echoes).
                     # A put raced by an apply is refused at the floor.
-                    if nloop.cache_put(raw, reply, gen,
-                                       tags=getattr(self._read_pub,
-                                                    "tags", None)):
+                    # Three shapes: a NOT_MODIFIED reply publishes as a
+                    # version-floor entry (the request's cond digits are
+                    # excised native-side so revalidators at ANY version
+                    # >= the stamp share it); any OTHER reply to a
+                    # conditional request is version-dependent (a delta,
+                    # or a full payload for a lagging caller) and must
+                    # not park under a key later conditionals would
+                    # exact-match — skipped; unconditional replies keep
+                    # the exact-byte publish unchanged.
+                    tags = getattr(self._read_pub, "tags", None)
+                    if len(reply) >= 1 and reply[0] == tv.NOT_MODIFIED:
+                        if nloop.cache_put_cond(
+                                raw, reply, gen, tags=tags,
+                                vfloor=int(getattr(self._read_pub,
+                                                   "version", 0))):
+                            self._read_pub_version = int(
+                                getattr(self._read_pub, "version", 0))
+                    elif b'"cond":' in raw[-4096:]:
+                        pass  # conditional miss: reply is caller-specific
+                    elif nloop.cache_put(raw, reply, gen, tags=tags):
                         self._read_pub_version = int(
                             getattr(self._read_pub, "version", 0))
             try:

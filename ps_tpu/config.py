@@ -87,6 +87,11 @@ Environment variables honored by :meth:`Config.from_env`:
   cache: repeat reads at an unchanged version cost no wire round trip;
   version bumps ride decoded replies plus a REPLICA_STATE probe on the
   heartbeat cadence (default off)
+- ``PS_READ_CONDITIONAL``   — '0' disables version-predicated reads
+  (default on): with it on, a reader holding a snapshot sends the
+  version it knows, an unchanged target answers NOT_MODIFIED (stamp
+  only), and a changed sparse target ships a row DELTA — only rows
+  whose per-row version moved — instead of the full id-set
 - ``PS_CONNECT_MAX_WAIT_MS`` — total sleep budget of one
   ``Channel.connect`` dial's retry backoff (default 15000); read-path
   failover tuning turns it down so a dead replica costs milliseconds
@@ -371,6 +376,11 @@ class Config:
         round trip; version bumps piggyback on every reply the worker
         decodes plus a REPLICA_STATE probe on the heartbeat cadence.
         Off by default (explicit opt-in, like shm).
+      read_conditional: version-predicated serving (on by default):
+        readers holding a snapshot revalidate it with a conditional
+        READ — an unchanged target answers NOT_MODIFIED (stamp only)
+        and a changed sparse target ships only the rows whose per-row
+        version moved. Off = every refetch ships the full payload.
       push_native_admit: zero-upcall push plane (README "Push path"):
         'off' | 'on' | 'auto' (default auto = on wherever the native
         loop serves). The loop classifies steady-state push frames
@@ -555,6 +565,10 @@ class Config:
     native_read_cache_bytes: int = 64 << 20
     read_staleness: int = 0
     pull_cache: bool = False
+    # version-predicated serving: conditional READs, NOT_MODIFIED
+    # handshakes and sparse row deltas (on by default — turning it off
+    # restores unconditional full-payload reads everywhere)
+    read_conditional: bool = True
     # zero-upcall push plane (README "Push path"): native push admission
     # in the epoll loop — replay acks + role refusals answered with zero
     # upcalls, fresh pushes admission-stamped for the pump's apply.
@@ -915,6 +929,9 @@ class Config:
             kwargs["nl_slow_frame_ms"] = float(env["PS_NL_SLOW_FRAME_MS"])
         if "PS_PULL_CACHE" in env:
             kwargs["pull_cache"] = env_flag("PS_PULL_CACHE", False)
+        if "PS_READ_CONDITIONAL" in env:
+            kwargs["read_conditional"] = env_flag(
+                "PS_READ_CONDITIONAL", True)
         if "PS_PUSH_NATIVE_ADMIT" in env:
             # "" explicitly selects the auto default
             kwargs["push_native_admit"] = (

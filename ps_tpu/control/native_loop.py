@@ -127,6 +127,12 @@ def _lib():
         ctypes.c_void_p, ctypes.c_uint64,
         ctypes.POINTER(ctypes.c_uint64), ctypes.c_int,
     ]
+    lib.nl_cache_put_cond.restype = ctypes.c_int
+    lib.nl_cache_put_cond.argtypes = [
+        ctypes.c_void_p, ctypes.c_void_p, ctypes.c_uint64,
+        ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64,
+        ctypes.POINTER(ctypes.c_uint64), ctypes.c_int, ctypes.c_uint64,
+    ]
     lib.nl_admit_config.argtypes = [ctypes.c_void_p, ctypes.c_int]
     lib.nl_admit_put.restype = ctypes.c_int
     lib.nl_admit_put.argtypes = [
@@ -204,7 +210,7 @@ class NativeEventLoop:
         self._lens = (ctypes.c_uint64 * MAX_BATCH)()
         self._admits = (ctypes.c_uint64 * MAX_BATCH)()
         self._stats_out = (ctypes.c_uint64 * 6)()
-        self._cache_out = (ctypes.c_uint64 * 8)()
+        self._cache_out = (ctypes.c_uint64 * 9)()
         self._admit_out = (ctypes.c_uint64 * 8)()
         self._hist_out = (ctypes.c_uint64 * (4 + NL_HIST_BUCKETS))()
         self._nl_out = (ctypes.c_uint64 * 8)()
@@ -356,6 +362,34 @@ class NativeEventLoop:
         del kv, rv  # pinned the sources for exactly the call's duration
         return bool(ok)
 
+    def cache_put_cond(self, key: bytes, reply, gen: int, tags=None,
+                       vfloor: int = 0) -> bool:
+        """Publish one conditional (NOT_MODIFIED) reply for the
+        CONDITIONAL request bytes ``key``: the native side sniffs the
+        request's ``"cond":`` token, excises its digits, and stores the
+        spliced key with version floor ``vfloor`` (the server version the
+        reply stamps) — any later conditional request whose sniffed known
+        version >= ``vfloor`` is answered from this entry with zero
+        upcalls, exactly the pump's unchanged-target comparison. Floor
+        refusal, budget, eviction and ``tags`` semantics match
+        :meth:`cache_put`."""
+        kv = np.frombuffer(key, np.uint8)
+        rv = np.frombuffer(reply, np.uint8)
+        if not self._pin():
+            return False
+        try:
+            arr, n = None, 0
+            if tags:
+                arr = (ctypes.c_uint64 * len(tags))(*[int(t) for t in tags])
+                n = len(tags)
+            ok = self._lib.nl_cache_put_cond(
+                self._h, kv.ctypes.data, kv.nbytes, rv.ctypes.data,
+                rv.nbytes, int(gen), arr, n, int(vfloor))
+        finally:
+            self._unpin()
+        del kv, rv  # pinned the sources for exactly the call's duration
+        return bool(ok)
+
     def cache_invalidate(self, gen: int, tags=None) -> None:
         """Invalidation-on-apply: raise the publish floor to ``gen`` and
         drop cached entries — every entry when ``tags`` is None, else
@@ -378,19 +412,21 @@ class NativeEventLoop:
     def cache_stats(self) -> dict:
         """Cumulative cache counters: hits (zero-upcall replies), misses
         (cacheable frames that took the pump path), puts, rejects,
-        invalidations, live entries, bytes held, the invalidation
-        floor."""
+        invalidations, live entries, bytes held, the invalidation floor,
+        and cond_hits (the subset of hits served from a version-floor
+        NOT_MODIFIED entry)."""
         with self._lock:
             if self._closed:
                 return {"hits": 0, "misses": 0, "puts": 0, "rejects": 0,
                         "invalidations": 0, "entries": 0, "bytes": 0,
-                        "floor": 0}
+                        "floor": 0, "cond_hits": 0}
             self._lib.nl_cache_stats(self._h, self._cache_out)
             o = self._cache_out
             return {"hits": int(o[0]), "misses": int(o[1]),
                     "puts": int(o[2]), "rejects": int(o[3]),
                     "invalidations": int(o[4]), "entries": int(o[5]),
-                    "bytes": int(o[6]), "floor": int(o[7])}
+                    "bytes": int(o[6]), "floor": int(o[7]),
+                    "cond_hits": int(o[8])}
 
     # -- native push admission (zero-upcall push plane) ------------------------
 

@@ -116,6 +116,14 @@ COORD_TELEMETRY = 29  # -> coordinator: fleet telemetry query/report
 # (PS_READ_STALENESS) — the serving path of a read-dominated deployment.
 READ = 30       # dense: -> whole-subtree params + version;
 #                 sparse: {"<table>/ids"} -> {"<table>/rows"} + versions
+# conditional-read reply (README "Read path"): a READ carrying the
+# caller's known version ("cond"/"conds" in extra) whose target is
+# UNCHANGED gets this tiny version-stamp-only frame instead of the full
+# payload — the steady-state revalidation of a read-mostly deployment.
+# Deterministic like READ itself (fixed worker id 0, no side effects),
+# so byte-identical conditional requests stay servable from the native
+# read cache with zero upcalls.
+NOT_MODIFIED = 31  # -> reader: target unchanged since "cond"; stamp only
 
 #: human names per kind — span labels (ps_tpu/obs/trace.py), ps_top, and
 #: flight-recorder events all resolve through here so a new kind gets a
@@ -134,7 +142,7 @@ KIND_NAMES = {
     MIGRATE_OUT: "migrate_out", MIGRATE_BEGIN: "migrate_begin",
     MIGRATE_ROW: "migrate_row", MIGRATE_COMMIT: "migrate_commit",
     MIGRATE_ABORT: "migrate_abort", COORD_TELEMETRY: "coord_telemetry",
-    READ: "read",
+    READ: "read", NOT_MODIFIED: "not_modified",
 }
 
 

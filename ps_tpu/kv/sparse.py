@@ -119,6 +119,15 @@ class SparseEmbedding:
         self.collective_bytes = 0
         self.push_count = 0
         self.rows_pushed = 0
+        # per-row change stamps for the conditional read path (README
+        # "Read path"): row i's last-touching push, in push_count units —
+        # the same version the serving layer stamps on READ replies, so
+        # a caller's known version v selects the delta rows directly
+        # (row_version[i] > v == "changed since the caller's copy").
+        # Host-side np like the directory arrays; not checkpointed —
+        # restore stamps everything at push_count (conservatively "all
+        # changed"), which can only widen a delta, never lose a row.
+        self.row_version = np.zeros((num_rows,), np.int64)
         # a2a overflow counts: device scalars accumulate sync-free; reading
         # .dropped_rows materializes them (read at logging boundaries)
         self._dropped_base = 0
@@ -295,6 +304,11 @@ class SparseEmbedding:
 
     def push(self, ids, row_grads) -> None:
         """Send (ids, row_grads); server scatter-applies immediately."""
+        # change stamps from the caller's raw id list (before padding):
+        # every real row this push touches carries the post-increment
+        # push_count — see row_version in __init__
+        np_ids = np.asarray(ids, np.int64).reshape(-1)
+        touched = np_ids[(np_ids >= 0) & (np_ids < self.num_rows)]
         ids = jnp.asarray(ids, jnp.int32)
         row_grads = jnp.asarray(row_grads)
         if row_grads.shape != (ids.shape[0], self.dim):
@@ -328,6 +342,7 @@ class SparseEmbedding:
         self.record_dropped(dropped)
         self.bytes_pushed += row_grads.size * row_grads.dtype.itemsize
         self.push_count += 1
+        self.row_version[touched] = self.push_count
         self._account_push(ids.shape[0])
 
     def _account_push(self, n_ids: int) -> None:
@@ -438,6 +453,10 @@ class SparseEmbedding:
         self._table = arrays["table"]
         self._state = ckpt.unflatten_like(self._state, arrays["opt"])
         self.push_count = int(meta["push_count"])
+        # change stamps are not checkpointed: mark every row changed at
+        # the restored version — a conditional reader's delta can only
+        # widen to "everything", never miss a row
+        self.row_version[:] = self.push_count
         self.bytes_pushed = int(meta["bytes_pushed"])
         self.bytes_pulled = int(meta["bytes_pulled"])
         self.collective_bytes = int(meta["collective_bytes"])

@@ -245,6 +245,15 @@ class TieredTable:
         self.push_count = 0
         self.rows_pushed = 0
         self.dropped_rows = 0
+        # per-row change stamps over LOGICAL ids for the conditional read
+        # path (README "Read path"), in push_count units like
+        # SparseEmbedding.row_version. A tier move IS a change: demotions
+        # and promotions rewrite which buffer holds the authoritative
+        # bytes, so moved rows are stamped alongside the push's own ids —
+        # a reader's cached copy of a moved row revalidates instead of
+        # trusting a stale gather path. Not checkpointed; restore stamps
+        # everything at push_count (conservative, never loses a row).
+        self.row_version = np.zeros((num_rows,), np.int64)
 
     # -- placement -----------------------------------------------------------
 
@@ -355,6 +364,14 @@ class TieredTable:
         self._c_miss.inc(n_cold)
         self.bytes_pushed += grads.size * grads.dtype.itemsize
         self.push_count += 1
+        # change stamps at the post-increment count: the push's own rows
+        # plus every tier-move victim ("d"/"p" ops — ref clears touch no
+        # row bytes). Primary and backup replay identical move logs, so
+        # the stamps stay bitwise-equal across the replica set.
+        self.row_version[uids] = self.push_count
+        moved = [op[1] for op in (moves.get("ops") or []) if op[0] != "r"]
+        if moved:
+            self.row_version[np.asarray(moved, np.int64)] = self.push_count
         self.rows_pushed += int(valid.sum())
 
     def _push_cold(self, ids: np.ndarray, grads: np.ndarray) -> None:
@@ -721,6 +738,9 @@ class TieredTable:
         self.hand = int(meta["hand"])
         self.dir_gen = int(meta["dir_gen"])
         self.push_count = int(meta["push_count"])
+        # change stamps are not checkpointed: everything "changed" at the
+        # restored version (deltas widen, never lose rows)
+        self.row_version[:] = self.push_count
         self.rows_pushed = int(meta["rows_pushed"])
         self.bytes_pushed = int(meta["bytes_pushed"])
         self.bytes_pulled = int(meta["bytes_pulled"])

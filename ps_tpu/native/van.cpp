@@ -825,6 +825,15 @@ struct NlCacheEntry {
   // every tagged invalidation, so dense whole-tree replies and over-cap
   // id-sets stay exactly as conservative as before.
   std::vector<uint64_t> tags;
+  // conditional-read entries (nl_cache_put_cond): the key is the request
+  // body with the "cond" version DIGITS EXCISED, so readers at different
+  // known versions share one entry; vfloor is the server version the
+  // cached NOT_MODIFIED reply stamps — a sniffed request version v
+  // serves iff v >= vfloor (the exact comparison the pump would make,
+  // and entry liveness under invalidation-on-apply proves the state the
+  // floor was taken against is still current).
+  bool cond = false;
+  uint64_t vfloor = 0;
 };
 
 //: bounded tail window of the meta region the push-token sniff walks
@@ -888,6 +897,9 @@ struct NlLoop {
   std::atomic<int> cache_kind{-1};
   std::atomic<uint64_t> cache_hits{0}, cache_miss{0}, cache_puts{0},
       cache_rejects{0}, cache_invals{0};
+  // conditional-read hits: the subset of cache_hits answered from a
+  // version-floor entry (a NOT_MODIFIED revalidation served natively)
+  std::atomic<uint64_t> cache_cond_hits{0};
   // in-loop telemetry (see the NlHist block above): one stripe per loop
   // thread plus one shared by the pump/punted callers (index nthreads).
   // stats_on/slow_ns are read per frame with relaxed loads — toggling
@@ -1100,14 +1112,68 @@ bool nl_serve_bytes(NlLoop* l, NlThread& t, NlConn* c, const char* data,
   return true;
 }
 
+// Bounded token sniff for conditional READ frames: extract the caller's
+// known version (`"cond": <int>`) from the meta region without a JSON
+// parser — the same discipline as nl_admit_token below. The token lives
+// in `extra`, the LAST top-level meta region by the encoder contract,
+// and the encoders place "cond" LAST within extra, so the scan walks a
+// bounded TAIL window and takes the LAST occurrence (the sparse
+// per-table `"conds":` map cannot shadow it: its quoted key does not
+// match the `"cond":` literal). On success fills *v with the version
+// and *dlo/*dhi with the digit run's [start, end) BODY offsets — the
+// range both the serve-side lookup and the publish-side key excise, so
+// readers at different known versions share one spliced cache key.
+// Returns 0 when the frame carries no parseable token: the caller
+// treats the frame as unconditional (exact-match semantics only).
+int nl_cond_token(const char* body, uint64_t len, uint64_t* v,
+                  uint64_t* dlo, uint64_t* dhi) {
+  if (body == nullptr || len < 13) return 0;
+  uint64_t mlen;
+  memcpy(&mlen, body + 5, 8);
+  if (mlen > len - 13) return 0;
+  const char* meta = body + 13;
+  uint64_t lo = mlen > kNlAdmitScan ? mlen - kNlAdmitScan : 0;
+  static const char kCond[] = "\"cond\":";
+  const int64_t cl = (int64_t)sizeof(kCond) - 1;
+  int64_t ci = -1;
+  for (int64_t i = (int64_t)mlen - cl; i >= (int64_t)lo; --i) {
+    if (memcmp(meta + i, kCond, (size_t)cl) == 0) {
+      ci = i;
+      break;
+    }
+  }
+  if (ci < 0) return 0;
+  uint64_t i = (uint64_t)(ci + cl);
+  while (i < mlen && meta[i] == ' ') ++i;
+  uint64_t dstart = i;
+  if (i >= mlen || meta[i] < '0' || meta[i] > '9') return 0;
+  uint64_t val = 0;
+  for (; i < mlen && meta[i] >= '0' && meta[i] <= '9'; ++i) {
+    if (val > (~0ull - 9) / 10) return 0;  // implausible: not a token
+    val = val * 10 + (uint64_t)(meta[i] - '0');
+  }
+  *v = val;
+  *dlo = 13 + dstart;  // body offsets (13-byte header + meta offset)
+  *dhi = 13 + i;
+  return 1;
+}
+
 // Owner thread: answer one cacheable frame from the native read cache.
 // Returns true when the frame was SERVED (reply written or staged — the
 // caller frees the body and moves on); false = miss, queue it to Python
 // as usual (the strict fallback: anything the cache cannot answer takes
 // the pump path, so replies are bitwise identical by construction — the
-// cache only ever echoes buffers Python published).
+// cache only ever echoes buffers Python published). Two lookup shapes:
+// exact byte match (unconditional frames, and conditional repeats at
+// the very same known version), then — for frames carrying a "cond"
+// token — the version-floor path: the token's digits are excised from
+// the body and the spliced key looked up among conditional entries; a
+// sniffed version v at or above the entry's vfloor gets the cached
+// NOT_MODIFIED reply, byte-identical to what the pump would produce
+// (same comparison, and entry liveness proves the state unchanged).
 bool nl_cache_serve(NlLoop* l, NlThread& t, NlConn* c) {
   std::shared_ptr<NlCacheEntry> e;
+  bool cond_hit = false;
   {
     std::lock_guard<std::mutex> lock(l->cachemu);
     if (!l->cache_limit) return false;
@@ -1115,10 +1181,33 @@ bool nl_cache_serve(NlLoop* l, NlThread& t, NlConn* c) {
     auto it = l->cache.find(hv);
     if (it != l->cache.end()) {
       for (auto& cand : it->second) {
-        if (cand->key.size() == c->body_len &&
+        if (!cand->cond && cand->key.size() == c->body_len &&
             memcmp(cand->key.data(), c->body, c->body_len) == 0) {
           e = cand;
           break;
+        }
+      }
+    }
+    if (!e) {
+      uint64_t v = 0, dlo = 0, dhi = 0;
+      if (nl_cond_token(c->body, c->body_len, &v, &dlo, &dhi)) {
+        std::string spliced;
+        spliced.reserve(c->body_len - (dhi - dlo));
+        spliced.append(c->body, dlo);
+        spliced.append(c->body + dhi, c->body_len - dhi);
+        uint64_t hv2 = nl_cache_hash(spliced.data(), spliced.size());
+        auto it2 = l->cache.find(hv2);
+        if (it2 != l->cache.end()) {
+          for (auto& cand : it2->second) {
+            if (cand->cond && cand->key.size() == spliced.size() &&
+                memcmp(cand->key.data(), spliced.data(),
+                       spliced.size()) == 0 &&
+                v >= cand->vfloor) {
+              e = cand;
+              cond_hit = true;
+              break;
+            }
+          }
         }
       }
     }
@@ -1133,6 +1222,8 @@ bool nl_cache_serve(NlLoop* l, NlThread& t, NlConn* c) {
     return false;
   }
   l->cache_hits.fetch_add(1, std::memory_order_relaxed);
+  if (cond_hit)
+    l->cache_cond_hits.fetch_add(1, std::memory_order_relaxed);
   return true;
 }
 
@@ -2130,16 +2221,16 @@ void nl_cache_config(void* h, int kind, uint64_t max_bytes) {
   }
 }
 
-// Publish one reply with per-key invalidation tags: `tags`/`ntags` name
-// the state slice the reply covers (the sparse service hashes each
-// (table, row id) of the cached id-set) so nl_cache_invalidate_tags can
-// drop ONLY intersecting entries. ntags == 0 publishes an untagged entry
-// — the pre-tag behavior: dropped by every invalidation, tagged or not.
-// Everything else is nl_cache_put's contract (floor refusal, budget,
-// FIFO eviction, buffers copied never retained).
-int nl_cache_put_tagged(void* h, const void* key, uint64_t klen,
-                        const void* buf, uint64_t len, uint64_t gen,
-                        const uint64_t* tags, int ntags) {
+// Shared store body of every publish flavor (cachemu taken here): floor
+// refusal, same-key replace (cond flag included in the match — an exact
+// entry never shadows a spliced one), FIFO eviction, byte budget.
+// Buffers are copied, never retained. Internal — not ABI.
+namespace {
+
+int nl_cache_store(void* h, const void* key, uint64_t klen,
+                   const void* buf, uint64_t len, uint64_t gen,
+                   const uint64_t* tags, int ntags, bool cond,
+                   uint64_t vfloor) {
   auto* l = static_cast<NlLoop*>(h);
   std::lock_guard<std::mutex> lock(l->cachemu);
   uint64_t need = klen + len + 8;
@@ -2155,7 +2246,7 @@ int nl_cache_put_tagged(void* h, const void* key, uint64_t klen,
   if (it != l->cache.end()) {
     std::shared_ptr<NlCacheEntry> old;
     for (auto& cand : it->second) {
-      if (cand->key.size() == klen &&
+      if (cand->cond == cond && cand->key.size() == klen &&
           memcmp(cand->key.data(), key, klen) == 0) {
         old = cand;  // copy FIRST: cand aliases the slot erase destroys
         break;
@@ -2175,6 +2266,8 @@ int nl_cache_put_tagged(void* h, const void* key, uint64_t klen,
   e->reply.append((const char*)&len_le, sizeof(len_le));
   e->reply.append((const char*)buf, len);
   e->gen = gen;
+  e->cond = cond;
+  e->vfloor = vfloor;
   if (ntags > 0 && tags != nullptr) {
     e->tags.assign(tags, tags + ntags);
     std::sort(e->tags.begin(), e->tags.end());
@@ -2184,6 +2277,48 @@ int nl_cache_put_tagged(void* h, const void* key, uint64_t klen,
   l->cache_bytes += klen + e->reply.size();
   l->cache_puts.fetch_add(1, std::memory_order_relaxed);
   return 1;
+}
+
+}  // namespace
+
+// Publish one reply with per-key invalidation tags: `tags`/`ntags` name
+// the state slice the reply covers (the sparse service hashes each
+// (table, row id) of the cached id-set) so nl_cache_invalidate_tags can
+// drop ONLY intersecting entries. ntags == 0 publishes an untagged entry
+// — the pre-tag behavior: dropped by every invalidation, tagged or not.
+// Everything else is nl_cache_put's contract (floor refusal, budget,
+// FIFO eviction, buffers copied never retained).
+int nl_cache_put_tagged(void* h, const void* key, uint64_t klen,
+                        const void* buf, uint64_t len, uint64_t gen,
+                        const uint64_t* tags, int ntags) {
+  return nl_cache_store(h, key, klen, buf, len, gen, tags, ntags,
+                        false, 0);
+}
+
+// Publish one conditional (NOT_MODIFIED) reply with a version floor:
+// `key`/`klen` are the CONDITIONAL request's body bytes — the "cond"
+// token is sniffed and its digits excised HERE, with the same bounded
+// tail scan the serve side runs, so request and publish derive the
+// spliced key by identical rules and can never disagree. `vfloor` is
+// the server version the reply stamps: any later conditional request
+// whose sniffed version >= vfloor gets this reply natively (the pump's
+// own unchanged-target comparison). A key with no parseable token falls
+// back to an exact-match publish — strictly conservative: byte-repeats
+// still serve, no floor sharing. Floor refusal, budget, FIFO eviction
+// and tag semantics are nl_cache_put_tagged's contract unchanged.
+int nl_cache_put_cond(void* h, const void* key, uint64_t klen,
+                      const void* buf, uint64_t len, uint64_t gen,
+                      const uint64_t* tags, int ntags, uint64_t vfloor) {
+  uint64_t v = 0, dlo = 0, dhi = 0;
+  if (!nl_cond_token((const char*)key, klen, &v, &dlo, &dhi))
+    return nl_cache_store(h, key, klen, buf, len, gen, tags, ntags,
+                          false, 0);
+  std::string spliced;
+  spliced.reserve(klen - (dhi - dlo));
+  spliced.append((const char*)key, dlo);
+  spliced.append((const char*)key + dhi, klen - dhi);
+  return nl_cache_store(h, spliced.data(), spliced.size(), buf, len,
+                        gen, tags, ntags, true, vfloor);
 }
 
 // Publish one reply: `key`/`klen` are the request body bytes the entry
@@ -2278,9 +2413,10 @@ void nl_cache_invalidate_tags(void* h, uint64_t gen, const uint64_t* tags,
   l->cache_invals.fetch_add(1, std::memory_order_relaxed);
 }
 
-// out[8]: hits, misses, puts, rejects, invalidations, entries, bytes,
-// floor. Hits are frames answered with zero upcalls; misses are
-// cacheable-kind frames that fell through to the pump.
+// out[9]: hits, misses, puts, rejects, invalidations, entries, bytes,
+// floor, cond_hits. Hits are frames answered with zero upcalls; misses
+// are cacheable-kind frames that fell through to the pump; cond_hits is
+// the subset of hits served from a version-floor (NOT_MODIFIED) entry.
 void nl_cache_stats(void* h, uint64_t* out) {
   auto* l = static_cast<NlLoop*>(h);
   out[0] = l->cache_hits.load(std::memory_order_relaxed);
@@ -2288,6 +2424,7 @@ void nl_cache_stats(void* h, uint64_t* out) {
   out[2] = l->cache_puts.load(std::memory_order_relaxed);
   out[3] = l->cache_rejects.load(std::memory_order_relaxed);
   out[4] = l->cache_invals.load(std::memory_order_relaxed);
+  out[8] = l->cache_cond_hits.load(std::memory_order_relaxed);
   std::lock_guard<std::mutex> lock(l->cachemu);
   out[5] = (uint64_t)l->cache_fifo.size();
   out[6] = l->cache_bytes;

@@ -241,6 +241,7 @@ class TransportStats:
         self.reads_served = 0
         self.read_native_hits = 0     # synced absolute, native owns it
         self.read_native_misses = 0   # synced absolute
+        self.read_native_cond_hits = 0  # synced absolute (version-floor)
         self.read_cache_entries = 0   # gauge, not cumulative
         self.read_cache_bytes = 0     # gauge, not cumulative
         self.read_cache_hits = 0
@@ -248,6 +249,19 @@ class TransportStats:
         self.read_coalesced = 0
         self.reads_replica = 0
         self.read_fallbacks = 0
+        # conditional reads (README "Read path"): NOT_MODIFIED replies
+        # served (stamp only, no payload) and delta rows shipped (changed
+        # rows only, instead of the full requested set). Registered as
+        # their own counter families so the fleet view can watch the
+        # revalidation share directly.
+        self.read_not_modified = 0
+        self.read_delta_rows = 0
+        self._c_read_nm = reg.counter(
+            "ps_read_not_modified_total",
+            "conditional READs answered NOT_MODIFIED (stamp only)")
+        self._c_read_delta = reg.counter(
+            "ps_read_delta_rows_total",
+            "changed rows shipped as conditional-read deltas")
         # zero-upcall push plane (README "Push path"): the native
         # admission mirror's counters, absolute values synced from
         # nl_admit_stats on the pump's gauge tick — the loop owns the
@@ -399,14 +413,17 @@ class TransportStats:
             self.reads_served += 1
 
     def set_read_cache_stats(self, hits: int, misses: int, entries: int,
-                             nbytes: int) -> None:
+                             nbytes: int, cond_hits: int = 0) -> None:
         """Sync the native read cache's counters (absolute values — the
-        native side owns the counting, like set_loop_stats)."""
+        native side owns the counting, like set_loop_stats).
+        ``cond_hits`` is the subset of hits served from a version-floor
+        (NOT_MODIFIED) entry — the zero-upcall revalidation count."""
         with self._lock:
             self.read_native_hits = int(hits)
             self.read_native_misses = int(misses)
             self.read_cache_entries = int(entries)
             self.read_cache_bytes = int(nbytes)
+            self.read_native_cond_hits = int(cond_hits)
 
     def set_admit_stats(self, acks: int, refusals: int, fresh: int,
                         punts: int) -> None:
@@ -446,6 +463,20 @@ class TransportStats:
         and the read fell back toward the primary."""
         with self._lock:
             self.read_fallbacks += 1
+
+    def record_read_not_modified(self) -> None:
+        """Server side: one conditional READ answered NOT_MODIFIED —
+        the caller's version is current, only the stamp shipped."""
+        self._c_read_nm.inc()
+        with self._lock:
+            self.read_not_modified += 1
+
+    def record_read_delta_rows(self, rows: int) -> None:
+        """Server side: one conditional sparse READ shipped ``rows``
+        changed rows instead of the full requested id-set."""
+        self._c_read_delta.inc(int(rows))
+        with self._lock:
+            self.read_delta_rows += int(rows)
 
     def record_upcall(self, batch: int) -> None:
         """One nl_poll upcall that handed ``batch`` requests to Python."""
@@ -567,7 +598,10 @@ class TransportStats:
                     self.reads_served, self.read_cache_hits,
                     self.read_wire, self.read_coalesced,
                     self.reads_replica, self.read_fallbacks,
-                    self.sparse_rows_applied)
+                    self.sparse_rows_applied,
+                    # conditional reads: APPENDED (older snapshots
+                    # zero-pad in summary — positions are the contract)
+                    self.read_not_modified, self.read_delta_rows)
 
     def summary(self, since: Optional[tuple] = None) -> Dict[str, float]:
         now = self.snapshot()
@@ -651,6 +685,12 @@ class TransportStats:
         if d[36] > 0:
             # sparse fused apply: raw row updates applied this interval
             out["sparse_rows_applied"] = int(d[36])
+        # conditional reads: only reported once a conditional READ was
+        # answered in the interval (legacy summaries unchanged)
+        if d[37] > 0:
+            out["read_not_modified"] = int(d[37])
+        if d[38] > 0:
+            out["read_delta_rows"] = int(d[38])
         # latency DISTRIBUTIONS (ps_tpu/obs): quantiles of everything the
         # histograms saw — lifetime, not interval (a p99 over an interval
         # delta of log buckets is computable but the lifetime tail is

@@ -443,12 +443,14 @@ def test_read_path_knobs_roundtrip(monkeypatch):
 
     monkeypatch.setenv("PS_READ_STALENESS", "3")
     monkeypatch.setenv("PS_PULL_CACHE", "1")
+    monkeypatch.setenv("PS_READ_CONDITIONAL", "0")
     monkeypatch.setenv("PS_NATIVE_READ_CACHE_BYTES", "1048576")
     monkeypatch.setenv("PS_CONNECT_MAX_WAIT_MS", "1200")
     monkeypatch.setenv("PS_AGG_PROBE_MAX_WAIT_MS", "50")
     cfg = Config.from_env()
     assert cfg.read_staleness == 3
     assert cfg.pull_cache is True
+    assert cfg.read_conditional is False
     assert cfg.native_read_cache_bytes == 1 << 20
     assert cfg.connect_max_wait_ms == 1200
     assert cfg.agg_probe_max_wait_ms == 50
@@ -531,6 +533,264 @@ def test_sparse_per_key_invalidation_keeps_disjoint_sets_native():
         assert cs1["floor"] >= cs0["floor"] + 4
     finally:
         svc.stop()
+        ps.shutdown()
+
+
+# -- conditional & delta reads (version-predicated serving) -------------------
+
+
+def test_dense_conditional_read_not_modified_and_full_parity():
+    """Protocol level: a READ carrying ``cond`` at the server's version
+    gets a NOT_MODIFIED stamp; a lagging ``cond`` gets the full reply —
+    byte-identical to an unconditional READ of the same state."""
+    ps.init(backend="tpu", mode="async", num_workers=1, dc_lambda=0.0)
+    svc = _svc()
+    w = connect_async(f"127.0.0.1:{svc.port}", 0, _params())
+    try:
+        full = _raw_read(svc.port)
+        kind, _, _, extra = tv.decode(memoryview(full))
+        assert kind == tv.OK
+        v = int(extra["version"])
+        nm = _raw_read(svc.port, tv.encode(tv.READ, 0, None,
+                                           extra={"cond": v}))
+        kind, _, tensors, extra = tv.decode(memoryview(nm))
+        assert kind == tv.NOT_MODIFIED
+        assert not tensors and int(extra["version"]) == v
+        assert len(nm) < len(full) / 5  # a handshake, not a payload
+        assert svc.transport.read_not_modified >= 1
+        # changed target: the conditional MISS is the unconditional reply
+        w.push_all(_grad(0.5))
+        uncond = _raw_read(svc.port)
+        cond = _raw_read(svc.port, tv.encode(tv.READ, 0, None,
+                                             extra={"cond": v}))
+        assert cond == uncond
+    finally:
+        w.close()
+        svc.stop()
+        ps.shutdown()
+
+
+def test_dense_conditional_native_hit_bitwise_and_cond_counter():
+    """A published NOT_MODIFIED is served zero-upcall: the repeat
+    conditional READ's native reply is byte-identical to the pump's,
+    and a HIGHER cond rides the same version-floor entry (the splice)."""
+    ps.init(backend="tpu", mode="async", num_workers=1, dc_lambda=0.0)
+    svc = _svc(native_loop=True)
+    try:
+        kind, _, _, extra = tv.decode(memoryview(_raw_read(svc.port)))
+        v = int(extra["version"])
+        req = tv.encode(tv.READ, 0, None, extra={"cond": v})
+        miss = _raw_read(svc.port, req)   # pump path; publishes
+        assert tv.decode(memoryview(miss))[0] == tv.NOT_MODIFIED
+        hit = _raw_read(svc.port, req)    # native path; echoes
+        assert hit == miss
+        # a DIFFERENT cond >= the floor maps to the same entry
+        req2 = tv.encode(tv.READ, 0, None, extra={"cond": v + 7})
+        assert _raw_read(svc.port, req2) == miss
+        cs = _cache_settled(svc, lambda c: c.get("cond_hits", 0) >= 2)
+        assert cs["cond_hits"] >= 2, cs
+        assert cs["hits"] >= cs["cond_hits"], cs
+    finally:
+        svc.stop()
+        ps.shutdown()
+
+
+def test_worker_cache_revalidates_with_not_modified():
+    """A version-lag signal with an UNCHANGED server costs a stamp-only
+    round trip: the worker sends its snapshot version and keeps its
+    bytes on the NOT_MODIFIED, instead of refetching the tree."""
+    ps.init(backend="tpu", mode="async", num_workers=2, dc_lambda=0.0)
+    svc = _svc()
+    w = connect_async(f"127.0.0.1:{svc.port}", 0, _params(),
+                      pull_cache=True)
+    try:
+        t1 = w.read_all()
+        wire0 = w.transport.read_wire
+        # a lag signal lands (e.g. a REPLICA_STATE race) but the server
+        # has NOT advanced: the revalidation must come back NOT_MODIFIED
+        w.versions[0] += 1
+        t2 = w.read_all()
+        assert w.transport.read_wire == wire0 + 1  # it did go to the wire
+        assert svc.transport.read_not_modified >= 1
+        for k in ("a/w", "b/w"):
+            np.testing.assert_array_equal(np.asarray(t1[k]),
+                                          np.asarray(t2[k]))
+    finally:
+        w.close()
+        svc.stop()
+        ps.shutdown()
+
+
+def test_lagging_not_modified_refused_by_staleness_bound():
+    """A frozen backup answering NOT_MODIFIED to a cond it cannot judge
+    (it never saw the pushes) is refused by the SAME bounded-staleness
+    predicate as a lagging full reply — the read falls back to the
+    primary and serves the post-push state."""
+    ps.init(backend="tpu", mode="async", num_workers=2, dc_lambda=0.0)
+    prim = _svc()
+    stale = _svc(backup=True)  # frozen: no stream ever attaches
+    uri = f"127.0.0.1:{prim.port}|127.0.0.1:{stale.port}"
+    pusher = connect_async(f"127.0.0.1:{prim.port}", 1, _params())
+    w = connect_async(uri, 0, _params(), read_staleness=0,
+                      pull_cache=True)
+    try:
+        w.read_all()  # snapshot at v0; rotation consumed start=0
+        for _ in range(4):
+            pusher.push_all(_grad(0.25))
+        # the watcher (heartbeat cadence) observes the primary's bump
+        deadline = time.monotonic() + 5.0
+        while w.versions[0] < 4 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert w.versions[0] >= 4, "watcher never observed the bump"
+        # rotation now starts at the backup: its NOT_MODIFIED (stamp 0
+        # vs 4 known) violates the bound and MUST be refused
+        tree, version = w.read_all_versioned()
+        assert int(version) >= 4  # zero staleness violations
+        assert float(np.asarray(tree["b/w"])[0]) != 1.0  # post-push state
+        assert w.transport.read_fallbacks >= 1
+        assert stale.transport.read_not_modified >= 1  # the backup DID
+        # answer NOT_MODIFIED — acceptance is the reader's call
+    finally:
+        w.close()
+        pusher.close()
+        prim.stop()
+        stale.stop()
+        ps.shutdown()
+
+
+def test_sparse_conditional_delta_matches_full_read():
+    """Sparse revalidation end to end: repeat read_rows over the same
+    id-set is a NOT_MODIFIED handshake; after a push touching a SUBSET,
+    the server ships only the changed rows and the merged result is
+    bitwise the full pull — duplicate request ids included."""
+    import jax
+
+    from ps_tpu.backends.remote_sparse import SparsePSService, connect_sparse
+    from ps_tpu.kv.sparse import SparseEmbedding
+
+    ps.init(backend="tpu", mode="async", num_workers=1)
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:1]), ("data",))
+    emb = SparseEmbedding(64, 8, optimizer="sgd", learning_rate=0.5,
+                          mesh=mesh)
+    emb.init(np.random.default_rng(0)
+             .normal(0, 0.01, (64, 8)).astype(np.float32))
+    svc = SparsePSService({"deep": emb})
+    w = connect_sparse(f"127.0.0.1:{svc.port}", 0, {"deep": (64, 8)})
+    try:
+        ids = np.array([3, 9, 3, 11, 40], np.int32)  # dup id included
+        r1 = w.read_rows({"deep": ids})
+        pulled0 = w.bytes_pulled
+        r2 = w.read_rows({"deep": ids})  # NOT_MODIFIED: stamp only
+        np.testing.assert_array_equal(r1["deep"], r2["deep"])
+        assert svc.transport.read_not_modified >= 1
+        nm_bytes = w.bytes_pulled - pulled0
+        assert nm_bytes < 250, nm_bytes  # a handshake, not rows
+        # push touching ONLY id 9: the revalidation ships ONE row
+        w.push({"deep": (np.array([9], np.int32),
+                         np.full((1, 8), 0.5, np.float32))})
+        r3 = w.read_rows({"deep": ids})
+        assert svc.transport.read_delta_rows == 1
+        full = w.pull({"deep": ids})  # ground truth, full payload
+        np.testing.assert_array_equal(r3["deep"], np.asarray(full["deep"]))
+        # both dup positions of id 3 still carry the (unchanged) row
+        np.testing.assert_array_equal(r3["deep"][0], r3["deep"][2])
+    finally:
+        w.close()
+        svc.stop()
+        ps.shutdown()
+
+
+def test_tiered_conditional_delta_after_tier_moves():
+    """A tier move IS a change: after pushes that evict/promote rows of
+    the held snapshot, the conditional read's delta-merged result is
+    bitwise a fresh full pull — eviction can never hide behind an
+    unchanged table-version sum."""
+    from ps_tpu.backends.remote_sparse import SparsePSService, connect_sparse
+    from ps_tpu.kv.tiered import TieredTable
+
+    ps.init(backend="tpu", mode="async", num_workers=1)
+    t = TieredTable(64, 8, optimizer="adagrad", device_rows=8,
+                    admit_freq=1)
+    t.init(np.random.default_rng(0)
+           .normal(size=(64, 8)).astype(np.float32))
+    svc = SparsePSService({"emb": t})
+    w = connect_sparse(f"127.0.0.1:{svc.port}", 0, {"emb": (64, 8)})
+    try:
+        ids = np.arange(0, 16, dtype=np.int32)
+        r1 = w.read_rows({"emb": ids})
+        # churn far past the 8-row device budget: promotions + evictions
+        # sweep through the snapshot's rows
+        rng = np.random.default_rng(7)
+        for _ in range(6):
+            bids = rng.integers(0, 64, size=12).astype(np.int32)
+            w.push({"emb": (bids,
+                            rng.normal(size=(12, 8)).astype(np.float32)
+                            * 0.1)})
+        assert t.promotions + t.evictions > 0  # the drill moved tiers
+        r2 = w.read_rows({"emb": ids})  # delta-merged revalidation
+        full = w.pull({"emb": ids})
+        np.testing.assert_array_equal(r2["emb"], np.asarray(full["emb"]))
+        assert not np.array_equal(r2["emb"], r1["emb"])
+    finally:
+        w.close()
+        svc.stop()
+        ps.shutdown()
+
+
+def test_sparse_conditional_off_knob_restores_full_reads(monkeypatch):
+    """PS_READ_CONDITIONAL=0: every read ships the full payload (no
+    snapshots, no conds) and the served rows stay bitwise identical."""
+    import jax
+
+    from ps_tpu.backends.remote_sparse import SparsePSService, connect_sparse
+    from ps_tpu.kv.sparse import SparseEmbedding
+
+    monkeypatch.setenv("PS_READ_CONDITIONAL", "0")
+    ps.init(backend="tpu", mode="async", num_workers=1)
+    mesh = jax.sharding.Mesh(np.array(jax.devices()[:1]), ("data",))
+    emb = SparseEmbedding(64, 8, optimizer="sgd", learning_rate=0.5,
+                          mesh=mesh)
+    emb.init(np.random.default_rng(0)
+             .normal(0, 0.01, (64, 8)).astype(np.float32))
+    svc = SparsePSService({"deep": emb})
+    w = connect_sparse(f"127.0.0.1:{svc.port}", 0, {"deep": (64, 8)})
+    try:
+        ids = np.array([3, 9, 11], np.int32)
+        r1 = w.read_rows({"deep": ids})
+        r2 = w.read_rows({"deep": ids})
+        np.testing.assert_array_equal(r1["deep"], r2["deep"])
+        assert not w._read_snaps  # no snapshots held
+        assert svc.transport.read_not_modified == 0
+    finally:
+        w.close()
+        svc.stop()
+        ps.shutdown()
+
+
+def test_aggregator_conditional_read_not_modified():
+    """An aggregator member revalidating at the coalesced snapshot's
+    version gets the NOT_MODIFIED handshake, not the tree."""
+    ps.init(backend="tpu", mode="async", num_workers=2, dc_lambda=0.0)
+    from ps_tpu.backends.aggregator import AggregatorService
+
+    store = ps.KVStore(optimizer="sgd", learning_rate=0.5, mode="async")
+    store.init(_params())
+    shard = serve_async(store, bind="127.0.0.1")
+    agg = AggregatorService(f"127.0.0.1:{shard.port}", _params(),
+                            group_size=2, bind="127.0.0.1")
+    try:
+        kind, _, _, extra = tv.decode(memoryview(_raw_read(agg.port)))
+        assert kind == tv.OK
+        v = int(extra["version"])
+        nm = _raw_read(agg.port, tv.encode(tv.READ, 0, None,
+                                           extra={"cond": v}))
+        kind, _, tensors, extra = tv.decode(memoryview(nm))
+        assert kind == tv.NOT_MODIFIED and not tensors
+        assert int(extra["version"]) == v
+        assert agg.transport.read_not_modified >= 1
+    finally:
+        agg.stop()
+        shard.stop()
         ps.shutdown()
 
 

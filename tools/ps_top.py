@@ -57,7 +57,8 @@ COLS = [
     ("moved", 8), ("gbps", 7), ("ack_p99_ms", 10), ("bkt_p99_ms", 10),
     ("loop", 10), ("nlp99", 8), ("qw99", 8), ("padm%", 6), ("reads", 8),
     ("nhit%", 6),
-    ("chit%", 6), ("rshare%", 7), ("tier", 6), ("rows", 9), ("sap99", 8),
+    ("chit%", 6), ("nm%", 6),
+    ("rshare%", 7), ("tier", 6), ("rows", 9), ("sap99", 8),
     ("hot%", 6), ("evict", 7),
 ]
 
@@ -136,7 +137,7 @@ def render_row(st: dict) -> dict:
                 "dedup": "-", "stale": "-", "moved": "-", "gbps": "-",
                 "ack_p99_ms": "-", "bkt_p99_ms": "-", "loop": "-",
                 "nlp99": "-", "qw99": "-", "padm%": "-",
-                "reads": "-", "nhit%": "-", "chit%": "-",
+                "reads": "-", "nhit%": "-", "chit%": "-", "nm%": "-",
                 "rshare%": "-", "tier": "-", "rows": "-", "sap99": "-",
                 "hot%": "-", "evict": "-"}
     repl = st.get("repl") or {}
@@ -196,6 +197,12 @@ def render_row(st: dict) -> dict:
         "reads": _reads_total(st),
         "nhit%": _native_hit_pct(st),
         "chit%": _cached_read_pct(st),
+        # conditional serving (README "Read path"): share of answered
+        # reads settled as NOT_MODIFIED handshakes — Python-served NMs
+        # plus version-floor native cache hits. A warm steady-state
+        # fleet should sit near 100 here; near 0 with conditional reads
+        # on means readers never revalidate (cold sets or cache off)
+        "nm%": _not_modified_pct(st),
         # computed across the shard's replica set by poll_fleet: the
         # backup rows' reads over the whole set's (same value on every
         # row of a shard — the read-replica share of its traffic)
@@ -303,6 +310,18 @@ def _native_hit_pct(st: dict):
     hits = int(rd.get("native_hits", 0))
     total = hits + int(rd.get("native_misses", 0))
     return round(100.0 * hits / total, 1) if total else "-"
+
+
+def _not_modified_pct(st: dict):
+    """Share of ALL answered reads settled as NOT_MODIFIED handshakes
+    (stamp-only replies) — Python-served NMs plus the native cache's
+    version-floor hits, over the endpoint's total answered reads."""
+    rd = st.get("read")
+    if not isinstance(rd, dict):
+        return "-"
+    nm = int(rd.get("nm", 0)) + int(rd.get("native_cond_hits", 0))
+    total = int(rd.get("native_hits", 0)) + int(rd.get("served", 0))
+    return round(100.0 * nm / total, 1) if total else "-"
 
 
 def _opt(v):

@@ -69,6 +69,9 @@ int nl_cache_put(void* h, const void* key, uint64_t klen, const void* buf,
 int nl_cache_put_tagged(void* h, const void* key, uint64_t klen,
                         const void* buf, uint64_t len, uint64_t gen,
                         const uint64_t* tags, int ntags);
+int nl_cache_put_cond(void* h, const void* key, uint64_t klen,
+                      const void* buf, uint64_t len, uint64_t gen,
+                      const uint64_t* tags, int ntags, uint64_t vfloor);
 void nl_cache_invalidate(void* h, uint64_t gen);
 void nl_cache_invalidate_tags(void* h, uint64_t gen, const uint64_t* tags,
                               int ntags);
@@ -571,7 +574,7 @@ int main() {
       }
     });
     std::thread cstats([&] {  // stats-while-serve: the whole read surface
-      uint64_t out[8];
+      uint64_t out[9];
       uint64_t hist[4 + 160];
       uint64_t svals[7 * 8];
       char stids[2 * 20 * 8];
@@ -651,7 +654,7 @@ int main() {
     nl_shutdown_conns(loop);
     nl_begin_stop(loop);
     cpump.join();
-    uint64_t cs[8];
+    uint64_t cs[9];
     nl_cache_stats(loop, cs);
     // in-loop telemetry landed: read latency + read-hit serve histograms
     // counted, and the 1 ns watchdog filled the slow ring (drain sanity:
@@ -706,6 +709,177 @@ int main() {
                 (unsigned long long)frames_counted,
                 (unsigned long long)hits_counted,
                 (unsigned long long)nlst[3], drained);
+  }
+
+  // --- conditional serving (nl_cache_put_cond + the version-floor
+  // lookup): revalidation churn — reader threads hammer conditional
+  // requests whose "cond" version climbs, the pump answers every miss
+  // with the spliced NOT_MODIFIED-shaped reply and publishes it under a
+  // version floor, while a "pusher" thread bumps the version and the
+  // invalidation floor on a tight cadence (an apply IS an invalidation).
+  // Every reply — version-floor hit or pump miss — must be byte-identical
+  // to the splice of the reader's own request, whatever cond digits it
+  // carried: the by-construction parity contract of NOT_MODIFIED serving.
+  {
+    void* clst = tv_listen("127.0.0.1", 0, 64);
+    if (!clst) { std::fprintf(stderr, "cond listen failed\n"); return 1; }
+    void* loop = nl_start(clst, 2);
+    if (!loop) { std::fprintf(stderr, "cond nl_start failed\n"); return 1; }
+    const char kCacheKind = 0x42;
+    nl_cache_config(loop, kCacheKind, 1u << 20);
+    int cport = tv_listener_port(clst);
+    std::atomic<bool> cstop{false};
+    std::atomic<uint64_t> version{1};
+    std::atomic<uint64_t> genctr{0};
+    // request layout (the wire frame's shape): kind byte, 4-byte worker,
+    // 8-byte meta length, then meta {"k":K,"cond":DDDDDDDD} — fixed
+    // width so the digit run sits at body offsets [27, 35)
+    auto mkreq = [&](char kind, char key, uint64_t v) {
+      std::vector<char> b(36, 0);
+      b[0] = kind;
+      uint64_t mlen = 23;
+      std::memcpy(b.data() + 5, &mlen, 8);
+      char meta[24];
+      std::snprintf(meta, sizeof(meta), "{\"k\":%c,\"cond\":%08llu}",
+                    key, (unsigned long long)(v % 100000000ull));
+      std::memcpy(b.data() + 13, meta, 23);
+      return b;
+    };
+    auto splice = [](const std::vector<char>& b) {  // drop the digits
+      std::vector<char> out(b.begin(), b.begin() + 27);
+      out.insert(out.end(), b.begin() + 35, b.end());
+      return out;
+    };
+    std::thread pusher([&] {  // version bump + floor bump, push cadence
+      while (!cstop.load()) {
+        version.fetch_add(1);
+        nl_cache_invalidate(loop, genctr.fetch_add(1) + 1);
+        sleep_ms(1);
+      }
+    });
+    std::thread condstats([&] {  // widened stats surface under churn
+      uint64_t out[9];
+      while (!cstop.load()) {
+        nl_cache_stats(loop, out);
+        sleep_ms(1);
+      }
+    });
+    std::thread cpump([&] {  // miss path: spliced reply, cond publish
+      uint64_t ids[16];
+      void* bodies[16];
+      uint64_t lens[16];
+      while (true) {
+        int n = nl_poll(loop, ids, bodies, lens, 16, 50);
+        if (n < 0) break;
+        for (int i = 0; i < n; ++i) {
+          std::vector<char> body((char*)bodies[i],
+                                 (char*)bodies[i] + lens[i]);
+          uint64_t g = genctr.load();
+          uint64_t vf = version.load();
+          if (body.size() == 36 && body[0] == kCacheKind) {
+            std::vector<char> rep = splice(body);
+            const void* bufs[1] = {rep.data()};
+            uint64_t ls[1] = {rep.size()};
+            nl_reply_vec(loop, ids[i], bufs, ls, 1, 0, 0);
+            // the reply is valid for ANY cond >= the version it was
+            // computed at: publish under that floor (some of these
+            // race the pusher and are refused at the gen floor)
+            nl_cache_put_cond(loop, body.data(), body.size(), rep.data(),
+                              rep.size(), g, nullptr, 0, vf);
+          } else {  // non-cacheable: plain echo
+            const void* bufs[1] = {body.data()};
+            uint64_t ls[1] = {body.size()};
+            nl_reply_vec(loop, ids[i], bufs, ls, 1, 0, 0);
+          }
+          nl_body_free(loop, bodies[i]);
+        }
+      }
+    });
+    std::vector<std::thread> ccls;
+    std::atomic<int> cok{0};
+    for (int c = 0; c < 4; ++c) {
+      ccls.emplace_back([&, c] {
+        void* ch = tv_connect("127.0.0.1", cport, 2000);
+        if (!ch) return;
+        for (int r = 0; r < 120; ++r) {
+          // revalidate at or past the live version (hits whenever an
+          // entry survives the pusher's floor), two hot keys across
+          // clients, every 7th request non-cacheable
+          bool cold = (r % 7 == 6);
+          std::vector<char> req =
+              mkreq(cold ? (char)0x11 : kCacheKind, (char)('0' + r % 2),
+                    version.load() + 1);
+          std::vector<char> want = cold ? req : splice(req);
+          if (!tv_send(ch, req.data(), req.size())) break;
+          int64_t n = tv_recv_size(ch);
+          if (n != (int64_t)want.size()) break;
+          std::vector<char> back(n);
+          if (!tv_recv_into(ch, back.data(), n) || back != want) break;
+          cok.fetch_add(1);
+        }
+        tv_close(ch);
+      });
+    }
+    for (auto& t : ccls) t.join();
+    cstop.store(true);
+    pusher.join();
+    condstats.join();
+    // deterministic tail (no pusher racing): a publish at a known floor
+    // must serve BOTH the exact cond it was built from and any higher
+    // one (the splice), and refuse a lower one back to the pump
+    uint64_t vf = version.load();
+    uint64_t g = genctr.load();
+    std::vector<char> base = mkreq(kCacheKind, '9', vf);
+    std::vector<char> rep = splice(base);
+    if (nl_cache_put_cond(loop, base.data(), base.size(), rep.data(),
+                          rep.size(), g, nullptr, 0, vf) != 1) {
+      std::fprintf(stderr, "cond publish refused at a live floor\n");
+      return 1;
+    }
+    uint64_t cs0[9], cs1[9];
+    nl_cache_stats(loop, cs0);
+    void* ch = tv_connect("127.0.0.1", cport, 2000);
+    if (!ch) { std::fprintf(stderr, "cond tail connect failed\n"); return 1; }
+    for (uint64_t dv : {0ull, 3ull}) {  // exact floor, then above it
+      std::vector<char> req = mkreq(kCacheKind, '9', vf + dv);
+      std::vector<char> want = splice(req);
+      if (!tv_send(ch, req.data(), req.size())) return 1;
+      int64_t n = tv_recv_size(ch);
+      std::vector<char> back(n > 0 ? n : 0);
+      if (n != (int64_t)want.size() ||
+          !tv_recv_into(ch, back.data(), n) || back != want) {
+        std::fprintf(stderr, "cond tail parity broke at +%llu\n",
+                     (unsigned long long)dv);
+        return 1;
+      }
+    }
+    tv_close(ch);
+    nl_cache_stats(loop, cs1);
+    if (cs1[8] < cs0[8] + 2) {
+      std::fprintf(stderr, "cond tail not served from the version floor: "
+                   "cond_hits %llu -> %llu\n", (unsigned long long)cs0[8],
+                   (unsigned long long)cs1[8]);
+      return 1;
+    }
+    if (cs1[0] < cs1[8]) {
+      std::fprintf(stderr, "cond hits not a subset of hits\n");
+      return 1;
+    }
+    nl_stop_accept(loop);
+    nl_shutdown_conns(loop);
+    nl_begin_stop(loop);
+    cpump.join();
+    nl_stop(loop);
+    tv_listener_close(clst);
+    if (cok.load() < 400) {
+      std::fprintf(stderr, "cond churn: only %d/480 round trips\n",
+                   cok.load());
+      return 1;
+    }
+    std::printf("nl conditional-serve churn: OK (%d ok, %llu hits of "
+                "which %llu cond, %llu puts, %llu invals)\n", cok.load(),
+                (unsigned long long)cs1[0], (unsigned long long)cs1[8],
+                (unsigned long long)cs1[2], (unsigned long long)cs1[4]);
   }
 
   // --- native push admission (nl_admit_*): admission churn — loop
