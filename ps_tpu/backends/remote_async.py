@@ -244,12 +244,23 @@ class AsyncPSService(VanService):
                 nkeys = len(self._key_order)
                 nbytes = sum(int(v.nbytes)
                              for v in self._engine._params.values())
-            return {
+            out = {
                 "keys": nkeys,
                 "nbytes": nbytes,
                 "push_qps": round(push_qps, 2),
                 "pull_qps": round(pull_qps, 2),
             }
+            # replication health rides the load report: the autopilot's
+            # re-seed rule keys off a DEGRADED stream (backup died) or a
+            # promoted survivor serving without redundancy
+            s = self._backup_session
+            if s is not None or self.promote_reason is not None:
+                out["repl"] = {
+                    "attached": bool(s is not None and not s.degraded),
+                    "degraded": bool(s is not None and s.degraded),
+                    "promoted": self.promote_reason is not None,
+                }
+            return out
 
         # fleet telemetry (README "Fleet telemetry"): delta-encoded metric
         # snapshots piggyback on the load reports — THIS service's own
@@ -840,6 +851,8 @@ class AsyncPSService(VanService):
             return self._migrate_commit(worker, extra)
         elif kind == tv.MIGRATE_ABORT:
             return self._migrate_abort(worker)
+        elif kind == tv.RESEED:
+            return self._reseed_backup(worker, extra)
         return tv.encode(tv.ERR, worker, None,
                          extra={"error": f"bad kind {kind}"})
 
@@ -1278,6 +1291,137 @@ class AsyncPSService(VanService):
         super().kill()
 
     # -- shard replication hooks (ps_tpu/replica) -----------------------------
+
+    def _reseed_backup(self, worker: int, extra: dict) -> bytes:
+        """RESEED (coordinator/operator → this PRIMARY): restore
+        redundancy after a failover or backup death consumed the replica
+        stream. Quiesce applies — the engine lock is re-entrant, so the
+        export, the one-frame ``REPLICA_SEED`` install at the spare, and
+        the re-attach are ONE hold: the spare receives EXACTLY the state
+        point the new stream continues from (the same quiesce contract
+        :meth:`attach_backup` documents, driven by a machine). Ships
+        every row (param + optimizer state + stale snapshots), the
+        engine meta, and the per-key exactly-once ledger — promotion off
+        the re-seeded backup dedups a replay exactly like the original
+        pair would have."""
+        from ps_tpu.elastic.migrate import encode_row
+
+        spare = str(extra.get("spare") or "")
+        if ":" not in spare:
+            return tv.encode(tv.ERR, worker, None, extra={
+                "error": "reseed needs spare \"host:port\""})
+        if self.role != "primary":
+            return tv.encode(tv.ERR, worker, None, extra={
+                "error": f"only a primary re-seeds (role={self.role})"})
+        shost, sport = spare.rsplit(":", 1)
+        t0 = time.monotonic()
+        with self._engine._lock:
+            old = self._backup_session
+            if old is not None and not old.degraded:
+                return tv.encode(tv.ERR, worker, None, extra={
+                    "error": "a live backup session is already attached"})
+            tensors: Dict[str, np.ndarray] = {}
+            rows_extra = []
+            rows = self._engine.export_keys(self._key_order)
+            for i, k in enumerate(self._key_order):
+                r = rows[k]
+                t, e = encode_row(k, r["param"], r["state"], r["stale"],
+                                  r["apply_count"])
+                for name, arr in t.items():
+                    tensors[f"{i}/{name}"] = np.asarray(arr)
+                rows_extra.append(e)
+            frame = tv.encode(tv.REPLICA_SEED, 0, tensors, extra={
+                "kind": "dense",
+                "keys": self._key_order,
+                "shard": self.shard, "num_shards": self.num_shards,
+                "rows": rows_extra,
+                "meta": self._engine._checkpoint_meta(),
+                "applied": {str(w): int(n)
+                            for w, n in self._applied.items()},
+                "tokens": {str(w): {k: [tk[0], int(tk[1])]
+                                    for k, tk in toks.items()}
+                           for w, toks in self._applied_pseq.items()},
+            })
+            nbytes = len(frame)
+            ch = tv.Channel.connect(shost, int(sport))  # pslint: disable=PSL101 -- deliberate quiesce: the seed frame MUST ship while applies are frozen (the spare installs the exact state point the re-attached stream continues from); a dead spare fails the connect, not the primary
+            try:
+                k2, _, _, rep = tv.decode(ch.request(frame))  # pslint: disable=PSL101 -- same quiesce hold as the connect above; bounded by the channel timeout
+            finally:
+                ch.close()
+            if k2 != tv.OK:
+                return tv.encode(tv.ERR, worker, None, extra={
+                    "error": f"spare refused seed: {rep.get('error')}"})
+            self.attach_backup(shost, int(sport),  # pslint: disable=PSL101 -- same quiesce hold: the REPLICA_HELLO must validate against EXACTLY the state point the seed installed, so no apply may land between seed and attach (the lock is re-entrant by design)
+                               ack=str(extra.get("ack", "sync")))
+        dt = time.monotonic() - t0
+        obs.record_event("reseed", spare=spare, keys=len(rows_extra),
+                         bytes=nbytes, seconds=round(dt, 4))
+        logging.getLogger(__name__).warning(
+            "re-seeded backup at %s: %d key(s), %.1f MB in %.2fs "
+            "(redundancy restored)", spare, len(rows_extra),
+            nbytes / 1e6, dt)
+        return tv.encode(tv.OK, worker, None, extra={
+            "keys": len(rows_extra), "bytes": nbytes,
+            "seconds": round(dt, 4)})
+
+    def _replica_seed(self, worker: int, tensors, extra):
+        """REPLICA_SEED (re-seeding primary → this EMPTY backup):
+        install the shipped state point wholesale — rows, engine meta,
+        and the exactly-once ledger — so the REPLICA_HELLO that follows
+        validates against an exact copy. Refused once a stream is
+        attached: a seed is how a spare BECOMES a backup, never a way to
+        rewrite a live one."""
+        from ps_tpu.elastic.migrate import decode_row
+
+        if extra.get("kind") != "dense":
+            return (f"seed kind {extra.get('kind')!r} does not match "
+                    f"this dense service")
+        meta = dict(extra.get("meta") or {})
+        if int(meta.get("num_workers", self._engine.num_workers)) \
+                != self._engine.num_workers:
+            return (f"seed is for num_workers={meta.get('num_workers')}, "
+                    f"this spare runs {self._engine.num_workers} — "
+                    f"staleness semantics would differ")
+        per: Dict[int, dict] = {}
+        for name, v in (tensors or {}).items():
+            i, _, rest = name.partition("/")
+            per.setdefault(int(i), {})[rest] = v
+        rows_extra = list(extra.get("rows") or [])
+        with self._engine._lock:
+            if self.role != "backup":
+                return f"only a backup accepts a seed (role={self.role})"
+            if self._replica_attached:
+                return ("seed refused: a replication stream is already "
+                        "attached")
+            booted = sorted(self._engine._params)
+            if booted:
+                # whatever this spare booted with is placeholder state;
+                # the seed IS the state point
+                self._engine.evict_keys(booted)
+            keys = []
+            for i, re_ in enumerate(rows_extra):
+                row = decode_row(per.get(i, {}), re_)
+                self._engine.adopt_key(row["key"], row["param"],
+                                      row["state"], row["stale"],
+                                      row["apply_count"])
+                keys.append(row["key"])
+            self._key_order = sorted(keys)
+            self.shard = extra.get("shard")
+            self.num_shards = extra.get("num_shards")
+            self._engine._load_checkpoint_meta(meta)
+            self._applied = {int(w): int(n) for w, n
+                             in (extra.get("applied") or {}).items()}
+            self._applied_pseq = {
+                int(w): {k: (tk[0], int(tk[1])) for k, tk in toks.items()}
+                for w, toks in (extra.get("tokens") or {}).items()}
+            self._invalidate_reads()
+            self._admit_sync(locked=True)
+        obs.record_event("replica_seeded", keys=len(keys),
+                         version=self._engine.version)
+        logging.getLogger(__name__).info(
+            "seeded as backup: %d key(s) at version %d", len(keys),
+            self._engine.version)
+        return None
 
     def _service_lock(self):
         return self._engine._lock
@@ -2014,10 +2158,41 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         retried push replays under its ORIGINAL (nonce, seq) token, and
         shards that already applied its merged form recorded this
         member's constituent token, so the replay is acked without
-        re-applying — no ledger violation in either direction."""
-        if getattr(self, "_agg_fallback", None) is None:
+        re-applying — no ledger violation in either direction.
+
+        An ELASTIC worker (coordinator-connected) re-discovers the fleet
+        instead of failing: poll the coordinator's table and re-adopt it
+        until the slot serves again — the member was wedged or refusing
+        and recovered, or a replacement (an autopilot re-seed, a restart)
+        took its slot over — bounded by the same failover deadline. The
+        re-adoption preserves the dedup nonce and push seq, so the op
+        that hit the failure replays exactly-once."""
+        if getattr(self, "_agg_fallback", None) is not None:
+            self._degrade_to_flat(err)
+            return
+        if self._coord is None:
             raise err
-        self._degrade_to_flat(err)
+        from ps_tpu.elastic.member import fetch_table
+
+        while True:
+            budget = deadline - time.monotonic()
+            if budget <= 0:
+                raise err
+            # back off before each poll: a refusing member is usually
+            # mid-promotion / mid-recovery, and the rebuild below is a
+            # full transport re-dial — not a thing to spin on
+            time.sleep(min(0.25, budget))
+            try:
+                table = fetch_table(self._coord, cover=self._key_order,
+                                    timeout=min(budget, 10.0))
+                self._adopt_table(table)
+                return
+            except (TimeoutError, ValueError, tv.VanError,
+                    ServerFailureError):
+                # the slot still refuses (or the fetched table raced a
+                # cutover) — keep polling; the budget check above is the
+                # only way out, and it surfaces the ORIGINAL failure
+                continue
 
     def _degrade_to_flat(self, cause: BaseException) -> None:
         """Rebuild the whole transport against the remembered flat shard

@@ -288,6 +288,12 @@ class VanService:
         self.promotion_s: Optional[float] = None  # promote() call duration
         self.goodbyes = 0  # workers that sent SHUTDOWN (clean departures)
         self._goodbye_cond = threading.Condition()
+        # chaos fault-injection hook (ps_tpu/chaos, README "Autopilot &
+        # chaos"): when set, every dispatched frame is offered to the
+        # hook FIRST — a returned reply short-circuits the handler
+        # (blackhole refusals, fault drills); None serves normally.
+        # Harness-only surface: nothing in the serving path ever sets it.
+        self.chaos = None
         # observability (ps_tpu/obs): request counter into the process
         # registry (several services in one process merge by name), and
         # the opt-in /metrics endpoint — a no-op unless PS_METRICS_PORT
@@ -506,10 +512,19 @@ class VanService:
         order); must not re-acquire it."""
         raise NotImplementedError
 
+    def _replica_seed(self, worker: int, tensors, extra):
+        """Backup: install the full state point a re-seeding primary
+        shipped (``RESEED`` → ``REPLICA_SEED``, the autopilot's replica
+        heal). Returns an error string to refuse, None to accept. The
+        base refuses — only services whose state fits the row codec
+        (dense) opt in."""
+        return "this service does not support re-seed"
+
     # -- replication / promotion ----------------------------------------------
 
     _REPLICA_KINDS = frozenset({tv.REPLICA_HELLO, tv.REPLICA_APPEND,
-                                tv.REPLICA_PROMOTE, tv.REPLICA_STATE})
+                                tv.REPLICA_PROMOTE, tv.REPLICA_STATE,
+                                tv.REPLICA_SEED})
 
     def _dispatch(self, kind: int, worker: int, tensors, extra) -> bytes:
         """Route one request: replication-plane kinds are handled here;
@@ -517,6 +532,15 @@ class VanService:
         backup refuses them with a typed, retry-able reply (the worker's
         failover loop keys off ``extra["backup"]`` to wait out the
         promotion instead of failing the job)."""
+        # chaos hook first (both serve paths funnel through here): an
+        # injected fault answers INSTEAD of the handler, so a drill
+        # exercises the worker's real refusal/retry machinery — the
+        # exact frames a genuinely broken shard would emit
+        hook = self.chaos
+        if hook is not None:
+            reply = hook(self, kind, worker, extra)
+            if reply is not None:
+                return reply
         # server-side tracing hook — THE one chokepoint every kind passes
         # through: a frame whose header carries a propagated trace
         # context gets a span named for its kind, parented to the
@@ -576,6 +600,17 @@ class VanService:
                           f"{self.role} (epoch {self.epoch}), not a backup"),
                 "fenced": True, "epoch": self.epoch,
             })
+        if kind == tv.REPLICA_SEED:
+            # full state-point install onto an EMPTY spare (autopilot
+            # re-seed, README "Autopilot & chaos"): the quiesced primary
+            # shipped its whole state in one frame; install it so the
+            # REPLICA_HELLO that follows validates against an exact copy
+            err = self._replica_seed(worker, tensors, extra)
+            if err is not None:
+                return tv.encode(tv.ERR, worker, None,
+                                 extra={"error": err})
+            return tv.encode(tv.OK, worker, None,
+                             extra={"epoch": self.epoch})
         if kind == tv.REPLICA_HELLO:
             err = self._replica_validate(extra)
             if err is not None:
@@ -1280,7 +1315,7 @@ class VanService:
     #: (checkpoint phases park between coordinator requests; a rebalance /
     #: outbound migration runs for the whole move) — always punted.
     _PUNT_KINDS = frozenset({tv.CHECKPOINT, tv.MIGRATE_OUT,
-                             tv.COORD_REBALANCE})
+                             tv.COORD_REBALANCE, tv.RESEED})
     #: subclass hook: kinds whose handlers can PARK waiting for ANOTHER
     #: member's future request of this same service (the aggregator's
     #: group barrier: a push waits for its host group's other pushes) —

@@ -151,6 +151,17 @@ Environment variables honored by :meth:`Config.from_env`:
   evaluates over fleet telemetry, e.g. ``push p99 < 10ms over 30s``
   (unset = no rules; breaches fire ``slo_breach`` flight events and the
   ``ps_slo_breach_total`` counter)
+- ``PS_POLICY``              — the coordinator's autopilot policy engine
+  (README "Autopilot & chaos"): ``off`` (default — today's behavior,
+  byte-identical), ``dry`` (evaluate rules and record decisions without
+  executing), ``on`` (execute planned elastic actions)
+- ``PS_POLICY_COOLDOWN_S``   — per-action-class cooldown between policy
+  actions (default 30; a flapping signal can never storm the fleet)
+- ``PS_POLICY_BURN_WINDOWS`` — consecutive evaluation windows a signal
+  must hold before a rule fires, and consecutive QUIET windows below the
+  recover threshold before it re-arms (default 3)
+- ``PS_CHAOS_SEED``          — deterministic seed for the chaos fault
+  injector's schedule (ps_tpu/chaos; default 0 — same seed, same faults)
 - ``PS_TRACE_SAMPLE``        — distributed-tracing sample rate in [0, 1]
   (ps_tpu/obs: 0 = off, the default — the unsampled path costs nothing)
 - ``PS_TRACE_DIR``           — directory for trace exports and flight-
@@ -468,6 +479,21 @@ class Config:
         coordinator loop — ``"<metric> p99 < 10ms over 30s"`` with
         metric one of push/pull/push_pull/cycle/bucket/apply/ack/flush
         or a full ``ps_*_seconds`` histogram name. None = no rules.
+      policy: the coordinator's autopilot policy engine (README
+        "Autopilot & chaos") — ``off`` (default: no engine at all,
+        today's behavior byte-identical), ``dry`` (rules evaluate and
+        decisions are recorded/audited but never executed), ``on``
+        (sustained signals execute planned elastic actions: rebalance
+        toward the healthy set, replica re-seed, shard add/remove).
+      policy_cooldown_s: seconds a policy action class stays cooled down
+        after firing — the storm brake (default 30).
+      policy_burn_windows: consecutive evaluation windows a signal must
+        hold before its rule fires, and consecutive quiet windows below
+        the (lower) recover threshold before the rule re-arms — the
+        hysteresis pair (default 3).
+      chaos_seed: deterministic seed for the chaos injector's fault
+        schedule (ps_tpu/chaos/inject.py) — identical seeds replay
+        identical fault timelines (default 0).
       trace_sample: distributed-tracing sample rate in [0, 1] (README
         "Observability"; ps_tpu/obs). A sampled worker op propagates its
         trace context in the van frame headers, so the whole
@@ -628,6 +654,13 @@ class Config:
     telemetry_ring: int = 256
     telemetry_straggler_z: float = 3.0
     slo_rules: Optional[str] = None
+    # autopilot (ps_tpu/elastic/policy.py, README "Autopilot & chaos"):
+    # the coordinator-side rule engine closing the telemetry→elastic
+    # loop, its storm brakes, and the chaos injector's schedule seed
+    policy: str = "off"
+    policy_cooldown_s: float = 30.0
+    policy_burn_windows: int = 3
+    chaos_seed: int = 0
     # observability (ps_tpu/obs, README "Observability"): trace sampling
     # (0 = off), trace/flight output dir, the opt-in /metrics endpoint,
     # and the flight-recorder ring size. apply_obs() pushes these into
@@ -800,6 +833,14 @@ class Config:
 
             parse_rules(self.slo_rules)  # a bad rule fails at config
             # time, loudly — not silently at the coordinator mid-run
+        if self.policy not in ("off", "dry", "on"):
+            raise ValueError(
+                f"policy {self.policy!r} is not one of off/dry/on")
+        if self.policy_cooldown_s < 0:
+            raise ValueError("policy_cooldown_s must be >= 0")
+        if self.policy_burn_windows < 1:
+            raise ValueError("policy_burn_windows must be >= 1 (a rule "
+                             "fires on at least one sustained window)")
         if not (0.0 <= self.trace_sample <= 1.0):
             raise ValueError(
                 f"trace_sample {self.trace_sample} outside [0, 1]")
@@ -987,6 +1028,16 @@ class Config:
         if "PS_SLO_RULES" in env:
             # "" explicitly selects no rules
             kwargs["slo_rules"] = env["PS_SLO_RULES"] or None
+        if "PS_POLICY" in env:
+            # "" explicitly selects off; the mode set is validated in
+            # __post_init__ (a typo'd mode fails loudly at config time)
+            kwargs["policy"] = env["PS_POLICY"].strip().lower() or "off"
+        if "PS_POLICY_COOLDOWN_S" in env:
+            kwargs["policy_cooldown_s"] = float(env["PS_POLICY_COOLDOWN_S"])
+        if "PS_POLICY_BURN_WINDOWS" in env:
+            kwargs["policy_burn_windows"] = int(env["PS_POLICY_BURN_WINDOWS"])
+        if "PS_CHAOS_SEED" in env:
+            kwargs["chaos_seed"] = int(env["PS_CHAOS_SEED"] or 0)
         if "PS_TRACE_SAMPLE" in env:
             kwargs["trace_sample"] = float(env["PS_TRACE_SAMPLE"] or 0)
         if "PS_TRACE_DIR" in env:

@@ -124,6 +124,20 @@ READ = 30       # dense: -> whole-subtree params + version;
 # so byte-identical conditional requests stay servable from the native
 # read cache with zero upcalls.
 NOT_MODIFIED = 31  # -> reader: target unchanged since "cond"; stamp only
+# autopilot (ps_tpu/elastic/policy.py): the coordinator's policy engine
+# turns sustained telemetry signals into planned elastic actions; these
+# kinds are its audit/query surface and the replica re-seed action path
+COORD_POLICY = 32  # -> coordinator: policy-engine state + action audit
+#                    log (rule arm/streak/cooldown, last decisions) —
+#                    ps_top --coord's "policy" column and the chaos soak's
+#                    zero-operator-actions proof read this
+RESEED = 33        # coordinator -> primary: re-seed replication onto the
+#                    named spare backup — quiesce under the apply lock,
+#                    ship the full state point (REPLICA_SEED), re-attach
+REPLICA_SEED = 34  # primary -> EMPTY backup: the full per-key state
+#                    (rows + engine meta + dedup ledgers) installed
+#                    atomically so the pair stands at one state point and
+#                    the deltas-only REPLICA stream can attach
 
 #: human names per kind — span labels (ps_tpu/obs/trace.py), ps_top, and
 #: flight-recorder events all resolve through here so a new kind gets a
@@ -143,6 +157,8 @@ KIND_NAMES = {
     MIGRATE_ROW: "migrate_row", MIGRATE_COMMIT: "migrate_commit",
     MIGRATE_ABORT: "migrate_abort", COORD_TELEMETRY: "coord_telemetry",
     READ: "read", NOT_MODIFIED: "not_modified",
+    COORD_POLICY: "coord_policy", RESEED: "reseed",
+    REPLICA_SEED: "replica_seed",
 }
 
 

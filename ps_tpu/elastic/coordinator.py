@@ -33,6 +33,17 @@ what the data plane actually needs from it:
   flight event plus a rebalance HINT next to the byte-skew trigger) and
   the declarative SLO rule set (``slo_rules`` — "push p99 < 10ms over
   30s" — firing ``slo_breach`` events and ``ps_slo_breach_total``).
+- **autopilot** (README "Autopilot & chaos"): with ``policy="dry"`` or
+  ``"on"`` (PS_POLICY; off by default) a rule engine
+  (:mod:`ps_tpu.elastic.policy`) closes the telemetry→elastic loop on
+  the same report cadence — sustained SLO burn / straggler suspects /
+  byte skew plan a rebalance toward the healthy set, a consumed replica
+  set is re-seeded onto a registered spare (``RESEED``), standbys
+  absorb overload (shard add) and underload drains them — every action
+  behind burn windows, hysteresis, per-class cooldowns, and a global
+  in-flight cap of one. Decisions are audited on ``COORD_POLICY``,
+  ridden in ``COORD_TELEMETRY`` replies, and exported as
+  ``ps_policy_actions_total`` / ``ps_policy_suppressed_total``.
 
 The coordinator is deliberately OFF the data path: a dead coordinator
 stops rebalances and new joins, never traffic — workers keep their last
@@ -67,6 +78,9 @@ class _Member:
         self.key_bytes: Dict[str, int] = {}
         self.report: dict = {}
         self.report_t: Optional[float] = None
+        # coordinator-clock stamp of the last key_bytes refresh
+        # (registration or load report) — byte-skew hints carry it
+        self.bytes_t: float = time.monotonic()
 
     @property
     def nbytes(self) -> int:
@@ -99,6 +113,12 @@ class Coordinator(VanService):
         suspicion (``Config.telemetry_straggler_z``).
       slo_rules: ``;``-separated SLO rule lines (``Config.slo_rules`` /
         PS_SLO_RULES), e.g. ``"push p99 < 10ms over 30s"``.
+      policy: the autopilot mode — ``"off"`` (default: no engine exists,
+        coordinator behavior is byte-identical to a policy-free build),
+        ``"dry"`` (decide + audit, never execute), ``"on"``
+        (``Config.policy`` / PS_POLICY; README "Autopilot & chaos").
+      policy_cooldown_s / policy_burn_windows: the autopilot's storm
+        brakes (``Config.policy_cooldown_s`` / ``policy_burn_windows``).
     """
 
     def __init__(self, port: int = 0, bind: str = "127.0.0.1",
@@ -108,7 +128,10 @@ class Coordinator(VanService):
                  telemetry_window_s: Optional[float] = None,
                  telemetry_ring: Optional[int] = None,
                  straggler_z: Optional[float] = None,
-                 slo_rules: Optional[str] = None):
+                 slo_rules: Optional[str] = None,
+                 policy: Optional[str] = None,
+                 policy_cooldown_s: Optional[float] = None,
+                 policy_burn_windows: Optional[int] = None):
         import os
 
         from ps_tpu.config import Config, env_flag
@@ -192,6 +215,40 @@ class Coordinator(VanService):
                                     "row bytes streamed by rebalances")
         self._m_aborts = reg.counter("ps_rebalance_aborts_total",
                                      "aborted key-range moves")
+        # autopilot (ps_tpu/elastic/policy.py, README "Autopilot &
+        # chaos"): the rule engine turning sustained fleet signals into
+        # rebalance / re-seed / scale actions. "off" (the default)
+        # constructs NOTHING — this coordinator is byte-identical to a
+        # policy-free build; "dry" decides and audits without executing
+        mode = (_env("PS_POLICY", "policy",
+                     lambda v: v.strip().lower() or "off")
+                if policy is None else str(policy).strip().lower())
+        if mode not in ("off", "dry", "on"):
+            raise ValueError(f"policy={mode!r} is not off/dry/on")
+        if policy_cooldown_s is None:
+            policy_cooldown_s = _env("PS_POLICY_COOLDOWN_S",
+                                     "policy_cooldown_s", float)
+        if policy_burn_windows is None:
+            policy_burn_windows = _env("PS_POLICY_BURN_WINDOWS",
+                                       "policy_burn_windows", int)
+        self._spares: List[str] = []       # registered re-seed targets
+        self._reseed_handled: set = set()  # member uris already re-seeded
+        self.policy = None
+        if mode != "off":
+            from ps_tpu.elastic.policy import PolicyEngine
+
+            self.policy = PolicyEngine(
+                mode=mode,
+                actions={"rebalance": self._act_rebalance,
+                         "reseed": self._act_reseed,
+                         "shard_add": self._act_shard_add,
+                         "shard_remove": self._act_shard_remove},
+                cooldown_s=float(policy_cooldown_s),
+                burn_windows=int(policy_burn_windows),
+                tick_s=self._eval_every_s)
+            # labeled action/suppression series ride /metrics exactly
+            # like the tsdb's fleet series; removed explicitly at stop()
+            reg.add_exporter(self.policy.render_prometheus)
         # one coordinator per cluster here, so "election" is the moment
         # this process takes ownership of the table — recorded so the
         # flight log of any later incident names who owned membership
@@ -242,11 +299,24 @@ class Coordinator(VanService):
             return tv.encode(tv.OK, worker, None, extra=out)
         elif kind == tv.COORD_TELEMETRY:
             return self._telemetry_reply(worker, extra or {})
+        elif kind == tv.COORD_POLICY:
+            # the autopilot audit surface: mode, per-rule arming,
+            # cooldowns, counters, and the recent decision ring
+            if self.policy is None:
+                return tv.encode(tv.OK, worker, None,
+                                 extra={"mode": "off"})
+            out = self.policy.state()
+            out["actions"] = self.policy.audit(
+                int((extra or {}).get("n", 32)))
+            out["spares"] = list(self._spares)
+            return tv.encode(tv.OK, worker, None, extra=out)
         elif kind == tv.STATS:
             out = {"role": self.role, "members": self._members_view(),
                    "table": self._table.to_wire(),
                    "moves_done": self.moves_done,
                    "hints": self.hints(), "slo": list(self._slo_states)}
+            if self.policy is not None:
+                out["policy"] = self.policy.state()
             return tv.encode(tv.OK, worker, None, extra=out)
         return tv.encode(tv.ERR, worker, None,
                          extra={"error": f"bad kind {kind}"})
@@ -261,11 +331,17 @@ class Coordinator(VanService):
         # stopped coordinator's fleet series leave the scrape NOW, not
         # at the next garbage collection
         obs.default_registry().remove_exporter(self.tsdb.render_prometheus)
+        if self.policy is not None:
+            obs.default_registry().remove_exporter(
+                self.policy.render_prometheus)
 
     def kill(self) -> None:
         super().kill()
         self.hb.close()
         obs.default_registry().remove_exporter(self.tsdb.render_prometheus)
+        if self.policy is not None:
+            obs.default_registry().remove_exporter(
+                self.policy.render_prometheus)
 
     # -- membership ------------------------------------------------------------
 
@@ -286,6 +362,23 @@ class Coordinator(VanService):
             logging.getLogger(__name__).info(
                 "aggregator for host %s registered at %s", host, uri)
             return tv.encode(tv.OK, worker, None, extra=self._table_reply())
+        if role == "spare":
+            # an empty backup process volunteering as a re-seed target:
+            # it serves nothing and owns no table slot until the policy
+            # engine (or an operator) seeds a degraded replica set onto
+            # it. Registration is idempotent per uri.
+            uri = str(extra.get("uri") or "")
+            if not uri:
+                return tv.encode(tv.ERR, worker, None, extra={
+                    "error": "spare registration needs uri"})
+            with self._tlock:
+                if uri not in self._spares:
+                    self._spares.append(uri)
+            obs.record_event("coord_spare", uri=uri)
+            logging.getLogger(__name__).info(
+                "spare registered at %s", uri)
+            return tv.encode(tv.OK, worker, None,
+                             extra={"spares": len(self._spares)})
         if role != "server":
             # workers just fetch the table; no registration needed
             return tv.encode(tv.OK, worker, None, extra=self._table_reply())
@@ -370,6 +463,8 @@ class Coordinator(VanService):
                     member.node = self._next_node
                     self._next_node += 1
                 member.key_bytes = key_bytes or member.key_bytes
+                if key_bytes:
+                    member.bytes_t = time.monotonic()
             node = member.node
             table = self._table
         logging.getLogger(__name__).info(
@@ -406,8 +501,11 @@ class Coordinator(VanService):
                     "nbytes": extra.get("nbytes"),
                     "push_qps": extra.get("push_qps"),
                     "pull_qps": extra.get("pull_qps"),
+                    # replication health (autopilot re-seed rule input)
+                    "repl": extra.get("repl"),
                 }
                 member.report_t = time.monotonic()
+                member.bytes_t = member.report_t
                 if extra.get("nbytes") is not None:
                     total = int(extra["nbytes"])
                     if member.key_bytes and total:
@@ -420,6 +518,15 @@ class Coordinator(VanService):
         self._note_dead_members()
         if self.telemetry:
             self._maybe_evaluate()
+        if self.policy is not None:
+            # the autopilot ticks on report traffic exactly like the
+            # telemetry signals — no poll thread, self-throttled to the
+            # evaluation cadence, and a broken tick never fails a report
+            try:
+                self.policy.maybe_tick(self._policy_view())
+            except Exception:
+                logging.getLogger(__name__).warning(
+                    "policy tick failed", exc_info=True)
         if self.auto and member is not None:
             self._maybe_auto_rebalance()
         reply["epoch"] = self._table.epoch
@@ -451,11 +558,18 @@ class Coordinator(VanService):
         # members render OUTSIDE _tlock: _members_view re-acquires it
         # (and polls the heartbeat monitor — no reason to do that under
         # the table lock anyway)
-        return {"table": table.to_wire(),
-                "members": self._members_view(),
-                "migration": mig,
-                "aggregators": aggs,
-                "hints": self.hints()}
+        out = {"table": table.to_wire(),
+               "members": self._members_view(),
+               "migration": mig,
+               "aggregators": aggs,
+               "hints": self.hints()}
+        if self.policy is not None:
+            # the autopilot summary ps_top's --coord header renders:
+            # mode, arming, cooldowns, counters, the last decision
+            out["policy"] = self.policy.state()
+            with self._tlock:
+                out["spares"] = list(self._spares)
+        return out
 
     # -- fleet telemetry -------------------------------------------------------
 
@@ -511,7 +625,7 @@ class Coordinator(VanService):
                     per_member.setdefault(m, {})[metric] = mw["summary"]
         with self._tlock:
             shards = {m.uri: i for i, m in enumerate(self._members)}
-        return tv.encode(tv.OK, worker, None, extra={
+        out = {
             "window_s": self.tsdb.window_s if w is None else w,
             "members": self.tsdb.members(),
             "shards": shards,
@@ -522,23 +636,55 @@ class Coordinator(VanService):
             "stragglers": self.straggler.suspects(),
             "slo": list(self._slo_states),
             "hints": self.hints(),
-        })
+        }
+        if self.policy is not None:
+            # autopilot decisions ride the fleet query: recent audit
+            # entries + the live brake state (ps_top --fleet, ps_doctor)
+            p = self.policy.state()
+            p["actions"] = self.policy.audit(16)
+            out["policy"] = p
+        return tv.encode(tv.OK, worker, None, extra=out)
 
-    def hints(self) -> List[dict]:
+    def hints(self, now: Optional[float] = None) -> List[dict]:
         """Current rebalance hints: straggler suspects (latency outliers
         the byte-balancer cannot see) NEXT TO the byte-skew trigger the
-        auto-rebalancer fires on — one place an operator reads both."""
-        out: List[dict] = list(self.straggler.hints()) \
-            if self.telemetry else []
+        auto-rebalancer fires on — one place an operator reads both.
+
+        Every hint is stamped with the coordinator-clock instant its
+        inputs were computed (``t``, ``time.monotonic``) and the window
+        they cover (``window_s``), and EXPIRES out of the reply once the
+        stamp ages past 3x its window — a consumer (operator, the
+        autopilot) can always tell a live hint from one whose telemetry
+        stopped flowing. Straggler hints carry the last signal-evaluation
+        pass over the tsdb window; the byte-skew hint carries the
+        freshest per-member byte refresh (registration or load report)
+        over the report cadence. ``now`` injects a clock for tests."""
+        now = time.monotonic() if now is None else float(now)
+        out: List[dict] = []
+        if self.telemetry:
+            t = self._last_eval
+            w = self.tsdb.window_s
+            if now - t <= 3.0 * w:  # the tsdb's own staleness rule
+                for h in self.straggler.hints():
+                    h["t"] = round(t, 3)
+                    h["window_s"] = w
+                    out.append(h)
         with self._tlock:
             dense = {i: m.nbytes for i, m in enumerate(self._members)
                      if m.kind != "sparse"}
-        if len(dense) >= 2:
+            bytes_t = max((m.bytes_t for m in self._members
+                           if m.kind != "sparse"), default=now)
+        # byte view window: generous — reports refresh it every
+        # report_ms, but a fleet that has only registered (no reports
+        # yet) must not lose its hint inside the telemetry window
+        skew_w = max(3.0 * self.report_ms / 1000.0, self.tsdb.window_s)
+        if len(dense) >= 2 and now - bytes_t <= 3.0 * skew_w:
             s = skew(dense)
             if s > self.max_skew:
                 out.append({
                     "kind": "byte_skew", "skew": round(s, 2),
                     "max_skew": self.max_skew,
+                    "t": round(bytes_t, 3), "window_s": skew_w,
                     "action": (f"byte skew {s:.2f} exceeds "
                                f"rebalance_max_skew={self.max_skew} — "
                                f"a rebalance would level the shards"
@@ -565,6 +711,115 @@ class Coordinator(VanService):
                 obs.record_event("coord_member_dead", shard=i, uri=m.uri)
                 logging.getLogger(__name__).warning(
                     "member %s (shard %d) stopped heartbeating", m.uri, i)
+
+    # -- autopilot -------------------------------------------------------------
+
+    def _policy_view(self) -> dict:
+        """The snapshot the policy rules evaluate: membership +
+        liveness, per-member load reports (with the replication health
+        the servers now ride in them), the STAMPED hints, SLO states,
+        dense byte skew, registered spares, and whether anything is
+        already moving. Plain data — rules never touch coordinator
+        internals, and tests feed synthetic views directly."""
+        members = self._members_view()
+        with self._tlock:
+            spares = list(self._spares)
+            rebal = self._rebalancing is not None
+            handled = set(self._reseed_handled)
+        for m in members:
+            m["handled"] = m["uri"] in handled
+        dense = {m["shard"]: m["nbytes"] for m in members
+                 if m["kind"] != "sparse"}
+        return {
+            "now": time.monotonic(),
+            "members": members,
+            "spares": spares,
+            "rebalancing": rebal,
+            "hints": self.hints(),
+            "slo": list(self._slo_states),
+            "skew": skew(dense) if len(dense) >= 2 else None,
+            "max_skew": self.max_skew,
+        }
+
+    # action executors the engine runs on its worker thread — each is
+    # just the existing operator surface, called by a machine
+    def _act_rebalance(self, detail: dict) -> dict:
+        return self.rebalance(targets=detail.get("targets"))
+
+    def _act_shard_add(self, detail: dict) -> dict:
+        return self.rebalance(targets=detail.get("targets"))
+
+    def _act_shard_remove(self, detail: dict) -> dict:
+        return self.rebalance(drain=detail.get("drain"))
+
+    def _act_reseed(self, detail: dict) -> dict:
+        """Re-seed a degraded replica set onto a registered spare: probe
+        the pair for the surviving PRIMARY, tell it to quiesce and ship
+        its full state point (``RESEED`` → ``REPLICA_SEED``), then
+        publish the healed pair URI at the next table epoch."""
+        from ps_tpu.backends.common import parse_replica_uri
+
+        shard = int(detail["shard"])
+        uri = str(detail["uri"])
+        spare = str(detail["spare"])
+        with self._tlock:
+            if spare in self._spares:
+                self._spares.remove(spare)
+        _, sets = parse_replica_uri(uri)
+        primary = None
+        for host, port in sets[0]:
+            try:
+                ch = tv.Channel.connect(host, port)
+                try:
+                    _, _, _, st = tv.decode(ch.request(tv.encode(
+                        tv.REPLICA_STATE, 0, None, extra={})))
+                finally:
+                    ch.close()
+                if st.get("role") == "primary":
+                    primary = (host, port)
+                    break
+            except (tv.VanError, OSError):
+                continue
+        if primary is None:
+            with self._tlock:
+                self._spares.insert(0, spare)  # nothing consumed it
+            raise RuntimeError(
+                f"no live primary found in replica set {uri!r}")
+        host, port = primary
+        ch = tv.Channel.connect(host, port)
+        try:
+            kind, _, _, out = tv.decode(ch.request(tv.encode(
+                tv.RESEED, 0, None, extra={"spare": spare})))
+        finally:
+            ch.close()
+        if kind != tv.OK:
+            with self._tlock:
+                self._spares.insert(0, spare)
+            raise RuntimeError(f"primary {host}:{port} refused re-seed: "
+                               f"{out.get('error')}")
+        new_uri = f"{host}:{port}|{spare}"
+        with self._tlock:
+            if shard < len(self._members) \
+                    and self._members[shard].uri == uri:
+                self._members[shard].uri = new_uri
+                shards = list(self._table.shards)
+                shards[shard] = new_uri
+                self._table = ShardTable(self._table.epoch + 1,
+                                         shards, self._table.assign)
+            # both spellings are done: the degraded pair, and the healed
+            # one (its hb node is still the dead primary's — without
+            # this the rule would re-fire on the healed member forever)
+            self._reseed_handled.add(uri)
+            self._reseed_handled.add(new_uri)
+            epoch = self._table.epoch
+        obs.record_event("coord_reseed", shard=shard, uri=new_uri,
+                         old_uri=uri, spare=spare, epoch=epoch,
+                         bytes=out.get("bytes"), keys=out.get("keys"))
+        logging.getLogger(__name__).info(
+            "re-seeded shard %d replica set onto %s (epoch %d)",
+            shard, spare, epoch)
+        return {"epoch": epoch, "uri": new_uri,
+                "bytes": out.get("bytes"), "keys": out.get("keys")}
 
     # -- rebalance -------------------------------------------------------------
 

@@ -37,7 +37,8 @@ from ps_tpu.elastic.table import ShardTable
 
 __all__ = ["CoordinatorMember", "TelemetryReporter", "fetch_table",
            "fetch_view", "fetch_telemetry", "fetch_aggregators",
-           "request_rebalance", "parse_coord"]
+           "request_rebalance", "register_spare", "fetch_policy",
+           "parse_coord"]
 
 
 def parse_coord(addr: Union[str, Tuple[str, int]]) -> Tuple[str, int]:
@@ -147,6 +148,26 @@ def request_rebalance(addr, moves=None, targets=None, drain=None,
     if drain is not None:
         extra["drain"] = [int(d) for d in drain]
     return _coord_request(addr, tv.COORD_REBALANCE, extra=extra,
+                          timeout_ms=timeout_ms)
+
+
+def register_spare(addr, uri: str, timeout_ms: int = 5000) -> dict:
+    """Register an empty backup process as a re-seed target (README
+    "Autopilot & chaos"): the autopilot's ``replica_reseed`` rule heals
+    a consumed replica set onto the first registered spare. Idempotent
+    per uri; the spare serves nothing until seeded."""
+    return _coord_request(addr, tv.COORD_HELLO,
+                          extra={"role": "spare", "uri": str(uri)},
+                          timeout_ms=timeout_ms)
+
+
+def fetch_policy(addr, n: int = 32, timeout_ms: int = 5000) -> dict:
+    """One ``COORD_POLICY`` round trip: the autopilot's audit surface —
+    mode, per-rule arming/streaks, per-action-class cooldown remaining,
+    action/suppression counters, and the last ``n`` audit entries
+    (``ps_top --coord``'s policy line rides this)."""
+    extra = {"n": int(n)}
+    return _coord_request(addr, tv.COORD_POLICY, extra=extra,
                           timeout_ms=timeout_ms)
 
 

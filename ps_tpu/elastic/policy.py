@@ -1,0 +1,562 @@
+"""Autopilot: the coordinator's declarative policy engine.
+
+Every production mechanism below this module already exists in
+isolation — SLO burn and straggler suspects (ps_tpu/obs), byte-skew
+hints and live key-range rebalance (the coordinator), replica failover
+with an exactly-once ledger (ps_tpu/replica) — but until now a human (or
+a test) had to connect them. This module closes the telemetry→elastic
+loop: a rule evaluator runs over the fleet TSDB signals and
+:meth:`~ps_tpu.elastic.coordinator.Coordinator.hints` and turns
+SUSTAINED signals into planned actions:
+
+- ``hotspot_rebalance`` — sustained SLO burn, a straggler suspect, or
+  byte skew past the threshold plans a rebalance toward the healthy set
+  (suspects are excluded from the target list, so their keys drain);
+- ``replica_reseed`` — a member dead past the failover window whose
+  backup was consumed by promotion triggers a re-seed: the promoted
+  survivor quiesces, ships its full state point to a registered spare,
+  and re-attaches the replication stream (``RESEED``/``REPLICA_SEED``);
+- ``shard_add`` — a registered empty standby plus sustained overload
+  spreads the key range onto the standbys (the 2→4 half of the drill);
+- ``shard_drain`` — sustained underload drains and removes the shards
+  beyond the configured floor (4→2).
+
+Acting is the easy part; NOT acting is the engineering. Every rule is
+gated by the storm brakes a flapping signal would otherwise defeat:
+
+- **burn windows**: a signal must hold for ``burn_windows`` consecutive
+  evaluation ticks before its rule fires — noise one window shorter
+  never acts;
+- **hysteresis**: after firing, a rule re-arms only after
+  ``burn_windows`` consecutive ticks with the signal fully QUIET (below
+  the recover threshold, which sits at ``recover_frac`` of the fire
+  threshold) — hovering between the two thresholds neither fires nor
+  re-arms;
+- **per-action-class cooldown**: an action class that just ran stays
+  cooled down for ``cooldown_s`` regardless of rule state;
+- **global concurrency cap of ONE**: a planned action in flight (or a
+  rebalance started by anything else) suppresses every other fire;
+- **dry-run**: ``mode="dry"`` evaluates, decides, audits, and cools
+  down exactly like ``"on"`` — but never executes.
+
+Every decision lands in a bounded audit ring (served on the
+``COORD_POLICY`` wire kind and ridden in ``COORD_TELEMETRY`` replies),
+in flight events (``policy_fire`` / ``policy_acted`` /
+``policy_suppressed`` / ``policy_cooldown``), and in the
+``ps_policy_actions_total{action,outcome}`` /
+``ps_policy_suppressed_total{reason}`` Prometheus series (rendered by a
+registry exporter — the metrics registry itself is label-free by
+design, same pattern as the fleet TSDB's labeled series).
+
+The engine is deliberately passive: it owns no thread and no socket. The
+coordinator calls :meth:`PolicyEngine.maybe_tick` from its existing lazy
+evaluation path, and executes actions through callables it injected at
+construction — with ``policy="off"`` (the default) no engine exists at
+all and the coordinator behaves byte-identically to before this module.
+"""
+
+from __future__ import annotations
+
+import collections
+import math
+import threading
+import time
+from typing import Callable, Dict, List, Optional
+
+from ps_tpu import obs
+
+__all__ = ["PolicyEngine", "PolicyRule", "HotspotRebalance",
+           "ReplicaReseed", "ShardAdd", "ShardDrain"]
+
+#: signal levels a rule reports per tick
+QUIET, ELEVATED, FIRING = 0, 1, 2
+
+
+class PolicyRule:
+    """One declarative rule: a leveled signal plus an action plan.
+
+    ``signal(view)`` returns QUIET (below the recover threshold),
+    ELEVATED (between recover and fire — sustains neither firing nor
+    re-arming), or FIRING. ``plan(view)`` turns the current view into
+    the action detail dict the engine hands the executor, or ``None``
+    with ``self.why`` set when no actionable plan exists (no spare, no
+    healthy target) — the engine records that as a suppression, never
+    an error."""
+
+    name = "rule"
+    action = "noop"
+
+    def __init__(self):
+        self.why: Optional[str] = None
+
+    def signal(self, view: dict) -> int:
+        raise NotImplementedError
+
+    def plan(self, view: dict) -> Optional[dict]:
+        raise NotImplementedError
+
+
+def _dense(view: dict) -> List[dict]:
+    return [m for m in view.get("members") or []
+            if m.get("kind") != "sparse"]
+
+
+class HotspotRebalance(PolicyRule):
+    """Sustained SLO burn, a straggler suspect, or byte skew past the
+    threshold → rebalance toward the healthy set. With suspects the
+    target list excludes them (their keys drain to healthy shards);
+    without, the plan is a plain leveling pass over every dense shard."""
+
+    name = "hotspot_rebalance"
+    action = "rebalance"
+
+    def __init__(self, recover_frac: float = 0.8):
+        super().__init__()
+        self.recover_frac = float(recover_frac)
+
+    def _suspects(self, view: dict) -> List[int]:
+        return sorted({int(h["shard"]) for h in view.get("hints") or []
+                       if h.get("kind") == "straggler"
+                       and h.get("shard") is not None})
+
+    def signal(self, view: dict) -> int:
+        if self._suspects(view):
+            return FIRING
+        level = QUIET
+        for s in view.get("slo") or []:
+            thr, val = s.get("threshold_ms"), s.get("value_ms")
+            if s.get("breached"):
+                return FIRING
+            if thr and val is not None and val >= self.recover_frac * thr:
+                level = ELEVATED
+        sk, mx = view.get("skew"), view.get("max_skew")
+        # inf skew means some dense shard holds ZERO bytes — that is a
+        # standby waiting for shard_add, not a hotspot; latching FIRING
+        # on it would disarm this rule forever after its own drain
+        if sk is not None and mx and math.isfinite(sk):
+            if sk > mx:
+                return FIRING
+            if sk > self.recover_frac * mx:
+                level = max(level, ELEVATED)
+        return level
+
+    def plan(self, view: dict) -> Optional[dict]:
+        self.why = None
+        dense = _dense(view)
+        if len(dense) < 2:
+            self.why = "single_shard"
+            return None
+        suspects = set(self._suspects(view))
+        healthy = [m["shard"] for m in dense
+                   if m["shard"] not in suspects
+                   and m.get("hb_state") not in ("dead", "left")]
+        if suspects and healthy:
+            return {"targets": sorted(healthy),
+                    "suspects": sorted(suspects)}
+        if not suspects:
+            # no outlier to drain — a leveling pass over the dense fleet
+            return {"targets": sorted(m["shard"] for m in dense)}
+        self.why = "no_healthy_target"
+        return None
+
+
+class ReplicaReseed(PolicyRule):
+    """A member dead past the failover window with its backup consumed
+    (its replica set's survivor promoted, or its stream degraded) →
+    re-seed a registered spare and re-attach replication. The engine's
+    executor marks handled members so a consumed death re-fires only
+    after the next failover, not forever."""
+
+    name = "replica_reseed"
+    action = "reseed"
+
+    def _candidates(self, view: dict) -> List[dict]:
+        out = []
+        for m in _dense(view):
+            if m.get("handled"):
+                continue
+            repl = (m.get("report") or {}).get("repl") or {}
+            consumed = bool(repl.get("promoted")) and not repl.get("attached")
+            degraded = bool(repl.get("degraded"))
+            dead_pair = (m.get("hb_state") == "dead"
+                         and "|" in str(m.get("uri", "")))
+            if consumed or degraded or dead_pair:
+                out.append(m)
+        return out
+
+    def signal(self, view: dict) -> int:
+        return FIRING if self._candidates(view) else QUIET
+
+    def plan(self, view: dict) -> Optional[dict]:
+        self.why = None
+        cands = self._candidates(view)
+        if not cands:
+            self.why = "no_candidate"
+            return None
+        spares = list(view.get("spares") or [])
+        if not spares:
+            self.why = "no_spare"
+            return None
+        m = cands[0]
+        return {"shard": m["shard"], "uri": m["uri"], "spare": spares[0]}
+
+
+class ShardAdd(PolicyRule):
+    """A registered empty standby plus sustained overload (an SLO
+    breach) → spread the key range over every dense shard, standbys
+    included — the live 2→4 split."""
+
+    name = "shard_add"
+    action = "shard_add"
+
+    def __init__(self, recover_frac: float = 0.8):
+        super().__init__()
+        self.recover_frac = float(recover_frac)
+
+    def _standbys(self, view: dict) -> List[int]:
+        return [m["shard"] for m in _dense(view)
+                if not m.get("keys") and m.get("hb_state") != "dead"]
+
+    def signal(self, view: dict) -> int:
+        if not self._standbys(view):
+            return QUIET
+        level = QUIET
+        for s in view.get("slo") or []:
+            thr, val = s.get("threshold_ms"), s.get("value_ms")
+            if s.get("breached"):
+                return FIRING
+            if thr and val is not None and val >= self.recover_frac * thr:
+                level = ELEVATED
+        return level
+
+    def plan(self, view: dict) -> Optional[dict]:
+        self.why = None
+        if not self._standbys(view):
+            self.why = "no_standby"
+            return None
+        return {"targets": sorted(m["shard"] for m in _dense(view))}
+
+
+class ShardDrain(PolicyRule):
+    """Sustained underload (fleet push QPS under the floor) with more
+    dense shards than the configured minimum → drain and remove the
+    shards beyond the floor (4→2). Standbys and the emptiest shards
+    leave first; the rule never plans below ``min_shards``."""
+
+    name = "shard_drain"
+    action = "shard_remove"
+
+    def __init__(self, qps_floor: float = 1.0, min_shards: int = 2):
+        super().__init__()
+        self.qps_floor = float(qps_floor)
+        self.min_shards = int(min_shards)
+
+    def signal(self, view: dict) -> int:
+        dense = _dense(view)
+        if len(dense) <= self.min_shards:
+            return QUIET
+        qps = [float((m.get("report") or {}).get("push_qps") or 0.0)
+               for m in dense]
+        if not any((m.get("report") or {}).get("push_qps") is not None
+                   for m in dense):
+            return QUIET  # no load data at all: never drain blind
+        total = sum(qps)
+        if total < self.qps_floor:
+            return FIRING
+        if total < 2.0 * self.qps_floor:
+            return ELEVATED
+        return QUIET
+
+    def plan(self, view: dict) -> Optional[dict]:
+        self.why = None
+        dense = _dense(view)
+        extra = len(dense) - self.min_shards
+        if extra <= 0:
+            self.why = "at_floor"
+            return None
+        # emptiest leave first; ties broken toward the latest joiners
+        order = sorted(dense, key=lambda m: (int(m.get("nbytes") or 0),
+                                             -int(m["shard"])))
+        drain = sorted(m["shard"] for m in order[:extra])
+        return {"drain": drain}
+
+
+class _RuleState:
+    __slots__ = ("streak", "quiet", "armed", "fired_total")
+
+    def __init__(self):
+        self.streak = 0       # consecutive FIRING ticks
+        self.quiet = 0        # consecutive QUIET ticks (re-arm progress)
+        self.armed = True
+        self.fired_total = 0
+
+
+class PolicyEngine:
+    """Rule evaluation + the storm brakes + the audit surface.
+
+    Args:
+      mode: ``"dry"`` (decide and record, never execute) or ``"on"``
+        (execute through the injected action callables). ``"off"`` is
+        represented by NOT constructing an engine.
+      actions: ``{action_class: callable(detail) -> result}`` — the
+        executors the coordinator injects (rebalance / reseed / ...).
+        A missing class downgrades that rule to dry behavior.
+      cooldown_s / burn_windows: the ``PS_POLICY_COOLDOWN_S`` /
+        ``PS_POLICY_BURN_WINDOWS`` brakes (see module docstring).
+      tick_s: minimum spacing between evaluation ticks —
+        :meth:`maybe_tick` self-throttles so the caller can invoke it on
+        every report.
+      rules: override the default rule set (tests inject synthetic
+        single-rule engines).
+
+    Thread-safe: ticks arrive from coordinator serve threads, actions
+    run on a short-lived daemon thread, and the audit/counter surfaces
+    are read from wire handlers and the /metrics exporter.
+    """
+
+    def __init__(self, mode: str = "dry",
+                 actions: Optional[Dict[str, Callable]] = None,
+                 cooldown_s: float = 30.0, burn_windows: int = 3,
+                 tick_s: float = 0.25,
+                 rules: Optional[List[PolicyRule]] = None,
+                 audit: int = 256):
+        if mode not in ("dry", "on"):
+            raise ValueError(f"policy mode {mode!r} is not dry/on "
+                             f"(off = no engine)")
+        self.mode = mode
+        self.cooldown_s = float(cooldown_s)
+        self.burn_windows = int(burn_windows)
+        self.tick_s = float(tick_s)
+        self.rules: List[PolicyRule] = rules if rules is not None else [
+            ReplicaReseed(), HotspotRebalance(), ShardAdd(), ShardDrain(),
+        ]
+        self._actions = dict(actions or {})
+        self._lock = threading.Lock()
+        self._state: Dict[str, _RuleState] = {
+            r.name: _RuleState() for r in self.rules}
+        self._cool: Dict[str, float] = {}      # action class -> fire t
+        self._inflight: Optional[str] = None   # rule name mid-execution
+        self._last_tick = 0.0
+        self._audit = collections.deque(maxlen=int(audit))
+        self._last_action: Optional[dict] = None
+        self.actions_total: Dict[tuple, int] = {}    # (action, outcome)
+        self.suppressed_total: Dict[str, int] = {}   # reason
+        self.ticks = 0
+
+    # -- evaluation ------------------------------------------------------------
+
+    def maybe_tick(self, view: dict, now: Optional[float] = None) -> None:
+        """Tick if at least ``tick_s`` elapsed since the last one —
+        the coordinator calls this on every report, the throttle makes
+        it a window clock."""
+        now = time.monotonic() if now is None else float(now)
+        with self._lock:
+            if now - self._last_tick < self.tick_s:
+                return
+            self._last_tick = now
+        self.tick(view, now=now)
+
+    def tick(self, view: dict, now: Optional[float] = None) -> List[dict]:
+        """One evaluation window: advance every rule's streak/quiet
+        counters and run AT MOST ONE eligible action through the gates.
+        Returns this tick's audit entries (tests assert on them)."""
+        now = time.monotonic() if now is None else float(now)
+        out: List[dict] = []
+        fired_this_tick = False
+        for rule in self.rules:
+            st = self._state[rule.name]
+            try:
+                lvl = rule.signal(view)
+            except Exception as e:  # a broken signal must not kill the
+                # coordinator's report path — audit it and move on
+                out.append(self._note(rule, "error", now,
+                                      {"error": repr(e)}))
+                continue
+            with self._lock:
+                if lvl >= FIRING:
+                    st.streak += 1
+                    st.quiet = 0
+                elif lvl == ELEVATED:
+                    st.streak = 0
+                    st.quiet = 0
+                else:
+                    st.streak = 0
+                    st.quiet += 1
+                    if not st.armed and st.quiet >= self.burn_windows:
+                        st.armed = True
+                eligible = st.armed and st.streak >= self.burn_windows
+            if not eligible:
+                continue
+            entry = self._try_fire(rule, st, view, now,
+                                   concurrent=fired_this_tick)
+            out.append(entry)
+            if entry["outcome"] in ("dry", "started"):
+                fired_this_tick = True
+        with self._lock:
+            self.ticks += 1
+        return out
+
+    # -- gates + execution -----------------------------------------------------
+
+    def _try_fire(self, rule: PolicyRule, st: _RuleState, view: dict,
+                  now: float, concurrent: bool) -> dict:
+        with self._lock:
+            inflight = self._inflight
+        if concurrent or inflight is not None \
+                or view.get("rebalancing"):
+            reason = "inflight"
+            self._count_suppressed(reason)
+            obs.record_event("policy_suppressed", rule=rule.name,
+                             action=rule.action, reason=reason)
+            return self._note(rule, "suppressed", now, {"reason": reason})
+        with self._lock:
+            last = self._cool.get(rule.action)
+            cooling = last is not None and now - last < self.cooldown_s
+            remaining = (self.cooldown_s - (now - last)) if cooling else 0.0
+        if cooling:
+            self._count_suppressed("cooldown")
+            obs.record_event("policy_cooldown", rule=rule.name,
+                            action=rule.action,
+                            remaining_s=round(remaining, 3))
+            return self._note(rule, "suppressed", now,
+                              {"reason": "cooldown",
+                               "remaining_s": round(remaining, 3)})
+        try:
+            detail = rule.plan(view)
+        except Exception as e:
+            detail, rule.why = None, f"plan_error:{e!r}"
+        if detail is None:
+            reason = rule.why or "no_plan"
+            self._count_suppressed(reason)
+            obs.record_event("policy_suppressed", rule=rule.name,
+                             action=rule.action, reason=reason)
+            return self._note(rule, "suppressed", now, {"reason": reason})
+        # the signal held and a plan exists: this IS the fire decision
+        obs.record_event("policy_fire", rule=rule.name, action=rule.action,
+                         mode=self.mode, **{k: v for k, v in detail.items()
+                                            if isinstance(v, (int, float,
+                                                              str))})
+        fn = self._actions.get(rule.action)
+        with self._lock:
+            st.armed = False
+            st.streak = 0
+            st.fired_total += 1
+            self._cool[rule.action] = now
+        if self.mode == "dry" or fn is None:
+            self._count_action(rule.action, "dry")
+            entry = self._note(rule, "dry", now, detail)
+            with self._lock:
+                self._last_action = entry
+            return entry
+        with self._lock:
+            self._inflight = rule.name
+        entry = self._note(rule, "started", now, detail)
+        with self._lock:
+            self._last_action = entry
+        threading.Thread(target=self._run_action,
+                         args=(rule, fn, detail, entry),
+                         daemon=True, name="ps-coord-policy").start()
+        return entry
+
+    def _run_action(self, rule: PolicyRule, fn: Callable, detail: dict,
+                    entry: dict) -> None:
+        t0 = time.monotonic()
+        try:
+            result = fn(detail)
+            outcome = "ok"
+        except Exception as e:
+            result, outcome = {"error": repr(e)}, "failed"
+        dt = time.monotonic() - t0
+        with self._lock:
+            self._inflight = None
+            entry["outcome"] = outcome
+            entry["seconds"] = round(dt, 3)
+            if isinstance(result, dict):
+                entry["result"] = result
+        self._count_action(rule.action, outcome)
+        obs.record_event("policy_acted", rule=rule.name,
+                         action=rule.action, outcome=outcome,
+                         seconds=round(dt, 3))
+
+    # -- bookkeeping -----------------------------------------------------------
+
+    def _note(self, rule: PolicyRule, outcome: str, now: float,
+              detail: dict) -> dict:
+        entry = {"t": round(time.time(), 3), "mono": round(now, 3),
+                 "rule": rule.name, "action": rule.action,
+                 "mode": self.mode, "outcome": outcome,
+                 "detail": dict(detail)}
+        with self._lock:
+            self._audit.append(entry)
+        return entry
+
+    def _count_action(self, action: str, outcome: str) -> None:
+        with self._lock:
+            key = (action, outcome)
+            self.actions_total[key] = self.actions_total.get(key, 0) + 1
+
+    def _count_suppressed(self, reason: str) -> None:
+        with self._lock:
+            self.suppressed_total[reason] = \
+                self.suppressed_total.get(reason, 0) + 1
+
+    # -- read surfaces ---------------------------------------------------------
+
+    def audit(self, n: Optional[int] = None) -> List[dict]:
+        with self._lock:
+            events = list(self._audit)
+        return events if n is None else events[-int(n):]
+
+    def last_action(self) -> Optional[dict]:
+        with self._lock:
+            return dict(self._last_action) if self._last_action else None
+
+    def state(self) -> dict:
+        """The COORD_POLICY reply body: mode, brakes, per-rule arming,
+        per-class cooldown remaining, counters, and the recent audit."""
+        now = time.monotonic()
+        with self._lock:
+            rules = {}
+            for r in self.rules:
+                st = self._state[r.name]
+                rules[r.name] = {
+                    "action": r.action, "armed": st.armed,
+                    "streak": st.streak, "quiet": st.quiet,
+                    "fired_total": st.fired_total,
+                }
+            cooldown = {
+                a: round(max(0.0, self.cooldown_s - (now - t)), 3)
+                for a, t in self._cool.items()
+                if now - t < self.cooldown_s}
+            return {
+                "mode": self.mode,
+                "cooldown_s": self.cooldown_s,
+                "burn_windows": self.burn_windows,
+                "ticks": self.ticks,
+                "inflight": self._inflight,
+                "rules": rules,
+                "cooldown": cooldown,
+                "actions_total": {f"{a}:{o}": n for (a, o), n
+                                  in sorted(self.actions_total.items())},
+                "suppressed_total": dict(self.suppressed_total),
+                "last_action": (dict(self._last_action)
+                                if self._last_action else None),
+            }
+
+    def render_prometheus(self) -> str:
+        """``ps_policy_actions_total{action,outcome}`` /
+        ``ps_policy_suppressed_total{reason}`` — labeled series rendered
+        by an exporter hook, exactly like the fleet TSDB's (the registry
+        itself is label-free by design)."""
+        with self._lock:
+            acts = sorted(self.actions_total.items())
+            supp = sorted(self.suppressed_total.items())
+        lines = ["# TYPE ps_policy_actions_total counter"]
+        for (action, outcome), n in acts:
+            lines.append(f'ps_policy_actions_total{{action="{action}",'
+                         f'outcome="{outcome}"}} {n}')
+        lines.append("# TYPE ps_policy_suppressed_total counter")
+        for reason, n in supp:
+            lines.append(f'ps_policy_suppressed_total{{reason="{reason}"}}'
+                         f' {n}')
+        return "\n".join(lines)

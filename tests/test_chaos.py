@@ -1,0 +1,275 @@
+"""Chaos harness primitives (ps_tpu/chaos) + the self-heal loops they
+prove: deterministic fault scheduling under ``PS_CHAOS_SEED``, the
+blackhole hook's typed park-and-retry refusal, the elastic worker's
+coordinator re-discovery when a whole replica SET refuses (the product
+fix this PR ships in ``RemoteAsyncWorker._on_server_lost``), and the
+autopilot's replica re-seed closing the loop end to end in-process:
+primary dies → watch promotes the backup → the policy re-seeds a
+registered spare bitwise, ledger intact.
+
+The full multi-fault soak with subprocess members lives in
+``bench.py --model chaos`` (wired into ``tools/ci_bench_smoke.sh``);
+these tests keep each mechanism pinned at tier-1 speed.
+"""
+
+import time
+
+import numpy as np
+
+import ps_tpu as ps
+from ps_tpu.backends.remote_async import AsyncPSService, connect_async
+from ps_tpu.chaos import ChaosHook, ChaosInjector
+from ps_tpu.chaos.inject import DATA_KINDS
+from ps_tpu.chaos.member import make_tree, parse_keys
+from ps_tpu.control import tensor_van as tv
+from ps_tpu.elastic import Coordinator
+from ps_tpu.elastic.member import CoordinatorMember, register_spare
+
+
+def _wait(cond, timeout_s, what):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.05)
+    raise AssertionError(f"timed out after {timeout_s}s waiting for {what}")
+
+
+# -- deterministic scheduling + the ledger ------------------------------------
+
+
+def test_injector_plan_deterministic_under_seed(monkeypatch):
+    classes = ["blackhole", "sigstop", "slow_apply", "reconnect_storm"]
+    a = ChaosInjector(seed=7).plan(classes, 30.0, spacing_s=2.0)
+    b = ChaosInjector(seed=7).plan(classes, 30.0, spacing_s=2.0)
+    assert a == b  # same seed -> the same drills at the same offsets
+    assert len(a) == len(classes)
+    assert sorted(row["fault"] for row in a) == sorted(classes)
+    assert all(a[i]["at_s"] < a[i + 1]["at_s"] for i in range(len(a) - 1))
+    c = ChaosInjector(seed=8).plan(classes, 30.0, spacing_s=2.0)
+    assert c != a
+    # seed=None reads PS_CHAOS_SEED (Config.chaos_seed) — the knob CI
+    # pins so a failing soak replays bit-identically
+    monkeypatch.setenv("PS_CHAOS_SEED", "41")
+    assert ChaosInjector().seed == 41
+    assert ChaosInjector().plan(classes, 30.0) == \
+        ChaosInjector(seed=41).plan(classes, 30.0)
+
+
+def test_injector_ledger_records_marks():
+    inj = ChaosInjector(seed=0)
+    row = inj.mark("agg_death", target=1234)
+    assert row["fault"] == "agg_death" and row["target"] == 1234
+    assert [r["fault"] for r in inj.injections] == ["agg_death"]
+    assert all("t" in r for r in inj.injections)
+
+
+# -- the blackhole hook's refusal shape ---------------------------------------
+
+
+def test_chaos_hook_refuses_data_plane_only():
+    class FakeSvc:
+        port = 1234
+        epoch = 3
+
+    svc = FakeSvc()
+    hook = ChaosHook(svc)
+    assert svc.chaos is hook
+    # inert hook: every frame passes through to the real handler
+    assert hook(svc, tv.PUSH, 0, {}) is None
+    hook.blackhole(30.0)
+    assert hook.active
+    # control plane stays up — the fault starves workers, not the
+    # coordinator / replication / checkpoint machinery
+    assert hook(svc, tv.STATS, 0, {}) is None
+    assert hook(svc, tv.COORD_TABLE, 0, {}) is None
+    # data plane gets the typed backup-shaped refusal: retry-able, epoch
+    # carried, so the ordinary failover loop does the waiting
+    for kind in sorted(DATA_KINDS):
+        reply = hook(svc, kind, 2, {})
+        k, w, _, extra = tv.decode(reply)
+        assert k == tv.ERR and w == 2
+        assert extra["backup"] is True and extra["epoch"] == 3
+        assert "blackhole" in extra["error"]
+    assert hook.refused == len(DATA_KINDS)
+    hook.clear()
+    assert not hook.active
+    assert hook(svc, tv.PUSH, 0, {}) is None
+
+
+# -- deterministic member params ----------------------------------------------
+
+
+def test_make_tree_and_parse_keys():
+    spec = parse_keys("k1:512,k0:256,bare")
+    assert spec == {"k1": 512, "k0": 256, "bare": 256}
+    a = make_tree(spec, seed=7)
+    b = make_tree({"bare": 256, "k0": 256, "k1": 512}, seed=7)
+    assert set(a) == set(spec)
+    for k in a:  # insertion order of the spec must not matter: the
+        # bench and its subprocess members build the SAME arrays
+        assert a[k].dtype == np.float32 and np.array_equal(a[k], b[k])
+    c = make_tree(spec, seed=8)
+    assert not np.array_equal(a["k0"], c["k0"])
+
+
+# -- blackhole end-to-end: park, retry, re-discover, exactly-once -------------
+
+
+def test_blackhole_parks_worker_and_heals_exactly_once():
+    """Regression for the elastic ``_on_server_lost`` path: when a whole
+    single-member replica set refuses with the retry-able backup shape,
+    a coordinator-connected worker must PARK — re-polling the table —
+    and resume against the same epoch when the hole closes, applying
+    every push exactly once."""
+    ps.init(backend="tpu", mode="async", num_workers=1, dc_lambda=0.0)
+    coord = svc = w = None
+    try:
+        tree = make_tree({"p0": 256, "p1": 256}, seed=5)
+        st = ps.KVStore(optimizer="sgd", learning_rate=0.5, mode="async")
+        st.init({k: np.array(v) for k, v in tree.items()})
+        coord = Coordinator(bind="127.0.0.1", telemetry_window_s=2.0)
+        ca = f"127.0.0.1:{coord.port}"
+        svc = AsyncPSService(st, bind="127.0.0.1", coordinator=ca)
+        hook = ChaosHook(svc)
+        w = connect_async(None, 0, tree, coordinator=ca,
+                          failover_timeout=20.0)
+        w.pull_all()
+        grads = {k: np.full(v.shape, 2.0, np.float32)
+                 for k, v in tree.items()}
+        for _ in range(5):
+            w.push_pull(grads)
+        hook.blackhole(1.0)
+        t0 = time.monotonic()
+        w.push_pull(grads)  # parks inside the failover budget, retries
+        waited = time.monotonic() - t0
+        assert waited >= 0.5, f"push sailed through the hole ({waited:.2f}s)"
+        assert hook.refused > 0
+        for _ in range(4):
+            w.push_pull(grads)
+        # exactly-once through the park-and-retry: 10 applies per key
+        for k in tree:
+            assert st._engine.apply_count[k] == 10, k
+    finally:
+        if w is not None:
+            w.close()
+        if svc is not None:
+            svc.stop()
+        if coord is not None:
+            coord.stop()
+        ps.shutdown()
+
+
+# -- the autopilot re-seed closing the loop in-process ------------------------
+
+
+def test_policy_reseeds_spare_after_primary_death():
+    """The ISSUE's marquee loop, in-process: SIGKILL-equivalent primary
+    death → PromotionWatch promotes the backup (timeout path) → the
+    member's repl report shows the backup consumed → ReplicaReseed
+    fires → the coordinator probes the pair, re-seeds the registered
+    spare from the survivor, and the spare mirrors params AND the
+    exactly-once ledger bitwise."""
+    from ps_tpu.control.heartbeat import HeartbeatClient
+    from ps_tpu.replica.watch import PromotionWatch
+
+    ps.init(backend="tpu", mode="async", num_workers=1, dc_lambda=0.0)
+    coord = primary = b0 = spare = watch = hb = member = w = None
+    try:
+        tree = make_tree({"p0": 512, "p1": 512}, seed=21)
+
+        def mkstore(params):
+            st = ps.KVStore(optimizer="sgd", learning_rate=0.5,
+                            mode="async")
+            st.init({k: np.array(v) for k, v in params.items()})
+            return st
+
+        coord = Coordinator(bind="127.0.0.1", report_ms=100,
+                            hb_timeout_ms=5000, telemetry_window_s=2.0,
+                            policy="on", policy_cooldown_s=1.0,
+                            policy_burn_windows=2)
+        ca = f"127.0.0.1:{coord.port}"
+        primary = AsyncPSService(mkstore(tree), bind="127.0.0.1")
+        b0 = AsyncPSService(mkstore(tree), bind="127.0.0.1", backup=True)
+        primary.attach_backup("127.0.0.1", b0.port, ack="sync")
+        watch = PromotionWatch(b0, primary_id=1, timeout_ms=400)
+        hb = HeartbeatClient("127.0.0.1", watch.port, node_id=1,
+                             interval_ms=50)
+        watch.wait_for_primary()
+        spare = AsyncPSService(mkstore(make_tree({"ph": 64}, 3)),
+                               bind="127.0.0.1", backup=True)
+        register_spare(ca, f"127.0.0.1:{spare.port}")
+        pair = f"127.0.0.1:{primary.port}|127.0.0.1:{b0.port}"
+        key_bytes = {k: int(v.nbytes) for k, v in tree.items()}
+
+        def report():
+            s = b0._backup_session  # the survivor's downstream view
+            return {"keys": len(tree), "nbytes": sum(key_bytes.values()),
+                    "push_qps": 5.0,
+                    "repl": {"attached": bool(s is not None
+                                              and not s.degraded),
+                             "degraded": bool(s is not None
+                                              and s.degraded),
+                             "promoted": b0.promote_reason is not None}}
+
+        member = CoordinatorMember(ca, pair, key_bytes, report=report,
+                                   report_ms=100)
+        w = connect_async(pair, 0, tree, failover_timeout=20.0)
+        w.pull_all()
+        grads = {k: np.full(v.shape, 1.0, np.float32)
+                 for k, v in tree.items()}
+        for _ in range(6):
+            w.push_pull(grads)
+        # sync-ack replication: the backup's ledger tracks the primary's
+        assert all(b0._engine.apply_count[k] == 6 for k in tree)
+
+        primary.kill()          # engine state dies as SIGKILL leaves it
+        hb.close(goodbye=False)  # beats just stop -> watch times out
+        _wait(lambda: b0.promote_reason is not None, 10.0, "promotion")
+        assert watch.promoted_reason == "timeout"
+        for _ in range(4):      # worker fails over inside the pair set
+            w.push_pull(grads)
+
+        # the 100ms repl reports now show promoted-without-downstream;
+        # the autopilot must re-seed the spare with no operator call
+        def reseeded():
+            return any(e["rule"] == "replica_reseed"
+                       and e["outcome"] == "ok"
+                       for e in coord.policy.audit())
+
+        _wait(reseeded, 20.0, "policy replica_reseed ok")
+        [entry] = [e for e in coord.policy.audit()
+                   if e["rule"] == "replica_reseed"]
+        assert entry["detail"]["spare"] == f"127.0.0.1:{spare.port}"
+        # the healed pair is published under the next table epoch
+        assert any(u.endswith(f"|127.0.0.1:{spare.port}")
+                   for u in coord.table().shards)
+        # the survivor now streams to the spare...
+        s = b0._backup_session
+        assert s is not None and not s.degraded
+        # ...and the seed carried params AND ledger bitwise
+        assert set(spare._engine._params) == set(tree)
+        for _ in range(3):      # live replication after the re-seed
+            w.push_pull(grads)
+        for k in tree:
+            assert b0._engine.apply_count[k] == 13, k
+            _wait(lambda: spare._engine.apply_count.get(k) == 13, 5.0,
+                  f"spare ledger catch-up for {k}")
+            assert np.array_equal(np.asarray(b0._engine._params[k]),
+                                  np.asarray(spare._engine._params[k])), k
+    finally:
+        for closer in (
+            lambda: w.close(),
+            lambda: member.close(goodbye=True),
+            lambda: hb.close(),
+            lambda: watch.close(),
+            lambda: spare.stop(),
+            lambda: b0.stop(),
+            lambda: primary.stop(),
+            lambda: coord.stop(),
+        ):
+            try:
+                closer()
+            except Exception:
+                pass
+        ps.shutdown()

@@ -529,3 +529,39 @@ print(f"  ratio {det['throughput_ratio']}x at table/budget "
       f"{det['rowsum_conserved']}")
 print("tiered embedding smoke OK")
 EOF
+
+# 10. autopilot chaos soak (<60 s): `bench.py --model chaos --quick` —
+# the policy-driven self-heal loop under scheduled faults (README
+# "Autopilot & chaos"). Asserts every injected fault class healed
+# inside its SLO bound, the per-key exactly-once ledger balanced across
+# the whole soak, at least one policy action EXECUTED (outcome ok), and
+# zero operator interventions inside the soak window.
+out=$(timeout -k 10 120 env JAX_PLATFORMS=cpu python bench.py --model chaos --quick 2>/dev/null | tail -1)
+python - "$out" <<'EOF'
+import json
+import sys
+
+rec = json.loads(sys.argv[1])
+assert rec["metric"] == "chaos_self_heal_p99_s", rec["metric"]
+det = rec["detail"]
+assert det["exactly_once"], \
+    "the per-key apply ledger did not balance across the soak"
+assert det["operator_actions_in_soak"] == 0, \
+    f"soak needed operator help: {det['operator_actions_in_soak']}"
+assert det["faults"], "no fault classes were drilled"
+for cls, row in sorted(det["faults"].items()):
+    assert row["heal_p99_s"] <= row["slo_bound_s"], \
+        (f"{cls} healed in {row['heal_p99_s']}s, over its "
+         f"{row['slo_bound_s']}s bound")
+    print(f"  {cls:>15}: healed p99 {row['heal_p99_s']:6.2f}s "
+          f"(bound {row['slo_bound_s']}s) via {row['resolved_by']}")
+acted = {k: n for k, n in det["policy_actions_total"].items()
+         if k.endswith(":ok")}
+assert acted, \
+    f"no policy action executed: {det['policy_actions_total']}"
+assert rec["value"] is not None and rec["value"] >= 0, rec
+print(f"  policy actions {det['policy_actions_total']} "
+      f"(suppressed {det['policy_suppressed_total']}); "
+      f"{det['pushes']} pushes exactly-once; seed {det['chaos_seed']}")
+print("chaos autopilot smoke OK")
+EOF

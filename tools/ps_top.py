@@ -65,7 +65,7 @@ COLS = [
 COORD_COLS = [
     ("shard", 5), ("uri", 21), ("kind", 6), ("node", 4), ("hb", 6),
     ("age_ms", 6), ("keys", 5), ("mbytes", 8), ("push_qps", 8),
-    ("pull_qps", 8),
+    ("pull_qps", 8), ("repl", 9),
 ]
 
 
@@ -365,7 +365,23 @@ def render_coord_row(m: dict) -> dict:
                    if isinstance(nbytes, (int, float)) else "-"),
         "push_qps": _opt(report.get("push_qps")),
         "pull_qps": _opt(report.get("pull_qps")),
+        "repl": _repl_cell(report.get("repl")),
     }
+
+
+def _repl_cell(repl) -> str:
+    """Replica-pair health at a glance — the same states the autopilot's
+    re-seed rule keys on: PROMOTED (backup consumed, no downstream yet)
+    is the one that pages."""
+    if not isinstance(repl, dict):
+        return "-"
+    if repl.get("promoted") and not repl.get("attached"):
+        return "PROMOTED"
+    if repl.get("degraded"):
+        return "degraded"
+    if repl.get("attached"):
+        return "sync"
+    return "detached"
 
 
 def print_coord_view(view: dict, stream=sys.stdout) -> None:
@@ -379,6 +395,27 @@ def print_coord_view(view: dict, stream=sys.stdout) -> None:
                  f"{mig.get('moves', 0)} moves, "
                  f"{mig.get('keys', 0)} key(s) in motion")
     print(head, file=stream)
+    pol = view.get("policy")
+    if pol:
+        # the autopilot line: mode, storm-brake state, the last decision
+        cool = ",".join(f"{a}:{s}s" for a, s in
+                        sorted((pol.get("cooldown") or {}).items()))
+        acted = ",".join(f"{k}={n}" for k, n in
+                         sorted((pol.get("actions_total") or {}).items()))
+        last = pol.get("last_action") or {}
+        line = (f"AUTOPILOT mode={pol.get('mode')}  "
+                f"spares={len(view.get('spares') or [])}  "
+                f"inflight={pol.get('inflight') or '-'}  "
+                f"cooldown=[{cool or '-'}]  actions=[{acted or '-'}]")
+        if last:
+            line += (f"  last={last.get('rule')}/{last.get('action')}"
+                     f"->{last.get('outcome')}")
+        print(line, file=stream)
+        for e in (pol.get("actions") or [])[-3:]:
+            # the decision ring's tail: what fired (or was suppressed,
+            # and why) — the audit trail COORD_POLICY serves in full
+            print(f"  policy {e.get('rule')}/{e.get('action')} "
+                  f"-> {e.get('outcome')} {e.get('detail')}", file=stream)
     for h in view.get("hints") or []:
         # the byte-skew trigger and straggler suspects, side by side —
         # the two reasons an operator rebalances
@@ -393,12 +430,22 @@ def print_coord_view(view: dict, stream=sys.stdout) -> None:
 
 
 def poll_coord(addr: str) -> dict:
-    from ps_tpu.elastic.member import fetch_view
+    from ps_tpu.elastic.member import fetch_policy, fetch_view
 
     try:
-        return fetch_view(addr)
+        view = fetch_view(addr)
     except Exception as e:  # render, don't crash — same policy as STATS
         return {"error": str(e)}
+    if view.get("policy"):
+        # the autopilot is on: one extra round trip for the decision
+        # ring (COORD_POLICY carries the full audit; the table reply
+        # only summarizes)
+        try:
+            view["policy"]["actions"] = fetch_policy(addr, n=8).get(
+                "actions") or []
+        except Exception:
+            pass  # header still renders without the ring
+    return view
 
 
 def poll_fleet_via_coord(coord: str, fallback_servers=None) -> dict:
