@@ -67,6 +67,7 @@ from ps_tpu.backends.van_service import VanService
 from ps_tpu.compress import decode_tree
 from ps_tpu.control import tensor_van as tv
 from ps_tpu.kv import keys as keymod
+from ps_tpu.obs import freshness
 
 __all__ = ["AggregatorService", "serve_aggregator"]
 
@@ -322,6 +323,11 @@ class AggregatorService(VanService):
                 r["kv"] = {k: np.ascontiguousarray(np.asarray(v))
                            for k, v in kv.items()}
                 r["version"] = version
+                # freshness birth for the round snapshot: the merged
+                # apply JUST committed upstream and these bytes are its
+                # post-apply state, so the round is born here, now —
+                # stamped in THIS process, members age it monotonically
+                r["b"] = freshness.birth_record()
         except BaseException as e:  # surfaced at every parked member
             r["error"] = e
         if r["error"] is None:
@@ -334,7 +340,8 @@ class AggregatorService(VanService):
             with self._pcv:
                 self._pull_snap = {"round": self._rounds_done + 1,
                                    "kv": r["kv"],
-                                   "version": r["version"]}
+                                   "version": r["version"],
+                                   "b": r["b"]}
                 self._pcv.notify_all()
         with self._rcv:
             self._rounds_done += 1
@@ -438,8 +445,11 @@ class AggregatorService(VanService):
                 # self._client.version can run ahead of a bounded-stale
                 # replica read (or a flush decoding acks mid-read), and
                 # a snapshot stamped newer than its bytes would park
-                # stale rows in members' version-keyed caches
-                params, version = self._client.read_all_versioned()
+                # stale rows in members' version-keyed caches. The
+                # stamped read also brings the OLDEST constituent
+                # shard's birth, so the group's age chain never loses
+                # the upstream hop.
+                params, version, birth = self._client.read_all_stamped()
                 with self._pcv:
                     prev = self._pull_snap
                 if prev is not None \
@@ -447,15 +457,21 @@ class AggregatorService(VanService):
                     # upstream unchanged since the held snapshot (the
                     # client's conditional read proved it with a
                     # NOT_MODIFIED handshake): re-stamp the round and
-                    # keep the bytes — no re-flatten, no tree copy
+                    # keep the bytes — no re-flatten, no tree copy.
+                    # The birth DOES refresh (an NM revalidation proves
+                    # the held bytes are still the newest version — the
+                    # reply's stamp is that version's, so age keeps
+                    # flowing even while the upstream sits idle).
                     snap = {"round": rid, "kv": prev["kv"],
-                            "version": int(version)}
+                            "version": int(version),
+                            "b": birth if birth is not None
+                            else prev.get("b")}
                 else:
                     kv, _ = keymod.flatten_with_keys(params)
                     snap = {"round": rid,
                             "kv": {k: np.ascontiguousarray(np.asarray(v))
                                    for k, v in kv.items()},
-                            "version": version}
+                            "version": version, "b": birth}
             except BaseException:
                 with self._pcv:
                     self._pull_fetching = False
@@ -479,20 +495,25 @@ class AggregatorService(VanService):
         NOT_MODIFIED stamp instead of the tree."""
         gen = self._read_gen_snapshot()
         snap = self._coalesced_pull()
+        birth = snap.get("b")
+        bext = dict(birth) if birth is not None else {}
         cond = None
         if isinstance(extra, dict) and extra.get("cond") is not None:
             cond = int(extra["cond"])
         if cond is not None and int(snap["version"]) <= cond:
             reply = tv.encode(tv.NOT_MODIFIED, 0, None,
-                              extra={"version": int(snap["version"])})
+                              extra={"version": int(snap["version"]),
+                                     **bext})
             self._note_read_snapshot(gen, int(snap["version"]))
             self.transport.record_read_served()
             self.transport.record_read_not_modified()
+            self._note_serve_age(birth, tier="agg")
             return reply
         reply = tv.encode(tv.OK, 0, snap["kv"],
-                          extra={"version": snap["version"]})
+                          extra={"version": snap["version"], **bext})
         self._note_read_snapshot(gen, int(snap["version"]))
         self.transport.record_read_served()
+        self._note_serve_age(birth, tier="agg")
         return reply
 
     def _read_version(self):

@@ -38,6 +38,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ps_tpu import obs
+from ps_tpu.obs import freshness
 from ps_tpu.backends.common import (
     DEFAULT_BUCKET_BYTES,
     DRAIN_TO_TIMEOUT_S,
@@ -134,6 +135,17 @@ class AsyncPSService(VanService):
         self._store = store
         self._engine = engine
         self._key_order = list(store._key_order)
+        # freshness plane (README "Online serving & freshness"): the
+        # birth stamp of the currently servable version — re-stamped
+        # under the engine lock by every state change that makes new
+        # bytes servable (applies, replica-stream applies, migration
+        # cutovers). It rides every READ reply as committed STATE (never
+        # a serve-time clock, which would break the byte-deterministic
+        # reply contract the native cache needs), so each serving tier
+        # can report age = now - birth. Never-applied state has NO birth
+        # (None): its age is undefined, and two services constructed
+        # over the same state must encode byte-identical replies.
+        self._birth: Optional[dict] = None
         if num_shards is not None:
             misplaced = [k for k in self._key_order
                          if keymod.shard_for_key(k, num_shards) != shard]
@@ -325,11 +337,14 @@ class AsyncPSService(VanService):
         with self._engine._lock:
             kv = {k: self._engine._params[k] for k in self._key_order}
             version = self._engine.version
+            birth = dict(self._birth) if self._birth is not None else None
             gen = self._read_gen_snapshot()
         host = {k: np.asarray(v) for k, v in kv.items()}
-        reply = tv.encode(tv.OK, 0, host, extra={"version": version})
+        reply = tv.encode(tv.OK, 0, host, extra={"version": version,
+                                                 **(birth or {})})
         self._note_read_snapshot(gen, version)
         self.transport.record_read_served()
+        self._note_serve_age(birth)
         return reply
 
     def _read_cond_reply(self, extra) -> bytes:
@@ -349,13 +364,18 @@ class AsyncPSService(VanService):
         if cond is not None:
             with self._engine._lock:
                 version = self._engine.version
+                birth = dict(self._birth) if self._birth is not None else None
                 gen = self._read_gen_snapshot()
             if version <= cond:
+                # the NOT_MODIFIED stamp carries the birth too: a hot
+                # cached row must report TRUE freshness on every
+                # revalidation, not the age it had when first fetched
                 reply = tv.encode(tv.NOT_MODIFIED, 0, None,
-                                  extra={"version": version})
+                                  extra={"version": version, **(birth or {})})
                 self._note_read_snapshot(gen, version)
                 self.transport.record_read_served()
                 self.transport.record_read_not_modified()
+                self._note_serve_age(birth)
                 return reply
         return self._read_payload()
 
@@ -458,6 +478,7 @@ class AsyncPSService(VanService):
             # replies now describe a superseded version — drop them and
             # refuse any in-flight publish of the pre-apply snapshot
             self._invalidate_reads()
+            self._birth = freshness.birth_record()
             apply_s = time.perf_counter() - t_apply
             self._applied[worker] = self._applied.get(worker, 0) + 1
             if pseq is not None:
@@ -501,9 +522,14 @@ class AsyncPSService(VanService):
                 "push" if len(fresh) == len(self._key_order)
                 else "push_sub",
                 worker, fresh, {"pseq": pseq, "pnonce": pnonce,
-                                "members": extra.get("members")})
+                                "members": extra.get("members"),
+                                "birth": self._birth["birth"]})
         if apply_s is not None:
             self.transport.record_apply(apply_s)
+            # push->first-servable on the primary: the lock is released
+            # and the invalidation floor raised — a READ serves the new
+            # version from here on (ps_freshness_lag_seconds)
+            self.transport.record_fresh_lag(time.perf_counter() - t_apply)
         return rseq, False
 
     @staticmethod
@@ -1084,6 +1110,8 @@ class AsyncPSService(VanService):
                 engine.evict_keys(keys)
                 self._invalidate_reads()  # the moved range left this shard:
                 # a cached whole-subtree reply would still include it
+                self._birth = freshness.birth_record()  # servable bytes
+                # changed shape: the stamp must not predate the cutover
                 # only NOW does this shard refuse the moved range
                 # retryably: an aborted move must leave a static
                 # deployment's hard key-mismatch diagnosis untouched
@@ -1240,6 +1268,7 @@ class AsyncPSService(VanService):
                 self._applied[w] = max(self._applied.get(w, 0), int(n))
             self.table_epoch = max(self.table_epoch, new_epoch)
             self._invalidate_reads()  # the served subtree just grew
+            self._birth = freshness.birth_record()
             # serving adopted keys means refusing their OLD routing
             # retryably from now on (and remembering the commit so a
             # re-asked MIGRATE_COMMIT acks instead of "aborting" it)
@@ -1415,6 +1444,7 @@ class AsyncPSService(VanService):
                 int(w): {k: (tk[0], int(tk[1])) for k, tk in toks.items()}
                 for w, toks in (extra.get("tokens") or {}).items()}
             self._invalidate_reads()
+            self._birth = freshness.birth_record()
             self._admit_sync(locked=True)
         obs.record_event("replica_seeded", keys=len(keys),
                          version=self._engine.version)
@@ -1487,6 +1517,13 @@ class AsyncPSService(VanService):
         # a backup serves replica READs: its cached replies go stale on
         # every replicated apply exactly like a primary's on a commit
         self._invalidate_reads()
+        # install the PRIMARY's birth from the stream meta (foreign: the
+        # wall stamp crosses processes, the monotonic clock does not) so
+        # replica-served reads report the true push->now age, not the
+        # replication hop's arrival time
+        b = extra.get("birth")
+        self._birth = (freshness.foreign_record(float(b)) if b is not None
+                       else freshness.birth_record())
         self._applied[worker] = self._applied.get(worker, 0) + 1
         if extra.get("pseq") is not None:
             toks = self._applied_pseq.setdefault(worker, {})
@@ -2343,9 +2380,18 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         version bumps, and coalescing of concurrent same-shard reads
         into ONE wire fetch (the aggregator's ``_coalesced_pull``
         discipline, generalized to every worker)."""
-        from ps_tpu.config import env_flag, env_int
+        from ps_tpu.config import env_flag, env_float, env_int
 
         self._close_read_path()  # reconnect() re-runs _init_multi
+        # freshness plane (README "Online serving & freshness"): the
+        # staleness bound in SECONDS served ages are judged against
+        # (the within-bound share is ps_top's age% column), and one
+        # ClockSync per shard toward its PRIMARY — births are stamped
+        # there, so its clock is the one cross-process ages resolve
+        # against. Fed for free by the version watcher's REPLICA_STATE
+        # round trips (the reply already carries the server's "now").
+        self.freshness_slo = env_float("PS_FRESHNESS_SLO", 0.5, lo=1e-3)
+        self._read_clock: Dict[int, Any] = {}
         # bounded-staleness contract, measured in VERSIONS: a replica
         # whose reply trails the worker's last-known primary version by
         # more than this many versions is refused and the read falls
@@ -2436,11 +2482,22 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         actually are — a re-publisher (the aggregator's coalesced
         snapshot) must stamp the served version, never the known one,
         or downstream caches park stale bytes under a fresh stamp."""
+        tree, version, _ = self.read_all_stamped()
+        return tree, version
+
+    def read_all_stamped(self) -> Tuple[Any, int, Optional[dict]]:
+        """:meth:`read_all_versioned` plus the OLDEST birth record among
+        the served shard snapshots (None when no shard carried a stamp).
+        The oldest wins for the same reason the served version does: a
+        re-publisher (the aggregator's coalesced snapshot) must stamp
+        the age of its WORST constituent, or downstream readers
+        under-report the staleness of merged bytes."""
         import jax.numpy as jnp
 
         with self._op("read"):
             kv: Dict[str, Any] = {}
             version = 0
+            births: List[dict] = []
             if len(self._active) > 1:
                 # fan the per-shard reads out concurrently, like
                 # pull_all's _fanout — a serving read must not pay K
@@ -2453,22 +2510,23 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                 futs = {i: pool.submit(self._read_shard, i)
                         for i in self._active}
                 concurrent.futures.wait(futs.values())
-                for i, f in futs.items():
-                    snap = f.result()
-                    kv.update(snap["kv"])
-                    version += int(snap["version"])
+                snaps = [(i, f.result()) for i, f in futs.items()]
             else:
-                for i in self._active:
-                    snap = self._read_shard(i)
-                    kv.update(snap["kv"])
-                    version += int(snap["version"])
+                snaps = [(i, self._read_shard(i)) for i in self._active]
+            for _, snap in snaps:
+                kv.update(snap["kv"])
+                version += int(snap["version"])
+                if snap.get("b") is not None:
+                    births.append(snap["b"])
             missing = [k for k in self._key_order if k not in kv]
             if missing:
                 raise self._incomplete_pull(missing)
             tree = keymod.unflatten(
                 self._treedef, {k: jnp.asarray(v) for k, v in kv.items()},
                 self._key_order)
-            return tree, version
+            birth = (min(births, key=lambda b: b["birth"])
+                     if births else None)
+            return tree, version, birth
 
     def _read_executor(self):
         if self._read_pool is None:
@@ -2481,6 +2539,22 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
 
     def _read_fresh_enough(self, version: int, i: int) -> bool:
         return self.versions[i] - int(version) <= self.read_staleness
+
+    def _note_read_age(self, i: int, snap: dict, tier: str) -> None:
+        """One serve's data age into ``ps_read_staleness_seconds``:
+        ``now - birth`` resolved against shard ``i``'s ClockSync offset
+        when the birth crossed a process boundary (same-process births
+        use the monotonic clock; no offset falls back to wall — the
+        source rides the sample either way, and negative ages clamp)."""
+        b = snap.get("b")
+        if b is None:
+            return  # pre-freshness peer: no stamp, no sample
+        cs = self._read_clock.get(i)
+        off = cs.offset_us if cs is not None else None
+        age, src, clamped = freshness.age_of(b, off)
+        self.transport.record_read_age(age, src=src, tier=tier,
+                                       bound=self.freshness_slo,
+                                       clamped=clamped)
 
     def _read_shard(self, i: int) -> dict:
         """One shard's read snapshot: local cache when its version is
@@ -2497,6 +2571,7 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                 if (snap is not None and self.pull_cache
                         and self._read_fresh_enough(snap["version"], i)):
                     self.transport.record_read_cache(True)
+                    self._note_read_age(i, snap, "cache")
                     return snap
                 rec = self._read_fetching.get(i)
                 if rec is not None:
@@ -2507,6 +2582,7 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                         # coalesced: share the fetch this caller waited
                         # out instead of issuing another
                         self.transport.record_read_coalesced()
+                        self._note_read_age(i, got, "cache")
                         return got
                     continue
                 rec = {"done": False, "snap": None}
@@ -2518,6 +2594,7 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                 rec["snap"] = snap
                 if self.pull_cache:
                     self._read_snaps[i] = snap
+            self._note_read_age(i, snap, snap.get("tier") or "wire")
             return snap
         finally:
             with self._read_cv:
@@ -2577,8 +2654,11 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                 if addr != primary \
                         and not self._read_fresh_enough(version, i):
                     # a lagging replica's NOT_MODIFIED is refused exactly
-                    # like a lagging full reply would be
+                    # like a lagging full reply would be — and the GAP is
+                    # recorded, not just the fact (the bound's margin)
                     self.transport.record_read_fallback()
+                    self.transport.record_read_gap(
+                        self.versions[i] - version)
                     last = RuntimeError(
                         f"replica {addr} NOT_MODIFIED at version "
                         f"{version} exceeds the staleness bound "
@@ -2588,7 +2668,13 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                 if version > self.versions[i]:
                     self.versions[i] = version
                 self.transport.record_read_route(replica=addr != primary)
-                return {"version": version, "kv": snap0["kv"]}
+                # an NM revalidation must REFRESH the age: the stamp's
+                # birth describes the version we already hold — falling
+                # back to the snapshot's older birth would over-report
+                # the age of perfectly current bytes
+                birth = freshness.from_extra(extra) or snap0.get("b")
+                return {"version": version, "kv": snap0["kv"],
+                        "b": birth, "tier": "nm"}
             if kind != tv.OK:
                 last = RuntimeError(str(extra.get("error")))
                 continue
@@ -2597,6 +2683,7 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                 # replica too far behind the bound: fall back toward the
                 # primary (it is later in — or next around — the rotation)
                 self.transport.record_read_fallback()
+                self.transport.record_read_gap(self.versions[i] - version)
                 last = RuntimeError(
                     f"replica {addr} at version {version} exceeds the "
                     f"staleness bound ({self.versions[i]} known, "
@@ -2607,7 +2694,9 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
             if version > self.versions[i]:
                 self.versions[i] = version
             self.transport.record_read_route(replica=addr != primary)
-            return {"version": version, "kv": kv}
+            return {"version": version, "kv": kv,
+                    "b": freshness.from_extra(extra),
+                    "tier": "replica" if addr != primary else "wire"}
         raise ServerFailureError(
             f"read failed at every member of {self._failure_noun} {i}'s "
             f"replica set {members}: {last}", server=i)
@@ -2673,11 +2762,25 @@ class RemoteAsyncWorker(BucketedTransportMixin, CheckpointRoundsMixin):
                                                 timeout_ms=2000, retries=1,
                                                 max_wait_s=0.2)
                         chs[i] = ch
-                    kind, _, _, extra = tv.decode(ch.request(payload))
+                    t0 = time.time()
+                    reply = ch.request(payload)
+                    t1 = time.time()
+                    kind, _, _, extra = tv.decode(reply)
                     v = extra.get("version")
                     if kind == tv.OK and v is not None \
                             and int(v) > self.versions[i]:
                         self.versions[i] = int(v)
+                    if kind == tv.OK and extra.get("now") is not None:
+                        # clock discipline for cross-process ages: every
+                        # watch tick doubles as an NTP-style piggyback
+                        # probe toward the shard's primary (zero added
+                        # round trips — the reply carries "now" already)
+                        cs = self._read_clock.get(i)
+                        if cs is None:
+                            from ps_tpu.obs.clock import ClockSync
+
+                            cs = self._read_clock[i] = ClockSync()
+                        cs.observe(t0, t1, float(extra["now"]))
                     bad.pop(i, None)
                 except (tv.VanError, OSError, IndexError):
                     if ch is not None:

@@ -39,6 +39,7 @@ from typing import Any, Dict, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ps_tpu import obs
+from ps_tpu.obs import freshness
 from ps_tpu.backends.common import (
     DRAIN_TO_TIMEOUT_S,
     BucketedTransportMixin,
@@ -203,6 +204,15 @@ class SparsePSService(VanService):
         self.rows_applied: Dict[str, int] = {
             n: int(emb.rows_pushed) for n, emb in self._tables.items()
         }
+        # freshness plane (README "Online serving & freshness"): one
+        # birth stamp per table — the wall/monotonic moment its current
+        # version committed (per-table because the staleness a reader of
+        # table A feels is A's, not the shard's hottest table's). Rides
+        # READ replies as committed state, exactly like the dense
+        # service's per-shard stamp. A never-applied table has NO birth:
+        # its age is undefined, and two services constructed over the
+        # same state must encode byte-identical replies.
+        self._births: Dict[str, dict] = {}
         # sparse fused apply (README "Sparse apply"): which tier each
         # table's scatter-apply runs (resolved at SparseEmbedding
         # construction from PS_FUSED_APPLY / the backend), plus the
@@ -434,6 +444,11 @@ class SparsePSService(VanService):
                 tags=self._move_tags(
                     self._tags_for(per_table, APPLY_TAG_CAP),
                     tier_moves))
+            # one birth for every table this push touched (they
+            # committed atomically under this lock)
+            stamp = freshness.birth_record()
+            for name, _ids, _g in todo:
+                self._births[name] = stamp
             apply_s = _ptime.perf_counter() - t_apply
             if pseq is not None:
                 self._applied_pseq[worker] = (pnonce, int(pseq),
@@ -448,9 +463,14 @@ class SparsePSService(VanService):
             rseq = self._replicate("push", worker, wire, {  # pslint: disable=PSL101 -- deliberate backpressure: a full ack window MUST stall commits under the apply lock (that IS the bounded-lag contract), and stall_timeout degrades a corpse instead of wedging
                 "pseq": pseq, "pnonce": pnonce, "pfan": pfan,
                 "tier_moves": tier_moves or None,
+                "birth": stamp["birth"],
             })
         if apply_s is not None:
             self.transport.record_apply(apply_s)
+            # push->first-servable on the primary (the lock is released,
+            # the invalidation floor raised): ps_freshness_lag_seconds
+            self.transport.record_fresh_lag(
+                _ptime.perf_counter() - t_apply)
         return rseq, False
 
     def _admit_while_paused(self, worker: int) -> bool:
@@ -529,6 +549,15 @@ class SparsePSService(VanService):
         with self._lock:
             versions = dict(self.versions)
             gen = self._read_gen_snapshot()
+            # per-table birth stamps for every REQUESTED table, captured
+            # atomically with the rows (committed state — deterministic
+            # for byte-identical requests, so native-cache servable):
+            # [wall, monotonic, stamper token] triples, json-able
+            births = {}
+            for name in per_table:
+                b = self._births.get(name)
+                if b is not None:
+                    births[name] = [b["birth"], b["bmono"], b["bpid"]]
             for name, t in per_table.items():
                 v = conds.get(name) if conds is not None else None
                 if v is None:
@@ -553,37 +582,48 @@ class SparsePSService(VanService):
                 out[f"{name}/drows"] = np.asarray(emb.pull(lids))
                 delta_rows += int(uids.size)
         vsum = self._vsum(versions)
+        # the serve-side age sample judges the OLDEST requested table —
+        # the staleness a reader of merged bytes actually feels
+        oldest = (min((freshness.from_extra({"births": births}, table=n)
+                       for n in births),
+                      key=lambda b: b["birth"]) if births else None)
         if conds is not None and not out:
             # every requested table unchanged for this caller: a tiny
             # version-stamp frame — the steady-state revalidation reply
+            # (births included: an NM must still REFRESH the age)
             reply = tv.encode(tv.NOT_MODIFIED, 0, None,
                               extra={"versions": versions,
-                                     "version": vsum})
+                                     "version": vsum, "births": births})
             self._note_read_snapshot(gen, vsum,
                                      tags=self._tags_for(per_table,
                                                          READ_TAG_CAP))
             self.transport.record_read_served()
             self.transport.record_read_not_modified()
+            self._note_serve_age(oldest)
             return reply
         if conds is not None:
             reply = tv.encode(tv.OK, 0, out,
                               extra={"versions": versions,
-                                     "version": vsum, "delta": 1})
+                                     "version": vsum, "delta": 1,
+                                     "births": births})
             self._note_read_snapshot(gen, vsum,
                                      tags=self._tags_for(per_table,
                                                          READ_TAG_CAP))
             self.transport.record_read_served()
             if delta_rows:
                 self.transport.record_read_delta_rows(delta_rows)
+            self._note_serve_age(oldest)
             return reply
         reply = tv.encode(tv.OK, 0, out, extra={"versions": versions,
-                                                "version": vsum})
+                                                "version": vsum,
+                                                "births": births})
         # tag the publish with the id-set it covers, so a disjoint row
         # apply leaves the cached entry serving (per-key invalidation)
         self._note_read_snapshot(gen, vsum,
                                  tags=self._tags_for(per_table,
                                                      READ_TAG_CAP))
         self.transport.record_read_served()
+        self._note_serve_age(oldest)
         return reply
 
     def _tbl_hash(self, name: str) -> int:
@@ -956,6 +996,14 @@ class SparsePSService(VanService):
         # with the replayed tier moves' rows joining the tag set
         self._invalidate_reads(tags=self._move_tags(
             self._tags_for(split, APPLY_TAG_CAP), moves))
+        # install the PRIMARY's birth for the touched tables (foreign:
+        # wall stamp only — a replica's monotonic clock is not the
+        # stamper's), so replica-served reads report the push->now age
+        b = extra.get("birth")
+        stamp = (freshness.foreign_record(float(b)) if b is not None
+                 else freshness.birth_record())
+        for name in split:
+            self._births[name] = stamp
         if extra.get("pseq") is not None:
             self._applied_pseq[worker] = (extra.get("pnonce"),
                                           int(extra["pseq"]),
@@ -1181,10 +1229,13 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         # path"): a repeat read_rows over the same id-set sends the
         # versions it already holds and merges the server's row DELTA
         # in place of a full refetch (NOT_MODIFIED = reuse as-is)
-        from ps_tpu.config import env_flag
+        from ps_tpu.config import env_flag, env_float
         self.read_conditional = env_flag("PS_READ_CONDITIONAL", True)
         self._read_snaps: Dict[int, dict] = {}
         self._read_lock = threading.Lock()
+        # freshness plane (README "Online serving & freshness"): the
+        # staleness bound served row ages are judged against (age%)
+        self.freshness_slo = env_float("PS_FRESHNESS_SLO", 0.5, lo=1e-3)
         spec = resolve_spec(compress)
         if spec is not None and spec.get("codec") == "topk":
             raise ValueError(
@@ -1427,6 +1478,21 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
 
             return self._with_failover(once)
 
+    def _note_rows_age(self, extra: dict, req, tier: str) -> None:
+        """One age sample per table this reply served (``now - birth``
+        from the reply's per-table stamps): the data age a serving
+        caller of :meth:`read_rows` actually feels. No ClockSync rides
+        the sparse worker (no version watcher), so cross-process ages
+        fall to the wall clock — tagged, and clamped when negative."""
+        for key in req:
+            b = freshness.from_extra(extra, table=key[: -len("/ids")])
+            if b is None:
+                continue  # pre-freshness peer (or unknown table)
+            age, src, clamped = freshness.age_of(b)
+            self.transport.record_read_age(age, src=src, tier=tier,
+                                           bound=self.freshness_slo,
+                                           clamped=clamped)
+
     @staticmethod
     def _read_sig(req: Dict[str, np.ndarray]) -> tuple:
         """Hashable identity of one server's id-set: a snapshot only
@@ -1444,12 +1510,16 @@ class RemoteSparseWorker(BucketedTransportMixin, CheckpointRoundsMixin):
         if kind == tv.NOT_MODIFIED and snap is not None:
             for name, v in (extra.get("versions") or {}).items():
                 self._versions[name][i] = int(v)
+            # the stamp's births describe the rows we already hold: an
+            # NM revalidation REFRESHES the age of a hot cached id-set
+            self._note_rows_age(extra, req, "nm")
             return snap["tensors"]
         if kind != tv.OK:
             raise self._reply_error(i, extra)
         versions = extra.get("versions") or {}
         for name, v in versions.items():
             self._versions[name][i] = int(v)
+        self._note_rows_age(extra, req, "wire")
         out: Dict[str, np.ndarray] = {}
         if extra.get("delta") and snap is not None:
             for key in req:

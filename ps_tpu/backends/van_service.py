@@ -259,6 +259,12 @@ class VanService:
         # compressed pushes/pulls, and the zero-copy lane counters (shm
         # frames, spill, vectored-reply bytes, recv-pool hit rate)
         self.transport = TransportStats()
+        # freshness plane (README "Online serving & freshness"): the
+        # staleness bound served ages are judged against — the
+        # within-bound share is ps_top's age% column
+        from ps_tpu.config import env_float
+
+        self._fresh_slo = env_float("PS_FRESHNESS_SLO", 0.5, lo=1e-3)
         # reusable receive buffers for the serve loop: a request frame is
         # provably dead once its reply is sent, so the loop borrows and
         # returns per request instead of allocating per frame
@@ -691,6 +697,24 @@ class VanService:
             if self._native_admit:
                 nloop.admit_invalidate(gen)
 
+    def _note_serve_age(self, birth: Optional[dict],
+                        tier: Optional[str] = None) -> None:
+        """Record one serve's data age (``now - version birth``) into
+        ``ps_read_staleness_seconds``. READ handlers call this with the
+        birth record they just encoded into the reply; the tier defaults
+        to this endpoint's serving role — ``pump`` on a primary (the
+        Python serve path; zero-upcall native hits re-serve the same
+        stamped bytes), ``replica`` on a backup."""
+        if birth is None:
+            return
+        from ps_tpu.obs import freshness
+
+        age, src, clamped = freshness.age_of(birth)
+        self.transport.record_read_age(
+            age, src=src,
+            tier=tier or ("pump" if self.role == "primary" else "replica"),
+            bound=self._fresh_slo, clamped=clamped)
+
     def _note_read_snapshot(self, gen: int, version: int,
                             tags=None) -> None:
         """READ handlers record the (generation, version) their reply
@@ -1016,6 +1040,12 @@ class VanService:
                 "delta_rows": self.transport.read_delta_rows,
                 "native_cond_hits": self.transport.read_native_cond_hits,
             }
+        f = self.transport.fresh_snapshot()
+        if f is not None:
+            # freshness plane (README "Online serving & freshness"):
+            # ps_top's fresh/age% columns and ps_doctor's stalest-tier
+            # section render this dict straight off the STATS frame
+            out["fresh"] = f
         if self._nloop is not None:
             # native event-loop serve path: live connections + frames
             # read — the cell ps_top renders per shard (iterations and

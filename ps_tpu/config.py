@@ -151,6 +151,11 @@ Environment variables honored by :meth:`Config.from_env`:
   evaluates over fleet telemetry, e.g. ``push p99 < 10ms over 30s``
   (unset = no rules; breaches fire ``slo_breach`` flight events and the
   ``ps_slo_breach_total`` counter)
+- ``PS_FRESHNESS_SLO``       — the serving-freshness bound in SECONDS
+  (default 0.5): every served read records its age (now − the version's
+  birth at the primary's apply) into ``ps_read_staleness_seconds``, and
+  the share of reads at or under this bound is the ``age%`` column in
+  ps_top / the ``fresh_share`` STATS field
 - ``PS_POLICY``              — the coordinator's autopilot policy engine
   (README "Autopilot & chaos"): ``off`` (default — today's behavior,
   byte-identical), ``dry`` (evaluate rules and record decisions without
@@ -477,8 +482,14 @@ class Config:
         ``straggler_suspect`` (and a rebalance hint is published).
       slo_rules: ``;``-separated declarative SLO rules evaluated in the
         coordinator loop — ``"<metric> p99 < 10ms over 30s"`` with
-        metric one of push/pull/push_pull/cycle/bucket/apply/ack/flush
-        or a full ``ps_*_seconds`` histogram name. None = no rules.
+        metric one of push/pull/push_pull/cycle/bucket/apply/ack/flush/
+        read/freshness/staleness or a full ``ps_*`` histogram name.
+        None = no rules.
+      freshness_slo: the serving-freshness bound in seconds (README
+        "Online serving & freshness", default 0.5) — every served read
+        records ``now − birth`` into ``ps_read_staleness_seconds`` and
+        counts against this bound; the in-bound share is ps_top's
+        ``age%`` column.
       policy: the coordinator's autopilot policy engine (README
         "Autopilot & chaos") — ``off`` (default: no engine at all,
         today's behavior byte-identical), ``dry`` (rules evaluate and
@@ -654,6 +665,9 @@ class Config:
     telemetry_ring: int = 256
     telemetry_straggler_z: float = 3.0
     slo_rules: Optional[str] = None
+    # freshness plane (ps_tpu/obs/freshness.py, README "Online serving
+    # & freshness"): the age bound a served read is judged against
+    freshness_slo: float = 0.5
     # autopilot (ps_tpu/elastic/policy.py, README "Autopilot & chaos"):
     # the coordinator-side rule engine closing the telemetry→elastic
     # loop, its storm brakes, and the chaos injector's schedule seed
@@ -833,6 +847,9 @@ class Config:
 
             parse_rules(self.slo_rules)  # a bad rule fails at config
             # time, loudly — not silently at the coordinator mid-run
+        if self.freshness_slo <= 0:
+            raise ValueError("freshness_slo must be > 0 (seconds — the "
+                             "age bound a served read is judged against)")
         if self.policy not in ("off", "dry", "on"):
             raise ValueError(
                 f"policy {self.policy!r} is not one of off/dry/on")
@@ -1028,6 +1045,10 @@ class Config:
         if "PS_SLO_RULES" in env:
             # "" explicitly selects no rules
             kwargs["slo_rules"] = env["PS_SLO_RULES"] or None
+        if "PS_FRESHNESS_SLO" in env:
+            # float seconds, matching the service-level env_float reads
+            kwargs["freshness_slo"] = env_float(
+                "PS_FRESHNESS_SLO", 0.5, lo=1e-3)
         if "PS_POLICY" in env:
             # "" explicitly selects off; the mode set is validated in
             # __post_init__ (a typo'd mode fails loudly at config time)

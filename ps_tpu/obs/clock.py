@@ -63,6 +63,11 @@ class ClockSync:
         self.reprobes = 0                       # TTL-triggered re-probes
         self.probed_at: Optional[float] = None  # monotonic stamp
         self._samples: List[Tuple[float, float]] = []  # (rtt_us, offset_us)
+        #: sample-set cap for long-lived piggyback feeds (a version
+        #: watcher observing every heartbeat tick): keeping only the
+        #: newest window bounds memory AND lets the estimate track
+        #: drift — an hour-old min-RTT sample must eventually age out
+        self.max_samples = 256
 
     def observe(self, t_send: float, t_recv: float,
                 t_server: float) -> None:
@@ -73,6 +78,8 @@ class ClockSync:
         off = (t_server - (t_send + t_recv) / 2.0) * 1e6
         self.probes += 1
         self._samples.append((rtt, off))
+        if len(self._samples) > self.max_samples:
+            del self._samples[:-self.max_samples]
         self._refresh()
 
     def _refresh(self) -> None:

@@ -55,6 +55,10 @@ _STATS_COUNTERS = (
     # the slow-frame watchdog captured — a fleet-wide rash of these is
     # the page-worthy signal the per-frame ring exists for
     ("nl_slow_frames", "ps_nl_slow_frames_total"),
+    # freshness plane (README "Online serving & freshness"): negative
+    # cross-process ages clamped to zero — a fleet-wide rise means some
+    # member's clock skew is eating the staleness signal
+    ("fresh_clock_clamped", "ps_freshness_clock_clamped_total"),
 )
 
 #: TransportStats gauges (absolute, not cumulative) shipped fleet-wide

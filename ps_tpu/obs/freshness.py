@@ -1,0 +1,115 @@
+"""Freshness plane: version birth stamps and cross-process age.
+
+Every serving tier built since the read-path PR can hand a reader bytes
+that were committed somewhere else, some time ago — a replica's stream
+entry, the native read cache, a worker's pull-cache snapshot, an
+aggregator's coalesced snapshot, a NOT_MODIFIED revalidation. The one
+question a serving operator asks first — "how stale is what I am
+serving, right now?" — needs a *birth time* stamped once, at the
+primary's apply, and carried with the bytes through every one of those
+tiers so `age = now - birth` can be recorded at each serve.
+
+A birth record is a plain json-able dict (it rides the ``tensor_van``
+READ/NOT_MODIFIED reply extras and the replication stream meta)::
+
+    {"birth": <wall seconds>, "bmono": <monotonic seconds>, "bpid": token}
+
+Two clocks on purpose: the wall stamp crosses processes, the monotonic
+stamp is exact but only meaningful inside the stamping process. ``bpid``
+is a per-process random token (NOT a bare pid — pids recycle) that lets
+a consumer tell which case it is in. :func:`age_of` resolves the age in
+strict preference order and tags the sample's source:
+
+- ``mono`` — same process as the stamper: monotonic difference, exact.
+- ``sync`` — cross-process with a ClockSync offset in hand
+  (``ps_tpu/obs/clock.py``): the local wall clock is projected into the
+  stamper's clock before differencing, so member skew never reaches the
+  fleet windows (the fleet-telemetry PR's rule).
+- ``wall`` — cross-process, no offset: plain wall difference,
+  skew-bounded.
+
+A skewed member must never report a *negative* staleness (it would drag
+fleet quantiles below zero and hide real lag): negative ages clamp to
+zero and count ``ps_freshness_clock_clamped_total``.
+
+READ replies stay byte-deterministic (the zero-upcall native cache
+serves cached reply bytes verbatim), which is exactly why the stamp
+works: birth is committed STATE, stamped at apply time — never a
+``time.time()`` taken at serve time.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional, Tuple
+
+__all__ = ["PROC_TOKEN", "birth_record", "foreign_record", "from_extra",
+           "age_of"]
+
+#: this process's stamp identity — random so a recycled pid (or a
+#: fork twin) can never claim another process's monotonic clock
+PROC_TOKEN = f"{os.getpid():x}.{os.urandom(4).hex()}"
+
+
+def birth_record(wall: Optional[float] = None,
+                 mono: Optional[float] = None) -> dict:
+    """Stamp a version born HERE, NOW (call at the primary's apply,
+    under the engine lock, right where the version increments)."""
+    return {
+        "birth": time.time() if wall is None else float(wall),
+        "bmono": time.monotonic() if mono is None else float(mono),
+        "bpid": PROC_TOKEN,
+    }
+
+
+def foreign_record(wall: float) -> dict:
+    """A birth learned from ANOTHER process (a replica installing the
+    primary's stamp from the stream meta): wall clock only — an empty
+    token never matches :data:`PROC_TOKEN`, so readers fall to the
+    sync/wall paths instead of trusting a monotonic clock that is not
+    theirs."""
+    return {"birth": float(wall), "bmono": None, "bpid": ""}
+
+
+def from_extra(extra: dict, table: Optional[str] = None) -> Optional[dict]:
+    """The birth record carried by a reply ``extra``, or None when the
+    peer predates the freshness plane. Dense replies carry flat
+    ``birth``/``bmono``/``bpid`` keys; sparse replies carry a per-table
+    ``births`` map of ``[wall, mono, bpid]`` triples (mono/bpid absent
+    on foreign stamps) — pass ``table`` to resolve those."""
+    if table is not None:
+        b = (extra.get("births") or {}).get(table)
+        if b is None:
+            return None
+        bm = b[1] if len(b) > 1 else None
+        return {"birth": float(b[0]),
+                "bmono": None if bm is None else float(bm),
+                "bpid": (b[2] if len(b) > 2 else "") or ""}
+    if extra.get("birth") is None:
+        return None
+    bm = extra.get("bmono")
+    return {"birth": float(extra["birth"]),
+            "bmono": None if bm is None else float(bm),
+            "bpid": extra.get("bpid") or ""}
+
+
+def age_of(rec: dict, offset_us: Optional[float] = None
+           ) -> Tuple[float, str, bool]:
+    """``(age_seconds, source, clamped)`` for a birth record, resolved
+    in the preference order the module docstring fixes. ``offset_us``
+    is a ClockSync offset toward the STAMPING process (add to local
+    wall → stamper wall)."""
+    bmono = rec.get("bmono")
+    if rec.get("bpid") == PROC_TOKEN and bmono is not None:
+        age = time.monotonic() - float(bmono)
+        src = "mono"
+    elif offset_us is not None:
+        age = (time.time() + float(offset_us) / 1e6) - float(rec["birth"])
+        src = "sync"
+    else:
+        age = time.time() - float(rec["birth"])
+        src = "wall"
+    if age < 0.0:
+        return 0.0, src, True
+    return age, src, False

@@ -15,7 +15,8 @@ Rule syntax (``Config.slo_rules`` / PS_SLO_RULES, ``;``-separated)::
     push p99 < 10ms over 30s; apply p999 < 50ms over 60s
 
 ``metric`` is a short alias (push, pull, push_pull, cycle, bucket,
-apply, ack, flush) or a full histogram name (``ps_push_seconds``);
+apply, ack, flush, read, freshness, staleness) or a full histogram name
+(``ps_push_seconds``);
 ``quantile`` is p50/p90/p99/p999 (any ``pNN...``); thresholds take
 us/ms/s. On a transition into breach the evaluator records a typed
 ``slo_breach`` flight event (and ``slo_recover`` on the way back); every
@@ -40,6 +41,13 @@ METRIC_ALIASES: Dict[str, str] = {
     "apply": "ps_server_apply_seconds",
     "ack": "ps_replica_ack_wait_seconds",
     "flush": "ps_blocked_seconds",
+    # freshness plane (README "Online serving & freshness"): the serving
+    # latency a reader feels, the push->servable lag on the primary, and
+    # the data age at serve time — "freshness p99 < 500ms over 30s" is
+    # the canonical online-serving objective
+    "read": "ps_read_seconds",
+    "freshness": "ps_freshness_lag_seconds",
+    "staleness": "ps_read_staleness_seconds",
 }
 
 _UNITS = {"us": 1e-6, "ms": 1e-3, "s": 1.0}

@@ -133,6 +133,23 @@ class TransportStats:
         # device applies, eating the budget.
         ("cold_gather_s", "ps_embed_cold_gather_seconds",
          "tiered embedding cold-tier gather->apply->scatter, per push"),
+        # freshness plane (README "Online serving & freshness"): the age
+        # of the data a reader actually got (now - version birth,
+        # recorded at EVERY serving tier — worker cache, wire, replica,
+        # NOT_MODIFIED revalidation, aggregator snapshot) and the
+        # push->first-servable lag on the primary. Both ride the
+        # delta-encoded telemetry like every histogram here, so fleet
+        # freshness quantiles come from merged raw buckets — never
+        # averaged percentiles.
+        ("read_age_s", "ps_read_staleness_seconds",
+         "data age at serve time (now - version birth), any tier"),
+        ("fresh_lag_s", "ps_freshness_lag_seconds",
+         "push -> first-servable lag at the primary's apply"),
+        # staleness-bound refusals always counted read_fallbacks but
+        # never HOW stale the refused reply was; the gap distribution is
+        # what shows the bound's margin (in versions, not seconds)
+        ("read_gap_v", "ps_read_refused_version_gap",
+         "version gap of replica reads refused by the staleness bound"),
     )
 
     def __init__(self, window: int = 256):
@@ -256,6 +273,22 @@ class TransportStats:
         # revalidation share directly.
         self.read_not_modified = 0
         self.read_delta_rows = 0
+        # freshness plane (README "Online serving & freshness"): serves
+        # that recorded an age sample, the subset within the staleness
+        # SLO bound (their ratio is ps_top's age%), negative-age clamps
+        # (clock skew made an age negative — clamped to 0 so a skewed
+        # member can't drag fleet staleness below zero), the sample-
+        # source mix (mono/sync/wall — how trustworthy the ages are),
+        # and a per-tier {count, max age} map (ps_doctor names the
+        # stalest tier per shard from it)
+        self.reads_aged = 0
+        self.reads_fresh = 0
+        self.fresh_clock_clamped = 0
+        self.fresh_src: Dict[str, int] = {"mono": 0, "sync": 0, "wall": 0}
+        self.fresh_tiers: Dict[str, list] = {}  # tier -> [count, max_s]
+        self._c_fresh_clamped = reg.counter(
+            "ps_freshness_clock_clamped_total",
+            "negative cross-process data ages clamped to zero (skew)")
         self._c_read_nm = reg.counter(
             "ps_read_not_modified_total",
             "conditional READs answered NOT_MODIFIED (stamp only)")
@@ -464,6 +497,76 @@ class TransportStats:
         with self._lock:
             self.read_fallbacks += 1
 
+    def record_read_age(self, seconds: float, src: str = "mono",
+                        tier: str = "wire",
+                        bound: Optional[float] = None,
+                        clamped: bool = False) -> None:
+        """One serve recorded its data age (``now - version birth``,
+        resolved by ``ps_tpu/obs/freshness.age_of``): ``src`` tags the
+        clock the age came from, ``tier`` names the serving tier
+        (cache/wire/replica/nm/agg/pump/...), ``bound`` is the staleness
+        SLO this endpoint holds reads to (None = untracked), ``clamped``
+        marks a negative age clamped to zero."""
+        self.hist["read_age_s"].record(seconds)
+        with self._lock:
+            self.reads_aged += 1
+            if bound is not None and seconds <= bound:
+                self.reads_fresh += 1
+            if src in self.fresh_src:
+                self.fresh_src[src] += 1
+            t = self.fresh_tiers.setdefault(tier, [0, 0.0])
+            t[0] += 1
+            if seconds > t[1]:
+                t[1] = float(seconds)
+            if clamped:
+                self.fresh_clock_clamped += 1
+        if clamped:
+            self._c_fresh_clamped.inc()
+
+    def record_fresh_lag(self, seconds: float) -> None:
+        """Primary side: one apply's push->first-servable lag (commit
+        to the moment the new version could answer a READ)."""
+        self.hist["fresh_lag_s"].record(seconds)
+
+    def record_read_gap(self, versions: int) -> None:
+        """Worker side: a staleness-bound refusal's version gap — HOW
+        far the refused reply trailed the freshest known version (the
+        companion distribution to the read_fallbacks count)."""
+        self.hist["read_gap_v"].record(float(versions))
+
+    def fresh_snapshot(self) -> Optional[dict]:
+        """The STATS frame's ``fresh`` dict (None until any freshness
+        sample exists): age/lag quantiles in ms, the within-bound share
+        (``ps_top``'s age%), clamp count, source mix, and the per-tier
+        {count, max age} map ``ps_doctor`` names stale tiers from."""
+        age = self.hist["read_age_s"]
+        lag = self.hist["fresh_lag_s"]
+        with self._lock:
+            aged, within = self.reads_aged, self.reads_fresh
+            clamped = self.fresh_clock_clamped
+            src = {k: v for k, v in self.fresh_src.items() if v}
+            tiers = {t: {"n": int(n), "max_ms": round(mx * 1e3, 3)}
+                     for t, (n, mx) in self.fresh_tiers.items()}
+        if aged == 0 and lag.total == 0:
+            return None
+        out: dict = {"aged": int(aged)}
+        if age.total > 0:
+            out["age_p50_ms"] = round(age.quantile(0.50) * 1e3, 3)
+            out["age_p99_ms"] = round(age.quantile(0.99) * 1e3, 3)
+        if aged > 0:
+            out["within"] = int(within)
+            out["fresh_share"] = round(within / aged, 4)
+        if lag.total > 0:
+            out["lag_p50_ms"] = round(lag.quantile(0.50) * 1e3, 3)
+            out["lag_p99_ms"] = round(lag.quantile(0.99) * 1e3, 3)
+        if clamped:
+            out["clamped"] = int(clamped)
+        if src:
+            out["src"] = src
+        if tiers:
+            out["tiers"] = tiers
+        return out
+
     def record_read_not_modified(self) -> None:
         """Server side: one conditional READ answered NOT_MODIFIED —
         the caller's version is current, only the stamp shipped."""
@@ -601,7 +704,10 @@ class TransportStats:
                     self.sparse_rows_applied,
                     # conditional reads: APPENDED (older snapshots
                     # zero-pad in summary — positions are the contract)
-                    self.read_not_modified, self.read_delta_rows)
+                    self.read_not_modified, self.read_delta_rows,
+                    # freshness plane: APPENDED likewise
+                    self.reads_aged, self.reads_fresh,
+                    self.fresh_clock_clamped)
 
     def summary(self, since: Optional[tuple] = None) -> Dict[str, float]:
         now = self.snapshot()
@@ -691,6 +797,13 @@ class TransportStats:
             out["read_not_modified"] = int(d[37])
         if d[38] > 0:
             out["read_delta_rows"] = int(d[38])
+        # freshness plane: only reported once serves recorded ages in
+        # the interval; the share is the interval's, not lifetime
+        if d[39] > 0:
+            out["reads_aged"] = int(d[39])
+            out["read_fresh_share"] = round(d[40] / d[39], 4)
+        if d[41] > 0:
+            out["fresh_clock_clamped"] = int(d[41])
         # latency DISTRIBUTIONS (ps_tpu/obs): quantiles of everything the
         # histograms saw — lifetime, not interval (a p99 over an interval
         # delta of log buckets is computable but the lifetime tail is

@@ -1,0 +1,345 @@
+"""Freshness plane (README "Online serving & freshness").
+
+The contracts this file pins:
+
+1. **Birth stamps are committed state**: a version's birth is stamped
+   once, at the primary's apply, and rides the reply bytes — so the
+   zero-upcall native cache re-serves the SAME stamp bitwise, and
+   ``age = now - birth`` is honest at every tier.
+2. **Clock discipline**: :func:`ps_tpu.obs.freshness.age_of` resolves
+   the age mono → sync → wall (a foreign monotonic clock is never
+   trusted), tags the sample's source, and clamps negative ages to zero
+   while counting ``ps_freshness_clock_clamped_total``.
+3. **Every serving tier ages its serves**: worker pull-cache hits,
+   wire reads, replica reads, NOT_MODIFIED revalidations (which must
+   REFRESH the age, not freeze it), and aggregator coalesced snapshots
+   each record into ``ps_read_staleness_seconds`` with their tier tag —
+   all within one run's telemetry window.
+4. **Refusals record their margin**: a staleness-bound refusal's
+   version gap lands in ``read_gap_v`` (the frozen-backup regression),
+   not just the fallback count.
+5. **The SLO grammar speaks freshness**: ``freshness``/``staleness``/
+   ``read`` aliases parse, and a FleetTSDB-backed rule on
+   ``ps_freshness_lag_seconds`` breaches and recovers like any other.
+"""
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import ps_tpu as ps
+from ps_tpu import obs
+from ps_tpu.backends.remote_async import AsyncPSService, connect_async
+from ps_tpu.config import Config
+from ps_tpu.control import tensor_van as tv
+from ps_tpu.obs import freshness
+from ps_tpu.obs.metrics import Histogram
+from ps_tpu.obs.slo import SloEvaluator, parse_rule, parse_rules
+from ps_tpu.obs.tsdb import FleetTSDB
+from ps_tpu.utils.metrics import TransportStats
+
+
+@pytest.fixture
+def tpu_async(request):
+    ps.init(backend="tpu", mode="async", num_workers=2, dc_lambda=0.0)
+    request.addfinalizer(ps.shutdown)
+
+
+def _params():
+    return {"a/w": jnp.zeros((16, 8), jnp.float32),
+            "b/w": jnp.ones((32,), jnp.float32)}
+
+
+def _grad(x: float):
+    return {"a/w": jnp.full((16, 8), x, jnp.float32),
+            "b/w": jnp.full((32,), x, jnp.float32)}
+
+
+def _svc(**kw):
+    st = ps.KVStore(optimizer="sgd", learning_rate=0.5, mode="async")
+    st.init(_params())
+    return AsyncPSService(st, bind="127.0.0.1", **kw)
+
+
+def _raw_read(port, payload=None):
+    ch = tv.Channel.connect("127.0.0.1", port)
+    try:
+        return bytes(ch.request(payload or tv.encode(tv.READ, 0, None)))
+    finally:
+        ch.close()
+
+
+def _hist_state(vals, name):
+    h = Histogram(name)
+    for v in vals:
+        h.record(v)
+    return {"k": "hist", **h.state()}
+
+
+# -- clock discipline (unit) --------------------------------------------------
+
+
+def test_age_of_prefers_mono_then_sync_then_wall():
+    own = freshness.birth_record()
+    age, src, clamped = freshness.age_of(own)
+    assert src == "mono" and not clamped and 0.0 <= age < 5.0
+
+    # a foreign stamp (empty token) must never use OUR monotonic clock
+    foreign = freshness.foreign_record(time.time() - 1.0)
+    age, src, clamped = freshness.age_of(foreign)
+    assert src == "wall" and not clamped
+    assert age == pytest.approx(1.0, abs=0.5)
+
+    # with a ClockSync offset in hand, the local wall is projected into
+    # the stamper's clock: +2 s of offset adds 2 s of resolved age
+    age, src, clamped = freshness.age_of(foreign, offset_us=2e6)
+    assert src == "sync" and not clamped
+    assert age == pytest.approx(3.0, abs=0.5)
+
+    # another process that happens to carry a monotonic stamp: the
+    # token mismatch demotes it to the wall path (pids recycle; a
+    # foreign monotonic clock means nothing here)
+    twin = dict(freshness.birth_record())
+    twin["bpid"] = "deadbeef.cafe"
+    assert freshness.age_of(twin)[1] == "wall"
+
+    # a skewed member's future birth clamps to ZERO, flagged — never a
+    # negative age dragging fleet quantiles below zero
+    future = freshness.foreign_record(time.time() + 60.0)
+    age, src, clamped = freshness.age_of(future)
+    assert age == 0.0 and clamped and src == "wall"
+
+
+def test_from_extra_dense_and_sparse_forms():
+    assert freshness.from_extra({}) is None
+    assert freshness.from_extra({"version": 3}) is None
+    rec = freshness.birth_record()
+    assert freshness.from_extra(dict(rec)) == rec
+
+    # sparse wire form: per-table [wall, mono, bpid] triples; a foreign
+    # stamp ships [wall] (or a None mono) and resolves to wall-only
+    extra = {"births": {"emb": [rec["birth"], rec["bmono"], rec["bpid"]],
+                        "deep": [123.5]}}
+    got = freshness.from_extra(extra, table="emb")
+    assert got == rec
+    got = freshness.from_extra(extra, table="deep")
+    assert got == {"birth": 123.5, "bmono": None, "bpid": ""}
+    assert freshness.from_extra(extra, table="wide") is None
+    assert freshness.from_extra(
+        {"births": {"e": [1.0, None, None]}}, table="e") == \
+        {"birth": 1.0, "bmono": None, "bpid": ""}
+
+
+def test_record_read_age_tiers_share_and_clamp_counter():
+    t = TransportStats()
+    assert t.fresh_snapshot() is None  # no samples: no STATS dict
+    t.record_read_age(0.010, src="mono", tier="cache", bound=0.5)
+    t.record_read_age(0.020, src="wall", tier="wire", bound=0.5)
+    t.record_read_age(0.900, src="sync", tier="replica", bound=0.5)
+    t.record_read_age(0.0, src="wall", tier="wire", bound=0.5,
+                      clamped=True)
+    f = t.fresh_snapshot()
+    assert f["aged"] == 4 and f["within"] == 3
+    assert f["fresh_share"] == pytest.approx(0.75)
+    assert f["clamped"] == 1
+    assert f["src"] == {"mono": 1, "wall": 2, "sync": 1}
+    assert f["tiers"]["wire"]["n"] == 2
+    assert f["tiers"]["replica"]["max_ms"] == pytest.approx(900.0, rel=0.3)
+    t.record_fresh_lag(0.004)
+    assert t.fresh_snapshot()["lag_p99_ms"] == pytest.approx(4.0, rel=0.3)
+
+
+# -- birth stamps ride the reply bytes (native determinism held) --------------
+
+
+def test_read_reply_carries_birth_and_native_hit_reserves_it(tpu_async):
+    svc = _svc(native_loop=True)
+    w = connect_async(f"127.0.0.1:{svc.port}", 0, _params())
+    try:
+        w.push_all(_grad(0.5))
+        miss = _raw_read(svc.port)   # pump path; publishes + stamps age
+        hit = _raw_read(svc.port)    # native path; echoes the publish
+        assert hit == miss           # births did not break determinism
+        kind, _, _, extra = tv.decode(memoryview(miss))
+        assert kind == tv.OK
+        b = freshness.from_extra(extra)
+        assert b is not None and b["bpid"] == freshness.PROC_TOKEN
+        assert 0.0 <= time.time() - b["birth"] < 30.0
+        f = svc.transport.fresh_snapshot()
+        assert f and f["tiers"].get("pump", {}).get("n", 0) >= 1
+        assert f["lag_p99_ms"] is not None  # the apply recorded its lag
+    finally:
+        w.close()
+        svc.stop()
+
+
+# -- the four-tier e2e age drill ----------------------------------------------
+
+
+def test_four_tier_age_drill(tpu_async):
+    """Ages served from (a) the worker pull cache, (b) a replica read,
+    (c) a NOT_MODIFIED revalidation, (d) an aggregator coalesced
+    snapshot — each visible, tier-tagged, in the same run's telemetry
+    window. The replica's samples must resolve through a CROSS-process
+    clock path (foreign_record never trusts a monotonic stamp), the
+    cache/wire samples through the exact monotonic one."""
+    prim = _svc()
+    back = _svc(backup=True)
+    prim.attach_backup("127.0.0.1", back.port, ack="sync")
+    uri = f"127.0.0.1:{prim.port}|127.0.0.1:{back.port}"
+    # sync-acked set: bound 0 still lets the backup serve, and an
+    # artificial 1-version lag signal is enough to force a revalidation
+    wcache = connect_async(uri, 0, _params(), pull_cache=True,
+                           read_staleness=0)
+    wspread = connect_async(uri, 1, _params(), read_staleness=10_000)
+    agg = None
+    try:
+        wcache.push_all(_grad(0.5))
+
+        # (a) pull-cache hits: one wire fetch, then cached serves age
+        for _ in range(3):
+            wcache.read_all()
+        fc = wcache.transport.fresh_snapshot()
+        assert fc["tiers"].get("cache", {}).get("n", 0) >= 1, fc
+
+        # (c) NOT_MODIFIED revalidation: a version-lag signal against an
+        # unchanged server — the NM must RECORD the (grown) age of the
+        # bytes the worker keeps, off the server's fresh stamp
+        time.sleep(0.25)
+        wcache.versions[0] += 1
+        wcache.read_all()
+        fc = wcache.transport.fresh_snapshot()
+        nm = fc["tiers"].get("nm", {})
+        assert nm.get("n", 0) >= 1, fc
+        assert nm["max_ms"] >= 200.0  # the sleep aged the held bytes
+
+        # (b) replica reads: an uncached reader rotating over the
+        # sync-acked set lands on the backup, whose installed birth is a
+        # FOREIGN record — resolved via sync/wall, never mono
+        for _ in range(6):
+            wspread.read_all()
+        assert wspread.transport.reads_replica >= 2
+        fs = wspread.transport.fresh_snapshot()
+        assert fs["tiers"].get("replica", {}).get("n", 0) >= 1, fs
+        cross = fs["src"].get("sync", 0) + fs["src"].get("wall", 0)
+        assert cross >= 1, fs["src"]
+        assert fs["src"].get("mono", 0) >= 1  # primary serves stay exact
+        # the backup served with its own serve-age note, tier "replica"
+        fb = back.transport.fresh_snapshot()
+        assert fb and fb["tiers"].get("replica", {}).get("n", 0) >= 1
+
+        # (d) aggregator: the coalesced snapshot carries the upstream
+        # birth; member READs age with tier "agg"
+        from ps_tpu.backends.aggregator import AggregatorService
+
+        agg = AggregatorService(f"127.0.0.1:{prim.port}", _params(),
+                                group_size=2, bind="127.0.0.1")
+        kind, _, _, extra = tv.decode(memoryview(_raw_read(agg.port)))
+        assert kind == tv.OK and freshness.from_extra(extra) is not None
+        fa = agg.transport.fresh_snapshot()
+        assert fa and fa["tiers"].get("agg", {}).get("n", 0) >= 1
+
+        # the whole drill resolved every age without a single clamp
+        for f in (fc, fs, fb, fa):
+            assert f.get("clamped", 0) == 0, f
+    finally:
+        wcache.close()
+        wspread.close()
+        if agg is not None:
+            agg.stop()
+        prim.stop()
+        back.stop()
+
+
+# -- refusals record their version gap (frozen-backup regression) -------------
+
+
+def test_frozen_backup_refusal_records_version_gap(tpu_async):
+    """A backup frozen at version 0 against a primary at 4, bound 1:
+    every read falls back (zero replica serves), and the REFUSED
+    version gap — not just the refusal count — lands in the read_gap_v
+    histogram so ps_doctor can say HOW far behind the replica was."""
+    prim = _svc()
+    stale = _svc(backup=True)  # frozen: no stream ever attaches
+    uri = f"127.0.0.1:{prim.port}|127.0.0.1:{stale.port}"
+    w = connect_async(uri, 0, _params(), read_staleness=1)
+    try:
+        for _ in range(4):
+            w.push_all(_grad(0.25))
+        for _ in range(6):
+            w.read_all()
+        assert w.transport.reads_replica == 0
+        assert w.transport.read_fallbacks >= 3
+        gap = w.transport.hist["read_gap_v"]
+        assert gap.total >= 3
+        # the gap is 4 versions (4 known - 0 served); log2 buckets keep
+        # the estimate within the documented bound
+        assert gap.quantile(0.5) == pytest.approx(4.0, rel=0.5)
+    finally:
+        w.close()
+        prim.stop()
+        stale.stop()
+
+
+# -- the SLO grammar speaks freshness -----------------------------------------
+
+
+def test_freshness_slo_aliases_parse():
+    r = parse_rule("freshness p99 < 500ms over 30s")
+    assert r.metric == "ps_freshness_lag_seconds"
+    assert r.q == 0.99 and r.threshold_s == pytest.approx(0.5)
+    r = parse_rule("staleness p95 < 500ms over 30s")
+    assert r.metric == "ps_read_staleness_seconds" and r.q == 0.95
+    r = parse_rule("read p99 < 25ms over 30s")
+    assert r.metric == "ps_read_seconds"
+    rules = parse_rules("read p99 < 25ms over 30s; "
+                        "freshness p99 < 500ms over 30s")
+    assert [x.metric for x in rules] == ["ps_read_seconds",
+                                        "ps_freshness_lag_seconds"]
+
+
+def test_slo_rule_on_freshness_breach_and_recover():
+    """The breach/recover drill on the freshness lag itself: slow
+    applies breach 'freshness p99 < 5ms', the flight log gets the
+    transition, and a flood of fast applies recovers it."""
+    db = FleetTSDB(window_s=30.0, ring=8)
+    ev = SloEvaluator(db, parse_rules("freshness p99 < 5ms over 10s"))
+    flight0 = len([e for e in obs.flight().events()
+                   if e["kind"] == "slo_breach"])
+    now = time.monotonic()
+    db.ingest("m0", {"ps_freshness_lag_seconds": _hist_state(
+        [0.050] * 50, "ps_freshness_lag_seconds")}, t=now)
+    states = ev.evaluate()
+    assert states[0]["breached"] and states[0]["value_ms"] > 5.0
+    assert len([e for e in obs.flight().events()
+                if e["kind"] == "slo_breach"]) == flight0 + 1
+    db.ingest("m0", {"ps_freshness_lag_seconds": _hist_state(
+        [0.050] * 50 + [0.0001] * 10_000, "ps_freshness_lag_seconds")},
+        t=now + 0.5)
+    states = ev.evaluate()
+    assert not states[0]["breached"]
+    assert ev.breached() == []
+
+
+# -- knobs --------------------------------------------------------------------
+
+
+def test_freshness_slo_knob_four_way(tpu_async, monkeypatch):
+    monkeypatch.setenv("PS_FRESHNESS_SLO", "0.25")
+    assert Config.from_env().freshness_slo == pytest.approx(0.25)
+    with pytest.raises(ValueError):
+        Config(freshness_slo=0.0)
+    with pytest.raises(ValueError):
+        Config(freshness_slo=-1.0)
+    # the bound reaches both judges: the server's serve-age note and
+    # the worker's read-age note
+    svc = _svc()
+    w = connect_async(f"127.0.0.1:{svc.port}", 0, _params())
+    try:
+        assert svc._fresh_slo == pytest.approx(0.25)
+        assert w.freshness_slo == pytest.approx(0.25)
+    finally:
+        w.close()
+        svc.stop()

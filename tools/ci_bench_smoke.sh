@@ -565,3 +565,48 @@ print(f"  policy actions {det['policy_actions_total']} "
       f"{det['pushes']} pushes exactly-once; seed {det['chaos_seed']}")
 print("chaos autopilot smoke OK")
 EOF
+
+# 11. online serving freshness (<60 s): `bench.py --model online --quick`
+# — the closed-loop train-and-serve drill (README "Online serving &
+# freshness"): zipfian readers at bounded staleness against dense+sparse
+# shards while trainers keep pushing through an aggregator, swept through
+# diurnal load, a 10x flash crowd on a hot id-set, and a reader:writer
+# ratio shift. Asserts BOTH headline SLOs held through the flash crowd
+# with training running (read p99 AND push->servable freshness p99,
+# judged by the same rule grammar the coordinator parses), NM
+# revalidations actually fired, and the bounded-staleness contract saw
+# zero violations.
+out=$(timeout -k 10 120 env JAX_PLATFORMS=cpu python bench.py --model online --quick 2>/dev/null | tail -1)
+python - "$out" <<'EOF'
+import json
+import sys
+
+rec = json.loads(sys.argv[1])
+assert rec["metric"] == "online_read_p99_ms", rec["metric"]
+det = rec["detail"]
+for s in det["slo"]:
+    mark = "BREACH" if s["breached"] else "ok"
+    print(f"  [{mark:6s}] {s['rule']}  value={s['value_ms']}ms")
+assert det["slo_compliant"], \
+    f"online SLOs breached through the flash crowd: {det['slo']}"
+assert det["read_p99_ms"] is not None and det["lag_p99_ms"] is not None
+assert det["nm_hits"] > 0, \
+    f"no NOT_MODIFIED revalidations under the warm readers: {det['nm_hits']}"
+assert det["staleness_violations"] == 0, \
+    f"bounded-staleness contract violated: {det['staleness_violations']}"
+assert det["reads_aged"] > 0, "no served read carried a birth stamp"
+assert det["clock_clamped"] == 0, \
+    f"negative ages clamped: {det['clock_clamped']}"
+tiers = det["age_tiers"]
+print(f"  read p99 {det['read_p99_ms']}ms, freshness lag p99 "
+      f"{det['lag_p99_ms']}ms, age p95 {det['age_p95_ms']}ms; "
+      f"fresh share {det['fresh_share']} over {det['reads_aged']} "
+      f"aged reads")
+print(f"  nm hits {det['nm_hits']} (rate {det['nm_hit_rate']}), "
+      f"delta rows {det['delta_rows']}; tiers "
+      + " ".join(f"{t}:{v['n']}" for t, v in sorted(tiers.items())))
+print("  phases: " + "  ".join(
+    f"{name} read_p99={row['read_p99_ms']}ms"
+    for name, row in det["phases"].items()))
+print("online freshness smoke OK")
+EOF

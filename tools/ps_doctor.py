@@ -13,7 +13,12 @@ rendered as a readable report —
   server-apply / ack-wait, with each phase's share of the step);
 - straggler suspects (windowed leave-one-out z-score) and rebalance
   hints, next to the byte-skew trigger;
-- SLO rule states (breached / ok / no data).
+- SLO rule states (breached / ok / no data);
+- the freshness plane (README "Online serving & freshness"): one STATS
+  round trip per data-plane member names the **stalest serving tier per
+  shard** — which of pump / replica / cache / wire / nm / agg handed out
+  the oldest bytes — next to the shard's push→servable lag p99 and the
+  share of aged serves inside the PS_FRESHNESS_SLO bound.
 
 Usage::
 
@@ -37,11 +42,69 @@ import sys
 # tools/ run from the repo root; make that explicit for direct execution
 sys.path.insert(0, ".")
 
+from ps_tpu.control import tensor_van as tv  # noqa: E402
 from ps_tpu.elastic.member import fetch_telemetry, fetch_view  # noqa: E402
 
 
 def _ms(v) -> str:
     return "-" if v is None else f"{v:8.3f}"
+
+
+def freshness_section(view: dict) -> list:
+    """Per-shard freshness from one STATS round trip per data-plane
+    member: each row carries the shard's merged push→servable lag p99,
+    the share of aged serves within the PS_FRESHNESS_SLO bound, and the
+    STALEST tier — the serving path (pump / replica / cache / wire / nm /
+    agg) whose oldest handed-out bytes had the largest age. Members whose
+    STATS fail (or that have no aged serves yet) are skipped; an empty
+    list means no member has freshness samples."""
+    shards: dict = {}
+    for m in view.get("members") or []:
+        uri = m.get("uri") or ""
+        if ":" not in uri:
+            continue
+        host, _, port = uri.rpartition(":")
+        try:
+            ch = tv.Channel.connect(host, int(port), timeout_ms=2000,
+                                    retries=1, max_wait_s=0.5)
+        except (tv.VanError, OSError, ValueError):
+            continue
+        try:
+            kind, _, _, extra = tv.decode(
+                ch.request(tv.encode(tv.STATS, 0, None)))
+        except (tv.VanError, OSError):
+            continue
+        finally:
+            ch.close()
+        fresh = extra.get("fresh") if kind == tv.OK else None
+        if not isinstance(fresh, dict):
+            continue
+        row = shards.setdefault(m.get("shard"), {
+            "shard": m.get("shard"), "aged": 0, "within": 0,
+            "lag_p99_ms": None, "clamped": 0, "tiers": {}})
+        row["aged"] += int(fresh.get("aged", 0))
+        row["within"] += int(fresh.get("within", 0))
+        row["clamped"] += int(fresh.get("clamped", 0))
+        lag = fresh.get("lag_p99_ms")
+        if lag is not None and lag > (row["lag_p99_ms"] or 0):
+            row["lag_p99_ms"] = lag  # primaries stamp; backups don't
+        for tier, t in (fresh.get("tiers") or {}).items():
+            cur = row["tiers"].setdefault(tier, {"n": 0, "max_ms": 0.0})
+            cur["n"] += int(t.get("n", 0))
+            cur["max_ms"] = max(cur["max_ms"], float(t.get("max_ms", 0)))
+    out = []
+    for shard in sorted(shards, key=lambda s: (s is None, s)):
+        row = shards[shard]
+        if not row["aged"]:
+            continue
+        row["fresh_share"] = round(row["within"] / row["aged"], 4)
+        stalest = max(row["tiers"].items(),
+                      key=lambda kv: kv[1]["max_ms"], default=None)
+        if stalest:
+            row["stalest_tier"] = stalest[0]
+            row["stalest_age_ms"] = round(stalest[1]["max_ms"], 3)
+        out.append(row)
+    return out
 
 
 def native_section(tel: dict) -> dict:
@@ -164,6 +227,22 @@ def render(view: dict, tel: dict, stream=sys.stdout) -> None:
         print(f"  [{mark:7s}] {r.get('rule')}  value={r.get('value_ms')}"
               f"ms threshold={r.get('threshold_ms')}ms", file=stream)
 
+    print("\n-- freshness --", file=stream)
+    fresh = freshness_section(view)
+    if not fresh:
+        print("  (no aged serves yet — no member reported a fresh dict)",
+              file=stream)
+    for row in fresh:
+        lag = row.get("lag_p99_ms")
+        print(f"  shard {row['shard']}: "
+              f"lag p99={'-' if lag is None else f'{lag:.3f}'}ms  "
+              f"fresh={row['fresh_share'] * 100:.1f}% of "
+              f"{row['aged']} aged serve(s)  "
+              f"stalest tier={row.get('stalest_tier', '-')} "
+              f"(oldest {row.get('stalest_age_ms', 0)}ms)"
+              + (f"  clock_clamped={row['clamped']}" if row["clamped"]
+                 else ""), file=stream)
+
     hints = tel.get("hints") or []
     if hints:
         print("\n-- rebalance hints --", file=stream)
@@ -193,7 +272,9 @@ def main(argv=None) -> int:
         return 2
     if args.json:
         print(json.dumps({"view": view, "telemetry": tel,
-                          "native": native_section(tel)}, default=str))
+                          "native": native_section(tel),
+                          "freshness": freshness_section(view)},
+                         default=str))
     else:
         render(view, tel)
     unhealthy = bool(tel.get("stragglers")) or any(

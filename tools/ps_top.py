@@ -58,7 +58,8 @@ COLS = [
     ("loop", 10), ("nlp99", 8), ("qw99", 8), ("padm%", 6), ("reads", 8),
     ("nhit%", 6),
     ("chit%", 6), ("nm%", 6),
-    ("rshare%", 7), ("tier", 6), ("rows", 9), ("sap99", 8),
+    ("rshare%", 7), ("fresh", 7), ("age%", 6),
+    ("tier", 6), ("rows", 9), ("sap99", 8),
     ("hot%", 6), ("evict", 7),
 ]
 
@@ -138,7 +139,8 @@ def render_row(st: dict) -> dict:
                 "ack_p99_ms": "-", "bkt_p99_ms": "-", "loop": "-",
                 "nlp99": "-", "qw99": "-", "padm%": "-",
                 "reads": "-", "nhit%": "-", "chit%": "-", "nm%": "-",
-                "rshare%": "-", "tier": "-", "rows": "-", "sap99": "-",
+                "rshare%": "-", "fresh": "-", "age%": "-",
+                "tier": "-", "rows": "-", "sap99": "-",
                 "hot%": "-", "evict": "-"}
     repl = st.get("repl") or {}
     # a live session renders "<ack mode>@<acked seq>" so an operator sees
@@ -207,6 +209,12 @@ def render_row(st: dict) -> dict:
         # backup rows' reads over the whole set's (same value on every
         # row of a shard — the read-replica share of its traffic)
         "rshare%": _opt(st.get("_rshare")),
+        # freshness plane (README "Online serving & freshness"): the
+        # push->first-servable lag p99 (ms, primaries only — backups
+        # serve but never stamp) and the share of this endpoint's aged
+        # serves that landed within the PS_FRESHNESS_SLO bound
+        "fresh": _fresh_lag(st),
+        "age%": _fresh_share_pct(st),
         # sparse fused apply (README "Sparse apply"): the shard's apply
         # tier, raw row updates applied, and the per-push row-apply p99
         # (ms) — a shard falling off the fused tier shows 'off' here and
@@ -221,6 +229,24 @@ def render_row(st: dict) -> dict:
         "hot%": _hot_pct(st),
         "evict": _tier_churn(st),
     }
+
+
+def _fresh_lag(st: dict):
+    """Push→first-servable lag p99 in ms from the STATS ``fresh`` dict
+    ("-" = no freshness samples yet, or a tier that never applies)."""
+    f = st.get("fresh")
+    if not isinstance(f, dict) or f.get("lag_p99_ms") is None:
+        return "-"
+    return f["lag_p99_ms"]
+
+
+def _fresh_share_pct(st: dict):
+    """Share of this endpoint's age-stamped serves within the freshness
+    bound (PS_FRESHNESS_SLO) — the fleet's at-a-glance age% column."""
+    f = st.get("fresh")
+    if not isinstance(f, dict) or f.get("fresh_share") is None:
+        return "-"
+    return round(100.0 * f["fresh_share"], 1)
 
 
 def _hot_pct(st: dict):
